@@ -1,0 +1,18 @@
+"""openr_tpu — a TPU-native link-state routing platform.
+
+A from-scratch framework with the capabilities of Meta's OpenR
+(reference: /root/reference, surveyed in SURVEY.md): Spark-style neighbor
+discovery, an eventually-consistent CRDT key-value store with flooding,
+a Decision module computing full RIBs (SPF/ECMP/UCMP/KSP2, unicast + MPLS),
+and a Fib module programming routes — composed as asyncio actor modules over
+replicated queues, with a control API, CLI, watchdog and PerfEvents tracing.
+
+The differentiator is the route-computation core: the LinkState graph and
+prefix database are mirrored into device-resident CSR arrays and a
+jit-compiled, batched SSSP (frontier-synchronous Bellman-Ford in JAX/XLA)
+computes all-node shortest paths plus ECMP/LFA next-hops in one shot behind
+a runtime-selectable solver backend (see openr_tpu/ops and
+openr_tpu/decision/tpu_solver.py).
+"""
+
+__version__ = "0.1.0"

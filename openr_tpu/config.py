@@ -1,0 +1,307 @@
+"""Validated process configuration.
+
+Role of the reference's openr/config/Config.{h,cpp} over the thrift-JSON
+schema openr/if/OpenrConfig.thrift (DecisionConfig:171, LinkMonitorConfig:189,
+SparkConfig:231, WatchdogConfig:260, areas + regex matchers Config.h:34-110).
+Config is parsed from a JSON file, validated once at startup, and read-only
+thereafter; runtime mutables (drain state, metric overrides) go through the
+ctrl API + PersistentStore, not config reload.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass, field
+from typing import Optional
+
+from openr_tpu import serde
+
+
+class ConfigError(ValueError):
+    pass
+
+
+@dataclass
+class AreaConfig:
+    """ref OpenrConfig.thrift AreaConfig + AreaConfiguration Config.h:112."""
+
+    area_id: str = "0"
+    neighbor_regexes: list[str] = field(default_factory=lambda: [".*"])
+    include_interface_regexes: list[str] = field(default_factory=list)
+    exclude_interface_regexes: list[str] = field(default_factory=list)
+    redistribute_interface_regexes: list[str] = field(default_factory=list)
+
+
+@dataclass
+class KvstoreConfig:
+    """ref OpenrConfig.thrift KvstoreConfig + KvStoreParams."""
+
+    key_ttl_ms: int = 300_000  # default ttl for self-originated keys
+    ttl_decrement_ms: int = 1
+    sync_interval_s: float = 60.0
+    flood_rate_msgs_per_sec: float = 0.0  # 0 = unlimited
+    flood_rate_burst_size: int = 0
+    self_adjacency_timeout_warn_ms: int = 10_000
+    enable_flood_optimization: bool = False  # DUAL SPT flooding
+    max_parallel_initial_syncs: int = 32
+
+
+@dataclass
+class StepDetectorConfig:
+    """ref OpenrConfig.thrift:223 StepDetectorConfig."""
+
+    fast_window_size: int = 10
+    slow_window_size: int = 60
+    lower_threshold_pct: int = 2
+    upper_threshold_pct: int = 5
+    ads_threshold: int = 500  # absolute us threshold
+
+
+@dataclass
+class SparkConfig:
+    """ref OpenrConfig.thrift SparkConfig:231."""
+
+    neighbor_discovery_port: int = 6666
+    hello_time_s: float = 20.0
+    fastinit_hello_time_ms: int = 500
+    keepalive_time_s: float = 2.0
+    hold_time_s: float = 10.0
+    graceful_restart_time_s: float = 30.0
+    handshake_time_ms: int = 500
+    step_detector_conf: StepDetectorConfig = field(default_factory=StepDetectorConfig)
+    min_packets_per_sec: int = 50  # per-(iface,addr) rate limit (Spark.h:511)
+
+
+@dataclass
+class DecisionConfig:
+    """ref OpenrConfig.thrift DecisionConfig:171 + TPU-backend extension."""
+
+    debounce_min_ms: int = 10
+    debounce_max_ms: int = 250
+    enable_bgp_route_programming: bool = True
+    save_rib_policy: bool = False
+    # openr_tpu extension: route-computation backend. "cpu" is the oracle
+    # (decision/spf_solver.py); "tpu" is the batched JAX solver
+    # (decision/tpu_solver.py); "auto" prefers tpu when a device is present.
+    solver_backend: str = "auto"
+    # capacity classes for static-shape padding (ops/csr.py)
+    max_nodes_hint: int = 0  # 0 = grow on demand
+
+
+@dataclass
+class LinkMonitorConfig:
+    """ref OpenrConfig.thrift LinkMonitorConfig:189."""
+
+    linkflap_initial_backoff_ms: int = 60_000
+    linkflap_max_backoff_ms: int = 300_000
+    use_rtt_metric: bool = True
+
+
+@dataclass
+class FibConfig:
+    fib_port: int = 60100
+    enable_fib_ack: bool = True
+    route_delete_delay_ms: int = 1000
+
+
+@dataclass
+class WatchdogConfig:
+    """ref OpenrConfig.thrift WatchdogConfig:260."""
+
+    interval_s: float = 20.0
+    thread_timeout_s: float = 300.0
+    max_memory_mb: int = 800
+
+
+@dataclass
+class MonitorConfig:
+    max_event_log_entries: int = 100
+    enable_event_log_submission: bool = True
+
+
+@dataclass
+class PrefixAllocationConfig:
+    """ref OpenrConfig.thrift PrefixAllocationConfig."""
+
+    loopback_interface: str = "lo"
+    prefix_allocation_mode: str = "DYNAMIC_LEAF_NODE"  # or DYNAMIC_ROOT_NODE, STATIC
+    seed_prefix: str = ""
+    allocate_prefix_len: int = 128
+    set_loopback_address: bool = False
+
+
+@dataclass
+class SegmentRoutingConfig:
+    enable_segment_routing: bool = False
+    sr_adj_label_type: str = "AUTO"  # AUTO | DISABLED
+    sr_adj_label_range: tuple[int, int] = (50000, 59999)
+    sr_node_label_range: tuple[int, int] = (101, 1100)
+
+
+@dataclass
+class ThriftServerConfig:
+    openr_ctrl_port: int = 2018
+    listen_addr: str = "::1"
+    enable_secure_thrift_server: bool = False
+
+
+@dataclass
+class OpenrConfig:
+    """Top-level config (ref OpenrConfig.thrift:265-955)."""
+
+    node_name: str = ""
+    domain: str = "openr"
+    areas: list[AreaConfig] = field(default_factory=lambda: [AreaConfig()])
+    listen_addr: str = "::"
+    openr_ctrl_port: int = 2018
+    dryrun: bool = False
+    enable_v4: bool = True
+    enable_netlink_fib_handler: bool = False
+    prefix_forwarding_type: int = 0
+    prefix_forwarding_algorithm: int = 0
+    enable_ordered_adj_publication: bool = False
+
+    kvstore_config: KvstoreConfig = field(default_factory=KvstoreConfig)
+    spark_config: SparkConfig = field(default_factory=SparkConfig)
+    decision_config: DecisionConfig = field(default_factory=DecisionConfig)
+    link_monitor_config: LinkMonitorConfig = field(default_factory=LinkMonitorConfig)
+    fib_config: FibConfig = field(default_factory=FibConfig)
+    watchdog_config: WatchdogConfig = field(default_factory=WatchdogConfig)
+    monitor_config: MonitorConfig = field(default_factory=MonitorConfig)
+    prefix_allocation_config: Optional[PrefixAllocationConfig] = None
+    segment_routing_config: SegmentRoutingConfig = field(
+        default_factory=SegmentRoutingConfig
+    )
+    thrift_server: ThriftServerConfig = field(default_factory=ThriftServerConfig)
+
+    enable_watchdog: bool = True
+    enable_prefix_allocation: bool = False
+    persistent_store_path: str = ""
+    originated_prefixes: list[dict] = field(default_factory=list)
+
+    assume_drained: bool = False
+    undrained_flag_path: str = ""
+
+
+class AreaMatcher:
+    """Compiled per-area regex sets for neighbor/interface matching
+    (ref Config.h:34-110 compileRegexSet)."""
+
+    def __init__(self, cfg: AreaConfig):
+        self.area_id = cfg.area_id
+        try:
+            self._neighbor = [re.compile(p) for p in cfg.neighbor_regexes]
+            self._include_if = [re.compile(p) for p in cfg.include_interface_regexes]
+            self._exclude_if = [re.compile(p) for p in cfg.exclude_interface_regexes]
+            self._redist_if = [re.compile(p) for p in cfg.redistribute_interface_regexes]
+        except re.error as e:
+            raise ConfigError(f"area {cfg.area_id}: bad regex: {e}") from e
+
+    @staticmethod
+    def _match(patterns: list[re.Pattern], s: str) -> bool:
+        return any(p.fullmatch(s) for p in patterns)
+
+    def should_discover_on_iface(self, if_name: str) -> bool:
+        if self._match(self._exclude_if, if_name):
+            return False
+        return self._match(self._include_if, if_name)
+
+    def should_peer_with_neighbor(self, node_name: str) -> bool:
+        return self._match(self._neighbor, node_name)
+
+    def should_redistribute_iface(self, if_name: str) -> bool:
+        return self._match(self._redist_if, if_name)
+
+
+class Config:
+    """Validated wrapper (ref Config.h:34). Raises ConfigError on invalid."""
+
+    def __init__(self, cfg: OpenrConfig):
+        self.raw = cfg
+        self._validate()
+        self.areas: dict[str, AreaMatcher] = {
+            a.area_id: AreaMatcher(a) for a in cfg.areas
+        }
+
+    # accessors mirroring the reference's isXEnabled() family ------------
+
+    @property
+    def node_name(self) -> str:
+        return self.raw.node_name
+
+    @property
+    def domain(self) -> str:
+        return self.raw.domain
+
+    def area_ids(self) -> list[str]:
+        return [a.area_id for a in self.raw.areas]
+
+    def get_area_matcher(self, area_id: str) -> AreaMatcher:
+        return self.areas[area_id]
+
+    def match_neighbor_area(self, neighbor_node: str, if_name: str) -> Optional[str]:
+        """First area whose matchers accept (iface, neighbor); None if no
+        area claims it (ref Spark area negotiation)."""
+        for area_id, m in self.areas.items():
+            if m.should_discover_on_iface(if_name) and m.should_peer_with_neighbor(
+                neighbor_node
+            ):
+                return area_id
+        return None
+
+    def is_segment_routing_enabled(self) -> bool:
+        return self.raw.segment_routing_config.enable_segment_routing
+
+    def is_ordered_adj_publication_enabled(self) -> bool:
+        return self.raw.enable_ordered_adj_publication
+
+    # validation ---------------------------------------------------------
+
+    def _validate(self) -> None:
+        cfg = self.raw
+        if not cfg.node_name:
+            raise ConfigError("node_name is required")
+        if any(c in cfg.node_name for c in " :[]"):
+            raise ConfigError("node_name must not contain ' ', ':', '[', ']'")
+        if not cfg.areas:
+            raise ConfigError("at least one area is required")
+        ids = [a.area_id for a in cfg.areas]
+        if len(ids) != len(set(ids)):
+            raise ConfigError("duplicate area ids")
+        sc = cfg.spark_config
+        if sc.hold_time_s < sc.keepalive_time_s:
+            raise ConfigError("spark hold_time must be >= keepalive_time")
+        if sc.keepalive_time_s <= 0 or sc.hello_time_s <= 0:
+            raise ConfigError("spark timers must be positive")
+        dc = cfg.decision_config
+        if dc.debounce_min_ms > dc.debounce_max_ms:
+            raise ConfigError("decision debounce_min must be <= debounce_max")
+        if dc.solver_backend not in ("cpu", "tpu", "auto"):
+            raise ConfigError(f"unknown solver_backend {dc.solver_backend!r}")
+        kc = cfg.kvstore_config
+        if kc.key_ttl_ms <= 0 and kc.key_ttl_ms != -1:
+            raise ConfigError("kvstore key_ttl_ms must be positive or -1 (infinite)")
+        sr = cfg.segment_routing_config
+        if sr.enable_segment_routing:
+            lo, hi = sr.sr_node_label_range
+            if lo >= hi:
+                raise ConfigError("bad node label range")
+
+    # loading ------------------------------------------------------------
+
+    @classmethod
+    def from_file(cls, path: str) -> "Config":
+        with open(path) as fh:
+            return cls.from_json(fh.read())
+
+    @classmethod
+    def from_json(cls, text: str) -> "Config":
+        try:
+            plain = json.loads(text)
+        except json.JSONDecodeError as e:
+            raise ConfigError(f"invalid JSON: {e}") from e
+        return cls(serde.from_plain(plain, OpenrConfig))
+
+    def dump_json(self) -> str:
+        return serde.dumps_json(self.raw, indent=2)

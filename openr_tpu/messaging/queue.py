@@ -1,0 +1,120 @@
+"""Replicated message queues — the inter-module fabric.
+
+Role of the reference's openr/messaging/Queue.h (RQueue:43, RWQueue:83) and
+ReplicateQueue.h:34: MPMC fan-out where every reader sees every write,
+blocking reads suspend the caller (folly fibers there, asyncio tasks here),
+and close() unblocks all pending reads with QueueClosedError.
+
+Unlike the reference we are single-event-loop asyncio rather than
+one-thread-per-module, so the queue is a plain deque + condition per reader;
+the actor model (runtime/actor.py) preserves the single-writer discipline.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+from typing import Generic, TypeVar
+
+T = TypeVar("T")
+
+
+class QueueClosedError(RuntimeError):
+    """Raised from get() once the queue is closed and drained
+    (ref messaging/Queue.h QUEUE_CLOSED)."""
+
+
+class RQueue(Generic[T]):
+    """Read endpoint. Each reader has a private buffer; every push to the
+    parent ReplicateQueue lands in every reader's buffer."""
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self._buf: collections.deque[T] = collections.deque()
+        self._event = asyncio.Event()
+        self._closed = False
+        self._reads = 0
+
+    def _push(self, item: T) -> None:
+        self._buf.append(item)
+        self._event.set()
+
+    def _close(self) -> None:
+        self._closed = True
+        self._event.set()
+
+    def size(self) -> int:
+        return len(self._buf)
+
+    async def get(self) -> T:
+        while True:
+            if self._buf:
+                self._reads += 1
+                item = self._buf.popleft()
+                if not self._buf and not self._closed:
+                    self._event.clear()
+                return item
+            if self._closed:
+                raise QueueClosedError(self.name)
+            await self._event.wait()
+
+    def try_get(self) -> tuple[bool, T | None]:
+        """Non-blocking read: (ok, item)."""
+        if self._buf:
+            self._reads += 1
+            return True, self._buf.popleft()
+        if self._closed:
+            raise QueueClosedError(self.name)
+        return False, None
+
+
+class ReplicateQueue(Generic[T]):
+    """Write endpoint; fan-out to all readers
+    (ref messaging/ReplicateQueue.h:34)."""
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self._readers: list[RQueue[T]] = []
+        self._closed = False
+        self._writes = 0
+
+    def get_reader(self, name: str = "") -> RQueue[T]:
+        if self._closed:
+            raise QueueClosedError(self.name)
+        r = RQueue(name or f"{self.name}#{len(self._readers)}")
+        self._readers.append(r)
+        return r
+
+    def push(self, item: T) -> int:
+        """Replicate to every reader; returns replication count."""
+        if self._closed:
+            raise QueueClosedError(self.name)
+        self._writes += 1
+        for r in self._readers:
+            r._push(item)
+        return len(self._readers)
+
+    def close(self) -> None:
+        self._closed = True
+        for r in self._readers:
+            r._close()
+
+    @property
+    def num_readers(self) -> int:
+        return len(self._readers)
+
+    @property
+    def num_writes(self) -> int:
+        return self._writes
+
+    def stats(self) -> dict:
+        """Queue-depth stats for the watchdog (ref Watchdog.h:45-48)."""
+        return {
+            "name": self.name,
+            "writes": self._writes,
+            "readers": [
+                {"name": r.name, "depth": r.size(), "reads": r._reads}
+                for r in self._readers
+            ],
+            "max_depth": max((r.size() for r in self._readers), default=0),
+        }

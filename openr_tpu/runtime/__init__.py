@@ -1,0 +1,8 @@
+from openr_tpu.runtime.actor import Actor, Timer, run_actors, stop_actors  # noqa: F401
+from openr_tpu.runtime.counters import counters  # noqa: F401
+from openr_tpu.runtime.persistent_store import PersistentStore  # noqa: F401
+from openr_tpu.runtime.throttle import (  # noqa: F401
+    AsyncDebounce,
+    AsyncThrottle,
+    ExponentialBackoff,
+)

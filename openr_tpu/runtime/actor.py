@@ -1,0 +1,152 @@
+"""Actor base — the module concurrency model.
+
+Role of the reference's OpenrEventBase (openr/common/OpenrEventBase.h:30):
+each module is an actor owning its state, running long-lived tasks
+("fibers", ref addFiberTask h:48) that block on queue reads, plus timers.
+Cross-actor communication is queues only; cross-actor reads go through
+async request methods (role of folly::SemiFuture APIs).
+
+We use one asyncio event loop for the whole process (the reference uses one
+OS thread per module; asyncio gives the same single-writer-per-actor
+guarantee with cheaper context switches). Each actor stamps a health
+timestamp for the Watchdog (ref OpenrEventBase.h:76).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Awaitable, Callable, Coroutine, Optional
+
+from openr_tpu.messaging import QueueClosedError
+
+log = logging.getLogger(__name__)
+
+
+class Timer:
+    """Restartable one-shot timer (role of folly AsyncTimeout)."""
+
+    def __init__(self, callback: Callable[[], Any], loop=None):
+        self._callback = callback
+        self._handle: Optional[asyncio.TimerHandle] = None
+        self._loop = loop
+
+    def schedule(self, delay_s: float) -> None:
+        self.cancel()
+        loop = self._loop or asyncio.get_running_loop()
+        self._handle = loop.call_later(delay_s, self._fire)
+
+    def _fire(self) -> None:
+        self._handle = None
+        res = self._callback()
+        if asyncio.iscoroutine(res):
+            asyncio.ensure_future(res)
+
+    def cancel(self) -> None:
+        if self._handle is not None:
+            self._handle.cancel()
+            self._handle = None
+
+    @property
+    def scheduled(self) -> bool:
+        return self._handle is not None
+
+
+class Actor:
+    """Base for all modules (KvStore, Decision, Fib, ...)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._tasks: list[asyncio.Task] = []
+        self._timers: list[Timer] = []
+        self._stopped = asyncio.Event()
+        self._running = False
+        # Health timestamp for watchdog liveness (ref OpenrEventBase.h:76).
+        self.last_alive_ts = time.monotonic()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        """Override run() for main logic; start() spawns it."""
+        self._running = True
+        self.add_task(self._heartbeat_loop(), name=f"{self.name}.heartbeat")
+        await self.on_start()
+
+    async def on_start(self) -> None:  # override
+        pass
+
+    async def stop(self) -> None:
+        self._running = False
+        await self.on_stop()
+        for t in self._timers:
+            t.cancel()
+        for task in self._tasks:
+            task.cancel()
+        for task in self._tasks:
+            try:
+                await task
+            except (asyncio.CancelledError, QueueClosedError):
+                pass
+            except Exception:  # pragma: no cover
+                log.exception("%s: task failed during stop", self.name)
+        self._tasks.clear()
+        self._stopped.set()
+
+    async def on_stop(self) -> None:  # override
+        pass
+
+    # -- fibers / timers ---------------------------------------------------
+
+    def add_task(
+        self, coro: Coroutine[Any, Any, Any], name: str = ""
+    ) -> asyncio.Task:
+        """Role of OpenrEventBase::addFiberTask. QueueClosedError and
+        cancellation terminate the task quietly (shutdown path)."""
+
+        async def runner():
+            try:
+                await coro
+            except (QueueClosedError, asyncio.CancelledError):
+                pass
+            except Exception:
+                log.exception("%s: task %s crashed", self.name, name)
+                raise
+
+        task = asyncio.get_running_loop().create_task(
+            runner(), name=name or f"{self.name}.task"
+        )
+        self._tasks.append(task)
+        return task
+
+    def make_timer(self, callback: Callable[[], Any]) -> Timer:
+        t = Timer(callback)
+        self._timers.append(t)
+        return t
+
+    def schedule(self, delay_s: float, callback: Callable[[], Any]) -> Timer:
+        t = self.make_timer(callback)
+        t.schedule(delay_s)
+        return t
+
+    # -- watchdog hook -----------------------------------------------------
+
+    async def _heartbeat_loop(self) -> None:
+        while self._running:
+            self.last_alive_ts = time.monotonic()
+            await asyncio.sleep(0.1)
+
+    def seconds_since_alive(self) -> float:
+        return time.monotonic() - self.last_alive_ts
+
+
+async def run_actors(*actors: Actor) -> None:
+    """Start actors in order; awaitable handle for tests/main."""
+    for a in actors:
+        await a.start()
+
+
+async def stop_actors(*actors: Actor) -> None:
+    """Stop in reverse order (ref Main.cpp:592-599 teardown ordering)."""
+    for a in reversed(actors):
+        await a.stop()

@@ -1,0 +1,82 @@
+"""Process-wide counters/stats fabric.
+
+Role of fb303 (`fb303::fbData->addStatValue/setCounter`) which the
+reference uses everywhere (e.g. decision.spf_ms LinkState.cpp:909,
+kvstore thrift counters KvStore.cpp:3263). Flat singleton registry with
+counters (set/increment) and stats (windowed sum/count/avg), exported via
+the ctrl API and the monitor module.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Optional
+
+
+class _Stat:
+    __slots__ = ("samples",)
+
+    def __init__(self):
+        # (ts, value) ring; 600s retention
+        self.samples: collections.deque = collections.deque(maxlen=4096)
+
+    def add(self, value: float) -> None:
+        self.samples.append((time.monotonic(), value))
+
+    def windowed(self, window_s: float = 60.0) -> dict:
+        cutoff = time.monotonic() - window_s
+        vals = [v for ts, v in self.samples if ts >= cutoff]
+        n = len(vals)
+        return {
+            "count": n,
+            "sum": sum(vals),
+            "avg": (sum(vals) / n) if n else 0.0,
+            "max": max(vals) if vals else 0.0,
+        }
+
+
+class CounterRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = {}
+        self._stats: dict[str, _Stat] = {}
+
+    def set_counter(self, key: str, value: float) -> None:
+        with self._lock:
+            self._counters[key] = value
+
+    def increment(self, key: str, delta: float = 1) -> None:
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + delta
+
+    def add_stat_value(self, key: str, value: float) -> None:
+        with self._lock:
+            st = self._stats.get(key)
+            if st is None:
+                st = self._stats[key] = _Stat()
+            st.add(value)
+
+    def get_counter(self, key: str) -> Optional[float]:
+        return self._counters.get(key)
+
+    def get_counters(self, prefix: str = "") -> dict[str, float]:
+        with self._lock:
+            out = {k: v for k, v in self._counters.items() if k.startswith(prefix)}
+            for k, st in self._stats.items():
+                if k.startswith(prefix):
+                    w = st.windowed()
+                    out[f"{k}.avg.60"] = w["avg"]
+                    out[f"{k}.count.60"] = w["count"]
+                    out[f"{k}.sum.60"] = w["sum"]
+            return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._stats.clear()
+
+
+# the process-wide instance (role of fb303::fbData)
+counters = CounterRegistry()

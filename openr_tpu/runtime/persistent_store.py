@@ -1,0 +1,126 @@
+"""Crash-safe key-value file store.
+
+Role of the reference's openr/config-store/PersistentStore.{h,cpp}
+(class:55): a TLV append log of ADD/DEL PersistentObjects with periodic
+snapshot compaction and debounced writes. Stores drain state, the
+prefix-allocator index and LinkMonitor adjacency-metric overrides so they
+survive process restart (SURVEY §5 checkpoint/resume).
+
+Format: little-endian records  [1B op][4B klen][4B vlen][key][value].
+A snapshot is the same format written from scratch to a temp file and
+atomically renamed.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Optional
+
+_OP_ADD = 1
+_OP_DEL = 2
+_HDR = struct.Struct("<BII")
+
+# compact once the log has this many records beyond the live set
+_COMPACT_SLACK = 256
+
+
+class PersistentStore:
+    def __init__(self, path: str, dry_run: bool = False):
+        self.path = path
+        self.dry_run = dry_run
+        self._data: dict[str, bytes] = {}
+        self._log_records = 0
+        self._fh = None
+        if not dry_run:
+            self._load()
+            self._open_log()
+
+    # -- public API (ref PersistentStore.h store/load/erase) ---------------
+
+    def store(self, key: str, value: bytes) -> None:
+        self._data[key] = value
+        self._append(_OP_ADD, key, value)
+
+    def store_obj(self, key: str, obj) -> None:
+        from openr_tpu import serde
+
+        self.store(key, serde.serialize(obj))
+
+    def load(self, key: str) -> Optional[bytes]:
+        return self._data.get(key)
+
+    def load_obj(self, key: str, cls):
+        from openr_tpu import serde
+
+        raw = self.load(key)
+        return None if raw is None else serde.deserialize(raw, cls)
+
+    def erase(self, key: str) -> bool:
+        if key not in self._data:
+            return False
+        del self._data[key]
+        self._append(_OP_DEL, key, b"")
+        return True
+
+    def keys(self) -> list[str]:
+        return list(self._data)
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._fh.close()
+            self._fh = None
+
+    # -- internals ---------------------------------------------------------
+
+    def _load(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        with open(self.path, "rb") as fh:
+            blob = fh.read()
+        off = 0
+        n = 0
+        while off + _HDR.size <= len(blob):
+            op, klen, vlen = _HDR.unpack_from(blob, off)
+            off += _HDR.size
+            if off + klen + vlen > len(blob):
+                break  # truncated tail record (crash mid-write): drop
+            key = blob[off : off + klen].decode()
+            off += klen
+            value = blob[off : off + vlen]
+            off += vlen
+            n += 1
+            if op == _OP_ADD:
+                self._data[key] = value
+            elif op == _OP_DEL:
+                self._data.pop(key, None)
+        self._log_records = n
+
+    def _open_log(self) -> None:
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self._fh = open(self.path, "ab")
+
+    def _append(self, op: int, key: str, value: bytes) -> None:
+        if self.dry_run:
+            return
+        kb = key.encode()
+        self._fh.write(_HDR.pack(op, len(kb), len(value)) + kb + value)
+        self._fh.flush()
+        self._log_records += 1
+        if self._log_records > len(self._data) + _COMPACT_SLACK:
+            self._snapshot()
+
+    def _snapshot(self) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as fh:
+            for key, value in self._data.items():
+                kb = key.encode()
+                fh.write(_HDR.pack(_OP_ADD, len(kb), len(value)) + kb + value)
+            fh.flush()
+            os.fsync(fh.fileno())
+        self._fh.close()
+        os.replace(tmp, self.path)
+        self._open_log()
+        self._log_records = len(self._data)

@@ -1,0 +1,122 @@
+"""Rate-limiting primitives for expensive callbacks.
+
+Roles of the reference's openr/common/AsyncThrottle.h:31,
+AsyncDebounce.h:25 and ExponentialBackoff.{h,cpp}. AsyncDebounce is what
+batches SPF runs in Decision (debounce_min..max window doubling); the same
+semantics here drive the TPU solver's batching window.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Callable, Optional
+
+
+class AsyncThrottle:
+    """Invoke `callback` at most once per `interval_s`; calls made while
+    armed coalesce into the single pending invocation
+    (ref AsyncThrottle.h:31)."""
+
+    def __init__(self, interval_s: float, callback: Callable[[], Any]):
+        self.interval_s = interval_s
+        self._callback = callback
+        self._handle: Optional[asyncio.TimerHandle] = None
+
+    def __call__(self) -> None:
+        if self._handle is not None:
+            return  # already armed; coalesce
+        loop = asyncio.get_running_loop()
+        self._handle = loop.call_later(self.interval_s, self._fire)
+
+    def _fire(self) -> None:
+        self._handle = None
+        res = self._callback()
+        if asyncio.iscoroutine(res):
+            asyncio.ensure_future(res)
+
+    def cancel(self) -> None:
+        if self._handle is not None:
+            self._handle.cancel()
+            self._handle = None
+
+    @property
+    def is_active(self) -> bool:
+        return self._handle is not None
+
+
+class AsyncDebounce:
+    """Coalescing with bounded staleness (ref AsyncDebounce.h:25): the
+    first call arms a fire `min_s` out; calls while armed coalesce and do
+    NOT postpone the pending fire (a sustained storm still fires every
+    window). Each back-to-back fire doubles the window up to `max_s`;
+    a quiet period of >= `max_s` resets it to `min_s`. This is what batches
+    SPF runs under link-flap churn without starving them."""
+
+    def __init__(self, min_s: float, max_s: float, callback: Callable[[], Any]):
+        assert min_s <= max_s
+        self.min_s = min_s
+        self.max_s = max_s
+        self._callback = callback
+        self._handle: Optional[asyncio.TimerHandle] = None
+        self._current = min_s
+        self._last_fire_ts = 0.0
+
+    def __call__(self) -> None:
+        if self._handle is not None:
+            return  # armed: coalesce, never postpone
+        loop = asyncio.get_running_loop()
+        now = loop.time()
+        if now - self._last_fire_ts >= self.max_s:
+            self._current = self.min_s  # quiet period: reset window
+        self._handle = loop.call_later(self._current, self._fire)
+
+    def _fire(self) -> None:
+        self._handle = None
+        self._last_fire_ts = asyncio.get_running_loop().time()
+        # sustained churn: next window doubles (reset happens on quiet call)
+        self._current = min(self._current * 2, self.max_s)
+        res = self._callback()
+        if asyncio.iscoroutine(res):
+            asyncio.ensure_future(res)
+
+    def cancel(self) -> None:
+        if self._handle is not None:
+            self._handle.cancel()
+            self._handle = None
+
+    @property
+    def is_active(self) -> bool:
+        return self._handle is not None
+
+
+class ExponentialBackoff:
+    """Error backoff with doubling retry window
+    (ref openr/common/ExponentialBackoff.{h,cpp})."""
+
+    def __init__(self, initial_s: float, max_s: float):
+        self.initial_s = initial_s
+        self.max_s = max_s
+        self._current = 0.0
+        self._last_error_ts = 0.0
+
+    def report_success(self) -> None:
+        self._current = 0.0
+
+    def report_error(self) -> None:
+        self._current = (
+            self.initial_s if self._current == 0 else min(self._current * 2, self.max_s)
+        )
+        self._last_error_ts = time.monotonic()
+
+    def can_try_now(self) -> bool:
+        return self.time_until_retry_s() <= 0
+
+    def time_until_retry_s(self) -> float:
+        if self._current == 0:
+            return 0.0
+        return max(0.0, self._last_error_ts + self._current - time.monotonic())
+
+    @property
+    def has_error(self) -> bool:
+        return self._current > 0

@@ -1,0 +1,124 @@
+"""Wire codec for openr_tpu message types.
+
+Role of the thrift (de)serializers in the reference (openr/if/*.thrift +
+fbthrift BinarySerializer). We re-express the schema as Python dataclasses
+(types.py) and serialize them with a schema-driven JSON codec: compact,
+versionable (unknown fields ignored on decode, defaults fill missing
+fields), and debuggable. Hot-path payloads (CSR deltas) bypass this and use
+raw numpy buffers; see ops/csr.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import typing
+from typing import Any, Optional, Type, TypeVar, Union
+
+T = TypeVar("T")
+
+_HINT_CACHE: dict[type, dict[str, Any]] = {}
+
+
+def _type_hints(cls: type) -> dict[str, Any]:
+    hints = _HINT_CACHE.get(cls)
+    if hints is None:
+        import sys
+
+        mod_globals = vars(sys.modules.get(cls.__module__, typing))
+        hints = typing.get_type_hints(cls, mod_globals)
+        _HINT_CACHE[cls] = hints
+    return hints
+
+
+def to_plain(obj: Any) -> Any:
+    """Dataclass tree -> JSON-able plain value."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, enum.Enum):
+        return int(obj.value)
+    if isinstance(obj, bytes):
+        return {"__bytes__": obj.hex()}
+    if dataclasses.is_dataclass(obj):
+        return {
+            f.name: to_plain(getattr(obj, f.name))
+            for f in dataclasses.fields(obj)
+        }
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        return [to_plain(v) for v in obj]
+    if isinstance(obj, dict):
+        return {str(k): to_plain(v) for k, v in obj.items()}
+    raise TypeError(f"cannot serialize {type(obj)!r}")
+
+
+def _strip_optional(tp: Any) -> Any:
+    origin = typing.get_origin(tp)
+    if origin is Union or origin is getattr(typing, "UnionType", None):
+        args = [a for a in typing.get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    import types as _pytypes
+
+    if origin is _pytypes.UnionType:  # X | None syntax
+        args = [a for a in typing.get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
+def from_plain(value: Any, tp: Any) -> Any:
+    """Plain value -> typed object per annotation `tp`."""
+    if value is None:
+        return None
+    tp = _strip_optional(tp)
+    if isinstance(tp, str):  # unresolved forward ref; leave as-is
+        return value
+    origin = typing.get_origin(tp)
+    if origin in (list, set, frozenset):
+        (elem_tp,) = typing.get_args(tp) or (Any,)
+        seq = [from_plain(v, elem_tp) for v in value]
+        return origin(seq) if origin is not list else seq
+    if origin is tuple:
+        args = typing.get_args(tp)
+        if len(args) == 2 and args[1] is Ellipsis:
+            return tuple(from_plain(v, args[0]) for v in value)
+        return tuple(from_plain(v, a) for v, a in zip(value, args))
+    if origin is dict:
+        kt, vt = typing.get_args(tp) or (Any, Any)
+        out = {}
+        for k, v in value.items():
+            key = int(k) if kt is int else k
+            out[key] = from_plain(v, vt)
+        return out
+    if tp is bytes or (isinstance(value, dict) and "__bytes__" in value):
+        if isinstance(value, dict):
+            return bytes.fromhex(value["__bytes__"])
+        return value
+    if isinstance(tp, type) and issubclass(tp, enum.Enum):
+        return tp(value)
+    if dataclasses.is_dataclass(tp):
+        hints = _type_hints(tp)
+        kwargs = {}
+        for f in dataclasses.fields(tp):
+            if f.name in value:
+                kwargs[f.name] = from_plain(value[f.name], hints[f.name])
+            # missing fields fall back to dataclass defaults (forward compat)
+        return tp(**kwargs)
+    if tp in (int, float, str, bool):
+        return tp(value)
+    return value
+
+
+def serialize(obj: Any) -> bytes:
+    return json.dumps(to_plain(obj), separators=(",", ":")).encode()
+
+
+def deserialize(data: bytes, cls: Type[T]) -> T:
+    return from_plain(json.loads(data), cls)
+
+
+# Convenience wrappers for the two LSDB payload types --------------------
+
+def dumps_json(obj: Any, indent: Optional[int] = None) -> str:
+    return json.dumps(to_plain(obj), indent=indent, sort_keys=indent is not None)

@@ -1,0 +1,506 @@
+"""Core message vocabulary for openr_tpu.
+
+Re-expression (not a translation) of the reference wire/IPC schema:
+  - adjacency / prefix link-state types: /root/reference/openr/if/Types.thrift
+    (Adjacency:98, AdjacencyDatabase:175, PrefixEntry:380, PrefixDatabase:461)
+  - kvstore types: /root/reference/openr/if/KvStore.thrift (Value:177,
+    Publication:532)
+  - spark messages: Types.thrift:821-1003
+  - inter-module strong types: openr/common/Types.h, openr/common/LsdbTypes.h
+  - perf events: Types.thrift:53-75
+
+Dataclasses here are the single source of truth; serde.py provides the wire
+codec; decision/rib.py holds the RIB value types.
+"""
+
+from __future__ import annotations
+
+import enum
+import ipaddress
+import time
+from dataclasses import dataclass, field, replace  # noqa: F401  (replace re-exported)
+from typing import Optional
+
+
+# ---------------------------------------------------------------------------
+# Network primitives
+# ---------------------------------------------------------------------------
+
+def parse_prefix(s: str) -> ipaddress._BaseNetwork:
+    return ipaddress.ip_network(s, strict=False)
+
+
+# ---------------------------------------------------------------------------
+# Link-state types (ref Types.thrift)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Adjacency:
+    """One directed adjacency advertisement (ref Types.thrift:98-173)."""
+
+    other_node_name: str
+    if_name: str
+    other_if_name: str = ""
+    metric: int = 1
+    adj_label: int = 0
+    is_overloaded: bool = False
+    rtt_us: int = 0
+    timestamp_s: int = 0
+    weight: int = 1  # UCMP weight of this adj (ref Types.thrift:158)
+    # Two-stage cold-boot insertion: adjacency only usable by the *other*
+    # node until the restarting node has programmed routes
+    # (ref Types.thrift:166, Decision.cpp:567-644).
+    adj_only_used_by_other_node: bool = False
+
+
+@dataclass(frozen=True)
+class AdjacencyDatabase:
+    """All adjacencies of one node in one area (ref Types.thrift:175-221)."""
+
+    this_node_name: str
+    adjacencies: tuple[Adjacency, ...] = ()
+    is_overloaded: bool = False  # node drained: no transit traffic
+    node_label: int = 0  # segment-routing node label
+    area: str = "0"
+    # Distinguish a node that is up with no adjacencies from a withdrawal.
+    node_metric_increment: int = 0  # soft-drain metric penalty (ref :216)
+
+
+class PrefixForwardingType(enum.IntEnum):
+    """ref Types.thrift:18-27 (OpenrConfig.thrift PrefixForwardingType)."""
+
+    IP = 0
+    SR_MPLS = 1
+
+
+class PrefixForwardingAlgorithm(enum.IntEnum):
+    """ref OpenrConfig.thrift:25 — per-prefix route computation algorithm."""
+
+    SP_ECMP = 0
+    KSP2_ED_ECMP = 1
+    SP_UCMP_ADJ_WEIGHT_PROPAGATION = 3
+    SP_UCMP_PREFIX_WEIGHT_PROPAGATION = 4
+
+
+class PrefixType(enum.IntEnum):
+    """Origin of a prefix advertisement (ref Network.thrift PrefixType)."""
+
+    LOOPBACK = 1
+    DEFAULT = 2
+    BGP = 3
+    PREFIX_ALLOCATOR = 4
+    BREEZE = 5
+    CONFIG = 6
+    VIP = 7
+    RIB = 8
+
+
+@dataclass(frozen=True)
+class PrefixMetrics:
+    """Ranked route-selection metrics, higher wins except distance
+    (ref Types.thrift:239-286, compared in SpfSolver.cpp:648-769)."""
+
+    path_preference: int = 1000
+    source_preference: int = 100
+    # distance is igp metric to the announcer, computed not advertised
+    drain_metric: int = 0  # advertised by soft-drained nodes, lower wins
+
+
+@dataclass(frozen=True)
+class PrefixEntry:
+    """One prefix advertisement by one node (ref Types.thrift:380-459)."""
+
+    prefix: str  # canonical CIDR string
+    type: PrefixType = PrefixType.LOOPBACK
+    metrics: PrefixMetrics = field(default_factory=PrefixMetrics)
+    forwarding_type: PrefixForwardingType = PrefixForwardingType.IP
+    forwarding_algorithm: PrefixForwardingAlgorithm = (
+        PrefixForwardingAlgorithm.SP_ECMP
+    )
+    min_nexthop: Optional[int] = None  # drop route if fewer NHs (ref :422)
+    prepend_label: Optional[int] = None  # extra MPLS label to push (ref :432)
+    weight: Optional[int] = None  # UCMP prefix weight (ref :457)
+    tags: tuple[str, ...] = ()
+    area_stack: tuple[str, ...] = ()
+
+    def network(self):
+        return parse_prefix(self.prefix)
+
+
+@dataclass(frozen=True)
+class PrefixDatabase:
+    """All prefixes of one node in one area (ref Types.thrift:461-480).
+
+    The reference advertises per-prefix keys (`prefix:<node>:<area>:<pfx>`,
+    LsdbTypes.h:411 PrefixKey); each such key carries a PrefixDatabase with a
+    single entry and the deletePrefix tombstone flag.
+    """
+
+    this_node_name: str
+    prefix_entries: tuple[PrefixEntry, ...] = ()
+    area: str = "0"
+    delete_prefix: bool = False
+
+
+# ---------------------------------------------------------------------------
+# KvStore types (ref KvStore.thrift)
+# ---------------------------------------------------------------------------
+
+TTL_INFINITY = -1  # ref KvStore.thrift Consts
+
+
+@dataclass
+class Value:
+    """Versioned CRDT value (ref KvStore.thrift:177-214).
+
+    Merge order: version desc, then originator_id desc, then value bytes
+    desc; ttl_version refreshes TTL without data change
+    (ref KvStoreUtil.cpp:42-249).
+    """
+
+    version: int
+    originator_id: str
+    value: Optional[bytes] = None  # None => hash-only advertisement
+    ttl_ms: int = TTL_INFINITY
+    ttl_version: int = 0
+    hash: Optional[int] = None
+
+    def __post_init__(self):
+        if self.hash is None and self.value is not None:
+            self.hash = compute_hash(self.version, self.originator_id, self.value)
+
+
+def compute_hash(version: int, originator_id: str, value: Optional[bytes]) -> int:
+    """Deterministic content hash (role of generateHash, LsdbUtil)."""
+    import zlib
+
+    h = zlib.crc32(str(version).encode())
+    h = zlib.crc32(originator_id.encode(), h)
+    if value is not None:
+        h = zlib.crc32(value, h)
+    return h
+
+
+@dataclass
+class Publication:
+    """A batch of changed key/values flooded between stores
+    (ref KvStore.thrift:532-560)."""
+
+    key_vals: dict[str, Value] = field(default_factory=dict)
+    expired_keys: list[str] = field(default_factory=list)
+    # Loop suppression: path of node-ids this publication traversed
+    # (ref KvStore.cpp:3155-3290).
+    node_ids: list[str] = field(default_factory=list)
+    # Keys the sender has a newer hash for than us (full-sync delta request).
+    to_be_updated_keys: list[str] = field(default_factory=list)
+    area: str = "0"
+
+    def empty(self) -> bool:
+        return not self.key_vals and not self.expired_keys
+
+
+class FilterOperator(enum.IntEnum):
+    OR = 1
+    AND = 2
+
+
+@dataclass
+class KeyDumpParams:
+    """Filtered dump request (ref KvStore.thrift:287-320)."""
+
+    keys: list[str] = field(default_factory=list)  # prefix match terms
+    originator_ids: list[str] = field(default_factory=list)
+    operator: FilterOperator = FilterOperator.OR
+    ignore_ttl: bool = False
+    do_not_publish_value: bool = False
+    # sender's key->(version, originatorId, hash) map for delta sync
+    key_val_hashes: Optional[dict[str, Value]] = None
+
+
+class KvStorePeerState(enum.IntEnum):
+    """Peer sync FSM (ref KvStore.thrift:375, getNextState KvStore.cpp:981)."""
+
+    IDLE = 0
+    SYNCING = 1
+    INITIALIZED = 2
+
+
+@dataclass(frozen=True)
+class PeerSpec:
+    """How to reach a peer's kvstore (ref KvStore.thrift PeerSpec)."""
+
+    peer_addr: str
+    ctrl_port: int = 0
+    state: KvStorePeerState = KvStorePeerState.IDLE
+
+
+# ---------------------------------------------------------------------------
+# Spark messages (ref Types.thrift:821-1003)
+# ---------------------------------------------------------------------------
+
+class SparkNeighState(enum.IntEnum):
+    """Neighbor FSM states (ref Types.thrift:29, table Spark.h:463)."""
+
+    IDLE = 0
+    WARM = 1
+    NEGOTIATE = 2
+    ESTABLISHED = 3
+    RESTART = 4
+
+
+@dataclass(frozen=True)
+class SparkNeighbor:
+    node_name: str
+    domain_name: str = ""
+    hold_time_ms: int = 0
+    transport_address_v6: str = ""
+    transport_address_v4: str = ""
+    openr_ctrl_port: int = 0
+
+
+@dataclass
+class SparkHelloMsg:
+    """Periodic multicast hello carrying the seen-neighbor map for the
+    2-way connectivity check (ref Types.thrift:821-888)."""
+
+    domain_name: str
+    node_name: str
+    if_name: str
+    seq_num: int
+    # neighbor name -> ReflectedNeighborInfo(last seq & timestamps we saw)
+    neighbor_infos: dict[str, "ReflectedNeighborInfo"] = field(default_factory=dict)
+    version: int = 1
+    solicit_response: bool = False  # fast-init: ask for immediate reply
+    restarting: bool = False  # graceful-restart signal
+    sent_ts_us: int = 0
+
+
+@dataclass(frozen=True)
+class ReflectedNeighborInfo:
+    seq_num: int = 0
+    last_nbr_msg_sent_ts_us: int = 0
+    last_my_msg_rcvd_ts_us: int = 0
+
+
+@dataclass
+class SparkHandshakeMsg:
+    """Unicast negotiation after 2-way check (ref Types.thrift:917-960)."""
+
+    node_name: str
+    is_adj_established: bool = False
+    hold_time_ms: int = 0
+    gr_hold_time_ms: int = 0
+    transport_address_v6: str = ""
+    transport_address_v4: str = ""
+    openr_ctrl_port: int = 0
+    area: str = ""  # negotiated area
+    neighbor_node_name: str = ""  # directed handshake target
+
+
+@dataclass
+class SparkHeartbeatMsg:
+    """Cheap liveness keepalive once ESTABLISHED (ref Types.thrift:890-905)."""
+
+    node_name: str
+    seq_num: int
+    hold_up_adjacency: bool = False
+
+
+@dataclass
+class SparkPacket:
+    """Top-level datagram: exactly one of the three messages."""
+
+    hello: Optional[SparkHelloMsg] = None
+    handshake: Optional[SparkHandshakeMsg] = None
+    heartbeat: Optional[SparkHeartbeatMsg] = None
+
+
+# ---------------------------------------------------------------------------
+# Inter-module events (ref openr/common/Types.h, LsdbTypes.h)
+# ---------------------------------------------------------------------------
+
+class NeighborEventType(enum.IntEnum):
+    """ref LsdbTypes.h:76."""
+
+    NEIGHBOR_UP = 1
+    NEIGHBOR_DOWN = 2
+    NEIGHBOR_RESTARTED = 3
+    NEIGHBOR_RTT_CHANGE = 4
+    NEIGHBOR_RESTARTING = 5
+    NEIGHBOR_ADJ_SYNCED = 6
+
+
+@dataclass(frozen=True)
+class NeighborEvent:
+    """Spark -> LinkMonitor (ref LsdbTypes.h:76-160)."""
+
+    event_type: NeighborEventType
+    node_name: str
+    if_name: str
+    area: str
+    neighbor_addr_v6: str = ""
+    neighbor_addr_v4: str = ""
+    ctrl_port: int = 0
+    kvstore_port: int = 0
+    rtt_us: int = 0
+    adj_only_used_by_other_node: bool = False
+
+
+@dataclass(frozen=True)
+class NeighborInitEvent:
+    """Batched initial neighbor discovery completion signal
+    (ref LsdbTypes.h:161)."""
+
+    events: tuple[NeighborEvent, ...] = ()
+    init_complete: bool = False
+
+
+class PrefixEventType(enum.IntEnum):
+    ADD_PREFIXES = 1
+    WITHDRAW_PREFIXES = 2
+    WITHDRAW_PREFIXES_BY_TYPE = 3
+    SYNC_PREFIXES_BY_TYPE = 4
+
+
+@dataclass
+class PrefixEvent:
+    """Plugin/CLI/LinkMonitor -> PrefixManager (ref LsdbTypes.h:275)."""
+
+    event_type: PrefixEventType
+    type: PrefixType
+    prefixes: list[PrefixEntry] = field(default_factory=list)
+    dest_areas: tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class AreaPeerEvent:
+    """LinkMonitor -> KvStore peer add/del for one area
+    (ref openr/common/Types.h:49-71)."""
+
+    peers_to_add: dict[str, PeerSpec] = field(default_factory=dict)
+    peers_to_del: tuple[str, ...] = ()
+
+
+# PeerEvent = area -> AreaPeerEvent
+PeerEvent = dict
+
+
+class KeyValueRequestType(enum.IntEnum):
+    PERSIST = 1  # advertise + keep refreshed + version-bump-to-win
+    SET = 2  # one-shot set
+    CLEAR = 3  # unset/erase self-originated key
+
+
+@dataclass
+class KeyValueRequest:
+    """Module -> KvStore self-originated key op
+    (ref openr/common/Types.h:228)."""
+
+    request_type: KeyValueRequestType
+    area: str
+    key: str
+    value: Optional[bytes] = None
+    version: Optional[int] = None
+    set_ttl: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class KvStoreSyncEvent:
+    """KvStore -> LinkMonitor: initial sync with peer finished
+    (ref openr/common/Types.h:237)."""
+
+    node_name: str
+    area: str
+
+
+class InitializationEvent(enum.IntEnum):
+    """Cold-boot convergence milestones
+    (ref Types.thrift InitializationEvent, docs/Protocol_Guide/Initialization)."""
+
+    INITIALIZING = 0
+    AGENT_CONFIGURED = 1
+    LINK_DISCOVERED = 2
+    NEIGHBOR_DISCOVERED = 3
+    KVSTORE_SYNCED = 4
+    RIB_COMPUTED = 5
+    FIB_SYNCED = 6
+    PREFIX_DB_SYNCED = 7
+    INITIALIZED = 8
+
+
+@dataclass(frozen=True)
+class InterfaceInfo:
+    """One system interface snapshot (ref LsdbTypes.h:313-400)."""
+
+    if_name: str
+    is_up: bool
+    if_index: int = 0
+    networks: tuple[str, ...] = ()  # CIDR strings
+
+
+@dataclass(frozen=True)
+class InterfaceDatabase:
+    """LinkMonitor -> Spark interface snapshot (ref LsdbTypes.h:403)."""
+
+    interfaces: tuple[InterfaceInfo, ...] = ()
+
+
+# ---------------------------------------------------------------------------
+# Perf events (ref Types.thrift:53-75, LsdbUtil.h:29-43)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class PerfEvent:
+    node_name: str
+    event_descr: str
+    unix_ts_ms: int
+
+
+@dataclass
+class PerfEvents:
+    events: list[PerfEvent] = field(default_factory=list)
+
+
+def add_perf_event(perf: PerfEvents, node: str, descr: str) -> None:
+    perf.events.append(PerfEvent(node, descr, int(time.time() * 1000)))
+
+
+def total_perf_duration_ms(perf: PerfEvents) -> int:
+    if len(perf.events) < 2:
+        return 0
+    return perf.events[-1].unix_ts_ms - perf.events[0].unix_ts_ms
+
+
+# ---------------------------------------------------------------------------
+# KvStore key naming (ref LsdbTypes.h:411 PrefixKey, Constants)
+# ---------------------------------------------------------------------------
+
+ADJ_DB_MARKER = "adj:"
+PREFIX_DB_MARKER = "prefix:"
+
+
+def adj_key(node: str) -> str:
+    return f"{ADJ_DB_MARKER}{node}"
+
+
+def prefix_key(node: str, area: str, prefix: str) -> str:
+    return f"{PREFIX_DB_MARKER}{node}:[{area}]:{prefix}"
+
+
+def parse_adj_key(key: str) -> Optional[str]:
+    if key.startswith(ADJ_DB_MARKER):
+        return key[len(ADJ_DB_MARKER):]
+    return None
+
+
+def parse_prefix_key(key: str) -> Optional[tuple[str, str, str]]:
+    """-> (node, area, prefix) or None."""
+    if not key.startswith(PREFIX_DB_MARKER):
+        return None
+    rest = key[len(PREFIX_DB_MARKER):]
+    try:
+        node, rest = rest.split(":[", 1)
+        area, prefix = rest.split("]:", 1)
+    except ValueError:
+        return None
+    return node, area, prefix

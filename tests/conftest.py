@@ -1,0 +1,28 @@
+"""Test bootstrap.
+
+Tests run JAX on CPU with 8 virtual devices so multi-chip sharding
+(openr_tpu/parallel) is exercised without TPU hardware; the driver's bench
+run uses the real chip. This must happen before jax is imported anywhere.
+"""
+
+import asyncio
+import functools
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+
+def run_async(fn):
+    """Decorator: run an async test in a fresh event loop
+    (no pytest-asyncio in the image)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        return asyncio.run(asyncio.wait_for(fn(*args, **kwargs), timeout=60))
+
+    return wrapper

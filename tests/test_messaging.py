@@ -1,0 +1,90 @@
+"""Queue fabric tests (semantics of ref openr/messaging/tests)."""
+
+import asyncio
+
+import pytest
+
+from openr_tpu.messaging import QueueClosedError, ReplicateQueue
+from tests.conftest import run_async
+
+
+@run_async
+async def test_fanout_every_reader_sees_every_write():
+    q = ReplicateQueue(name="test")
+    r1 = q.get_reader()
+    r2 = q.get_reader()
+    q.push(1)
+    q.push(2)
+    assert await r1.get() == 1
+    assert await r2.get() == 1
+    assert await r1.get() == 2
+    assert await r2.get() == 2
+    assert q.num_writes == 2
+
+
+@run_async
+async def test_blocking_get_wakes_on_push():
+    q = ReplicateQueue()
+    r = q.get_reader()
+
+    async def producer():
+        await asyncio.sleep(0.01)
+        q.push("x")
+
+    task = asyncio.ensure_future(producer())
+    assert await r.get() == "x"
+    await task
+
+
+@run_async
+async def test_close_unblocks_with_queue_closed():
+    q = ReplicateQueue()
+    r = q.get_reader()
+
+    async def reader():
+        with pytest.raises(QueueClosedError):
+            await r.get()
+
+    task = asyncio.ensure_future(reader())
+    await asyncio.sleep(0.01)
+    q.close()
+    await task
+    with pytest.raises(QueueClosedError):
+        q.push(1)
+
+
+@run_async
+async def test_close_drains_buffered_items_first():
+    q = ReplicateQueue()
+    r = q.get_reader()
+    q.push(1)
+    q.close()
+    assert await r.get() == 1
+    with pytest.raises(QueueClosedError):
+        await r.get()
+
+
+@run_async
+async def test_late_reader_misses_earlier_writes():
+    q = ReplicateQueue()
+    r1 = q.get_reader()
+    q.push(1)
+    r2 = q.get_reader()
+    q.push(2)
+    assert await r1.get() == 1
+    assert await r2.get() == 2  # r2 only sees writes after creation
+    assert r1.size() == 1
+
+
+@run_async
+async def test_try_get_and_stats():
+    q = ReplicateQueue(name="stats")
+    r = q.get_reader("rd")
+    ok, item = r.try_get()
+    assert not ok and item is None
+    q.push(7)
+    ok, item = r.try_get()
+    assert ok and item == 7
+    s = q.stats()
+    assert s["writes"] == 1
+    assert s["readers"][0]["reads"] == 1

@@ -1,0 +1,100 @@
+"""Schema + codec round-trip tests (role of thrift serializer tests)."""
+
+from openr_tpu import serde, types
+
+
+def test_adjacency_db_roundtrip():
+    db = types.AdjacencyDatabase(
+        this_node_name="node1",
+        adjacencies=(
+            types.Adjacency("node2", "if_1_2", "if_2_1", metric=10, rtt_us=1200),
+            types.Adjacency(
+                "node3", "if_1_3", metric=5, adj_only_used_by_other_node=True
+            ),
+        ),
+        is_overloaded=True,
+        node_label=101,
+        area="area1",
+    )
+    assert serde.deserialize(serde.serialize(db), types.AdjacencyDatabase) == db
+
+
+def test_prefix_db_roundtrip():
+    db = types.PrefixDatabase(
+        this_node_name="node1",
+        prefix_entries=(
+            types.PrefixEntry(
+                prefix="10.1.0.0/16",
+                type=types.PrefixType.BGP,
+                metrics=types.PrefixMetrics(path_preference=2000),
+                forwarding_type=types.PrefixForwardingType.SR_MPLS,
+                forwarding_algorithm=types.PrefixForwardingAlgorithm.KSP2_ED_ECMP,
+                min_nexthop=2,
+                tags=("tag1",),
+            ),
+        ),
+        delete_prefix=False,
+    )
+    out = serde.deserialize(serde.serialize(db), types.PrefixDatabase)
+    assert out == db
+    assert out.prefix_entries[0].forwarding_algorithm is (
+        types.PrefixForwardingAlgorithm.KSP2_ED_ECMP
+    )
+
+
+def test_kvstore_value_hash_auto():
+    v = types.Value(version=3, originator_id="n1", value=b"payload", ttl_ms=5000)
+    assert v.hash is not None
+    v2 = types.Value(version=3, originator_id="n1", value=b"payload")
+    assert v.hash == v2.hash
+    v3 = types.Value(version=4, originator_id="n1", value=b"payload")
+    assert v.hash != v3.hash
+
+
+def test_publication_roundtrip():
+    pub = types.Publication(
+        key_vals={"adj:n1": types.Value(1, "n1", b"x", ttl_ms=100)},
+        expired_keys=["prefix:old"],
+        node_ids=["n1", "n2"],
+        area="0",
+    )
+    out = serde.deserialize(serde.serialize(pub), types.Publication)
+    assert out.key_vals["adj:n1"].value == b"x"
+    assert out.node_ids == ["n1", "n2"]
+
+
+def test_forward_compat_unknown_and_missing_fields():
+    import json
+
+    plain = serde.to_plain(types.Adjacency("n2", "if1"))
+    plain["brand_new_field"] = 42  # unknown field ignored
+    del plain["weight"]  # missing field -> default
+    adj = serde.from_plain(plain, types.Adjacency)
+    assert adj.other_node_name == "n2"
+    assert adj.weight == 1
+    json.dumps(plain)
+
+
+def test_key_naming():
+    assert types.adj_key("node-1") == "adj:node-1"
+    assert types.parse_adj_key("adj:node-1") == "node-1"
+    assert types.parse_adj_key("prefix:x") is None
+    k = types.prefix_key("node-1", "area0", "10.0.0.0/24")
+    assert types.parse_prefix_key(k) == ("node-1", "area0", "10.0.0.0/24")
+    assert types.parse_prefix_key("garbage") is None
+
+
+def test_spark_packet_roundtrip():
+    pkt = types.SparkPacket(
+        hello=types.SparkHelloMsg(
+            domain_name="d",
+            node_name="n1",
+            if_name="eth0",
+            seq_num=7,
+            neighbor_infos={"n2": types.ReflectedNeighborInfo(seq_num=3)},
+            solicit_response=True,
+        )
+    )
+    out = serde.deserialize(serde.serialize(pkt), types.SparkPacket)
+    assert out.hello.neighbor_infos["n2"].seq_num == 3
+    assert out.handshake is None
