@@ -269,6 +269,14 @@ class Config:
         ids = [a.area_id for a in cfg.areas]
         if len(ids) != len(set(ids)):
             raise ConfigError("duplicate area ids")
+        for area_id in ids:
+            # area ids embed into kvstore keys "prefix:<node>:[<area>]:<pfx>";
+            # forbid the delimiter characters so key encode/parse stay inverse
+            if not area_id or any(c in area_id for c in " :[]"):
+                raise ConfigError(
+                    f"area id {area_id!r} must be non-empty and must not "
+                    "contain ' ', ':', '[', ']'"
+                )
         sc = cfg.spark_config
         if sc.hold_time_s < sc.keepalive_time_s:
             raise ConfigError("spark hold_time must be >= keepalive_time")

@@ -20,6 +20,7 @@ import time
 from typing import Any, Awaitable, Callable, Coroutine, Optional
 
 from openr_tpu.messaging import QueueClosedError
+from openr_tpu.runtime.tasks import spawn_logged
 
 log = logging.getLogger(__name__)
 
@@ -41,7 +42,7 @@ class Timer:
         self._handle = None
         res = self._callback()
         if asyncio.iscoroutine(res):
-            asyncio.ensure_future(res)
+            spawn_logged(res, name=f"{type(self).__name__}.callback")
 
     def cancel(self) -> None:
         if self._handle is not None:

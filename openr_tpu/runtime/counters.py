@@ -19,7 +19,7 @@ class _Stat:
     __slots__ = ("samples",)
 
     def __init__(self):
-        # (ts, value) ring; 600s retention
+        # (ts, value) ring: 4096 most-recent samples; windowed() filters by age
         self.samples: collections.deque = collections.deque(maxlen=4096)
 
     def add(self, value: float) -> None:

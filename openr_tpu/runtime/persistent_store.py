@@ -9,6 +9,13 @@ survive process restart (SURVEY §5 checkpoint/resume).
 Format: little-endian records  [1B op][4B klen][4B vlen][key][value].
 A snapshot is the same format written from scratch to a temp file and
 atomically renamed.
+
+Durability contract: every record is flushed to the OS (surviving process crash)
+but fsynced only on snapshot/close — a power loss may drop the most recent
+writes. That matches the data stored here (drain state, allocator index):
+losing the last write degrades to a re-negotiation, never corruption. A
+truncated tail record left by a crash is dropped AND truncated from the file
+on recovery so subsequent appends stay parseable.
 """
 
 from __future__ import annotations
@@ -82,6 +89,7 @@ class PersistentStore:
             blob = fh.read()
         off = 0
         n = 0
+        valid_off = 0  # byte offset of the end of the last complete record
         while off + _HDR.size <= len(blob):
             op, klen, vlen = _HDR.unpack_from(blob, off)
             off += _HDR.size
@@ -92,11 +100,19 @@ class PersistentStore:
             value = blob[off : off + vlen]
             off += vlen
             n += 1
+            valid_off = off
             if op == _OP_ADD:
                 self._data[key] = value
             elif op == _OP_DEL:
                 self._data.pop(key, None)
         self._log_records = n
+        if valid_off < len(blob):
+            # Crash left a partial record at the tail. Truncate it away so
+            # the append log stays parseable; otherwise every record written
+            # after recovery lands beyond the garbage and is lost on the
+            # next restart.
+            with open(self.path, "r+b") as fh:
+                fh.truncate(valid_off)
 
     def _open_log(self) -> None:
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)

@@ -1,0 +1,37 @@
+"""Fire-and-forget task spawning with strong references + error logging.
+
+asyncio's event loop keeps only weak references to tasks, so a task spawned
+with bare ensure_future can be garbage-collected mid-execution and its
+exception surfaces only as "Task exception was never retrieved". Timer and
+throttle callbacks route through spawn_logged() instead: the module-level
+set retains the task until completion and a done-callback logs failures
+with the owning component's name.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Coroutine
+
+log = logging.getLogger("openr_tpu.runtime")
+
+_live_tasks: set[asyncio.Task] = set()
+
+
+def spawn_logged(coro: Coroutine[Any, Any, Any], name: str = "") -> asyncio.Task:
+    task = asyncio.ensure_future(coro)
+    if name:
+        task.set_name(name)
+    _live_tasks.add(task)
+
+    def _done(t: asyncio.Task) -> None:
+        _live_tasks.discard(t)
+        if t.cancelled():
+            return
+        exc = t.exception()
+        if exc is not None:
+            log.error("task %s crashed", t.get_name(), exc_info=exc)
+
+    task.add_done_callback(_done)
+    return task

@@ -53,14 +53,11 @@ def to_plain(obj: Any) -> Any:
 
 
 def _strip_optional(tp: Any) -> Any:
-    origin = typing.get_origin(tp)
-    if origin is Union or origin is getattr(typing, "UnionType", None):
-        args = [a for a in typing.get_args(tp) if a is not type(None)]
-        if len(args) == 1:
-            return args[0]
     import types as _pytypes
 
-    if origin is _pytypes.UnionType:  # X | None syntax
+    origin = typing.get_origin(tp)
+    # typing.Optional[X]/Union[X, None] and the X | None syntax
+    if origin is Union or origin is _pytypes.UnionType:
         args = [a for a in typing.get_args(tp) if a is not type(None)]
         if len(args) == 1:
             return args[0]

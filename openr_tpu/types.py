@@ -171,14 +171,22 @@ class Value:
 
 
 def compute_hash(version: int, originator_id: str, value: Optional[bytes]) -> int:
-    """Deterministic content hash (role of generateHash, LsdbUtil)."""
-    import zlib
+    """Deterministic 64-bit content hash (role of generateHash, LsdbUtil).
 
-    h = zlib.crc32(str(version).encode())
-    h = zlib.crc32(originator_id.encode(), h)
+    The hash drives full-sync delta detection: a collision silently skips a
+    key during sync, so at 100k-key scale a 32-bit hash's birthday bound
+    (~2^16 keys) is not acceptable — we use 64 bits.
+    """
+    import hashlib
+
+    h = hashlib.blake2b(digest_size=8)
+    h.update(str(version).encode())
+    h.update(b"\x00")
+    h.update(originator_id.encode())
+    h.update(b"\x00")
     if value is not None:
-        h = zlib.crc32(value, h)
-    return h
+        h.update(value)
+    return int.from_bytes(h.digest(), "little")
 
 
 @dataclass

@@ -69,6 +69,30 @@ async def test_debounce_bounded_staleness_under_storm():
     assert len(fired) == n + 1
 
 
+@run_async
+async def test_debounce_postpones_like_reference():
+    # Reference contract (AsyncDebounce.h:44-52): every call below max
+    # backoff RESCHEDULES the pending fire with a doubled window; calls at
+    # max backoff leave it alone.
+    fired = []
+    db = AsyncDebounce(0.02, 0.08, lambda: fired.append(1))
+    db()  # scheduled +0.02
+    await asyncio.sleep(0.015)
+    db()  # rescheduled +0.04 from now — the original +0.02 must NOT fire
+    await asyncio.sleep(0.015)  # t=0.03 > first deadline
+    assert fired == []  # postponed
+    await asyncio.sleep(0.04)
+    assert fired == [1]
+    # cancel resets backoff: next call starts again at min
+    db()
+    db.cancel()
+    await asyncio.sleep(0.1)
+    assert fired == [1]
+    db()
+    await asyncio.sleep(0.03)
+    assert fired == [1, 1]
+
+
 def test_exponential_backoff():
     bo = ExponentialBackoff(0.1, 0.4)
     assert bo.can_try_now()
@@ -110,6 +134,26 @@ def test_persistent_store_compaction_and_truncated_tail(tmp_path):
     ps2 = PersistentStore(path)
     assert ps2.load("key") == b"x" * 599
     ps2.close()
+
+
+def test_persistent_store_writes_after_crash_recovery_survive(tmp_path):
+    # Regression for ADVICE r1 high: recovery must truncate the partial
+    # tail record, else appends after recovery land beyond garbage bytes
+    # and are lost on the next restart.
+    path = str(tmp_path / "store.bin")
+    ps = PersistentStore(path)
+    ps.store("k1", b"v1")
+    ps.close()
+    with open(path, "ab") as fh:
+        fh.write(b"\x01\x03\x00")  # partial header (crash mid-write)
+    ps2 = PersistentStore(path)
+    assert ps2.load("k1") == b"v1"
+    ps2.store("k2", b"v2")  # written after recovery
+    ps2.close()
+    ps3 = PersistentStore(path)
+    assert ps3.load("k1") == b"v1"
+    assert ps3.load("k2") == b"v2"
+    ps3.close()
 
 
 def test_persistent_store_objects(tmp_path):
