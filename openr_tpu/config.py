@@ -258,33 +258,39 @@ class Config:
 
     # validation ---------------------------------------------------------
 
+    @staticmethod
+    def _validate_key_component(value: str, what: str) -> None:
+        # node/area ids embed into kvstore keys "prefix:<node>:[<area>]:<pfx>"
+        # (types.py prefix_key); forbid the delimiter characters so key
+        # encode/parse stay inverses
+        if not value or any(c in value for c in " :[]"):
+            raise ConfigError(
+                f"{what} {value!r} must be non-empty and must not contain "
+                "' ', ':', '[', ']'"
+            )
+
     def _validate(self) -> None:
         cfg = self.raw
         if not cfg.node_name:
             raise ConfigError("node_name is required")
-        if any(c in cfg.node_name for c in " :[]"):
-            raise ConfigError("node_name must not contain ' ', ':', '[', ']'")
+        self._validate_key_component(cfg.node_name, "node_name")
         if not cfg.areas:
             raise ConfigError("at least one area is required")
         ids = [a.area_id for a in cfg.areas]
         if len(ids) != len(set(ids)):
             raise ConfigError("duplicate area ids")
         for area_id in ids:
-            # area ids embed into kvstore keys "prefix:<node>:[<area>]:<pfx>";
-            # forbid the delimiter characters so key encode/parse stay inverse
-            if not area_id or any(c in area_id for c in " :[]"):
-                raise ConfigError(
-                    f"area id {area_id!r} must be non-empty and must not "
-                    "contain ' ', ':', '[', ']'"
-                )
+            self._validate_key_component(area_id, "area id")
         sc = cfg.spark_config
         if sc.hold_time_s < sc.keepalive_time_s:
             raise ConfigError("spark hold_time must be >= keepalive_time")
         if sc.keepalive_time_s <= 0 or sc.hello_time_s <= 0:
             raise ConfigError("spark timers must be positive")
         dc = cfg.decision_config
-        if dc.debounce_min_ms > dc.debounce_max_ms:
-            raise ConfigError("decision debounce_min must be <= debounce_max")
+        if not (0 < dc.debounce_min_ms <= dc.debounce_max_ms):
+            raise ConfigError(
+                "decision debounce windows must satisfy 0 < min <= max"
+            )
         if dc.solver_backend not in ("cpu", "tpu", "auto"):
             raise ConfigError(f"unknown solver_backend {dc.solver_backend!r}")
         kc = cfg.kvstore_config

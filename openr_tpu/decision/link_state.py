@@ -1,0 +1,699 @@
+"""Per-area link-state graph + shortest-path computation (CPU oracle).
+
+Role of the reference's openr/decision/LinkState.{h,cpp}:
+  - Link: bidirectionally-verified edge with per-direction attributes held
+    as HoldableValue for rfc6976-style ordered programming (LinkState.h:38-60,
+    LinkState.cpp:50-118).
+  - LinkState.update_adjacency_database: sorted old/new link diff ->
+    LinkStateChange (LinkState.cpp:584-756).
+  - run_spf: Dijkstra with ECMP `>=` relaxation accumulating all equal-cost
+    path links + root next hops, overloaded-node transit drain
+    (LinkState.cpp:836-911).
+  - get_spf_result: memoized per (root, use_link_metric), invalidated on
+    topology change (LinkState.cpp:821-831, clears at :751-754).
+  - get_kth_paths / trace_one_path: k edge-disjoint paths via iterative
+    SPF-with-ignored-links + DFS (LinkState.cpp:790-819, 418-439).
+  - resolve_ucmp_weights: reverse-Dijkstra weight propagation leaf->root
+    (LinkState.cpp:913-1033).
+
+This module is pure logic — no I/O, deterministic for a given set of
+adjacency databases — which is exactly what makes the TPU mirror
+(ops/csr.py + decision/tpu_solver.py) a legitimate drop-in: both are pure
+functions of the same LSDB and are differentially tested against each other.
+"""
+
+from __future__ import annotations
+
+import heapq
+from dataclasses import dataclass, field
+from typing import Generic, Iterable, Optional, TypeVar
+
+from openr_tpu.types import Adjacency, AdjacencyDatabase
+
+T = TypeVar("T")
+
+# large-but-finite "infinite" hold ttl sentinel would be config; holds count
+# in decrement ticks (ref LinkStateMetric holdUpTtl/holdDownTtl)
+
+
+class HoldableValue(Generic[T]):
+    """Value change smoothing for ordered route programming (rfc6976-ish,
+    ref LinkState.h:38-60 / LinkState.cpp:50-118).
+
+    An update with a hold ttl keeps reporting the old value for `ttl`
+    decrement ticks before switching; "bringing up" changes use hold_up_ttl
+    and "bringing down" changes use hold_down_ttl. is_change_bringing_up
+    defines which direction counts as up for bool (false->true) and metric
+    (higher->lower is "up"; ref LinkState.cpp:88-102).
+    """
+
+    def __init__(self, value: T):
+        self._value: T = value
+        self._pending: Optional[T] = None
+        self._ttl = 0
+
+    @property
+    def value(self) -> T:
+        return self._value
+
+    def has_hold(self) -> bool:
+        return self._ttl > 0
+
+    @staticmethod
+    def _is_bringing_up(old, new) -> bool:
+        if isinstance(old, bool):
+            # overload false->true is "down"; true->false is "up"
+            return old and not new
+        # metric: lowering the metric is "bringing up"
+        return new < old
+
+    def update_value(self, new: T, hold_up_ttl: int, hold_down_ttl: int) -> bool:
+        """Returns True if the *reported* value changed now."""
+        if self._pending is not None:
+            if self._pending == new:
+                return False  # same pending change, keep waiting
+            # changed target while holding: flush previous pending first
+            self._value = self._pending
+            self._pending = None
+            self._ttl = 0
+            if self._value == new:
+                return True
+        if self._value == new:
+            return False
+        ttl = hold_up_ttl if self._is_bringing_up(self._value, new) else hold_down_ttl
+        if ttl > 0:
+            self._pending = new
+            self._ttl = ttl
+            return False
+        self._value = new
+        return True
+
+    def decrement_ttl(self) -> bool:
+        """One hold tick; returns True if the reported value changed."""
+        if self._ttl > 0:
+            self._ttl -= 1
+            if self._ttl == 0 and self._pending is not None:
+                self._value = self._pending
+                self._pending = None
+                return True
+        return False
+
+
+class Link:
+    """Bidirectionally-verified link (ref LinkState.h Link). Node endpoints
+    ordered so (n1,if1) < (n2,if2) lexicographically for stable sorting."""
+
+    __slots__ = (
+        "area",
+        "n1",
+        "if1",
+        "n2",
+        "if2",
+        "_metric",
+        "_overload",
+        "_adj_label",
+        "_weight",
+        "_addr_v4",
+        "_addr_v6",
+        "_sort_key",
+    )
+
+    def __init__(self, area: str, node1: str, adj1: Adjacency, node2: str, adj2: Adjacency):
+        # adj1 is node1's adjacency toward node2 and vice versa
+        if (node1, adj1.if_name) > (node2, adj2.if_name):
+            node1, adj1, node2, adj2 = node2, adj2, node1, adj1
+        self.area = area
+        self.n1, self.if1 = node1, adj1.if_name
+        self.n2, self.if2 = node2, adj2.if_name
+        self._metric = {
+            node1: HoldableValue(adj1.metric),
+            node2: HoldableValue(adj2.metric),
+        }
+        self._overload = {
+            node1: HoldableValue(adj1.is_overloaded),
+            node2: HoldableValue(adj2.is_overloaded),
+        }
+        self._adj_label = {node1: adj1.adj_label, node2: adj2.adj_label}
+        self._weight = {node1: adj1.weight, node2: adj2.weight}
+        # link addresses for Fib programming: the *next hop address* from
+        # node X's perspective is the other end's link-local address; the
+        # framework uses "<other_node>@<other_if>" as the structural address
+        self._addr_v4 = {node1: "", node2: ""}
+        self._addr_v6 = {
+            node1: f"fe80::{node2}%{adj2.if_name}",
+            node2: f"fe80::{node1}%{adj1.if_name}",
+        }
+        self._sort_key = (self.n1, self.if1, self.n2, self.if2)
+
+    # -- identity / ordering ----------------------------------------------
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Link) and self._sort_key == other._sort_key
+
+    def __lt__(self, other: "Link") -> bool:
+        return self._sort_key < other._sort_key
+
+    def __hash__(self) -> int:
+        return hash(self._sort_key)
+
+    def __repr__(self) -> str:
+        return f"Link({self.area}: {self.n1}%{self.if1} <-> {self.n2}%{self.if2})"
+
+    # -- accessors (ref Link::getXFromNode) --------------------------------
+
+    def other_node(self, node: str) -> str:
+        return self.n2 if node == self.n1 else self.n1
+
+    def iface_from_node(self, node: str) -> str:
+        return self.if1 if node == self.n1 else self.if2
+
+    def metric_from_node(self, node: str) -> int:
+        return self._metric[node].value
+
+    def overload_from_node(self, node: str) -> bool:
+        return self._overload[node].value
+
+    def adj_label_from_node(self, node: str) -> int:
+        return self._adj_label[node]
+
+    def weight_from_node(self, node: str) -> int:
+        return self._weight[node]
+
+    def nh_v6_from_node(self, node: str) -> str:
+        """Next-hop address when forwarding *from* node over this link."""
+        return self._addr_v6[node]
+
+    def is_up(self) -> bool:
+        """Usable iff neither direction is overloaded (drained)
+        (ref Link::isUp)."""
+        return not (self._overload[self.n1].value or self._overload[self.n2].value)
+
+    # -- mutators returning topology-changed bool ---------------------------
+
+    def set_metric_from_node(
+        self, node: str, metric: int, hold_up: int = 0, hold_down: int = 0
+    ) -> bool:
+        return self._metric[node].update_value(metric, hold_up, hold_down)
+
+    def set_overload_from_node(
+        self, node: str, overloaded: bool, hold_up: int = 0, hold_down: int = 0
+    ) -> bool:
+        return self._overload[node].update_value(overloaded, hold_up, hold_down)
+
+    def set_adj_label_from_node(self, node: str, label: int) -> None:
+        self._adj_label[node] = label
+
+    def set_weight_from_node(self, node: str, weight: int) -> None:
+        self._weight[node] = weight
+
+    def decrement_holds(self) -> bool:
+        changed = False
+        for hv in self._metric.values():
+            changed |= hv.decrement_ttl()
+        for hv in self._overload.values():
+            changed |= hv.decrement_ttl()
+        return changed
+
+    def has_holds(self) -> bool:
+        return any(hv.has_hold() for hv in self._metric.values()) or any(
+            hv.has_hold() for hv in self._overload.values()
+        )
+
+
+@dataclass
+class LinkStateChange:
+    """ref LinkState.h LinkStateChange."""
+
+    topology_changed: bool = False
+    link_attributes_changed: bool = False
+    node_label_changed: bool = False
+    added_links: list[Link] = field(default_factory=list)
+
+    def __bool__(self) -> bool:
+        return (
+            self.topology_changed
+            or self.link_attributes_changed
+            or self.node_label_changed
+        )
+
+
+@dataclass
+class PathLink:
+    """One reverse-SPF-tree edge: arrived at a node via `link` from
+    `prev_node` (ref LinkState.h NodeSpfResult::PathLink)."""
+
+    link: Link
+    prev_node: str
+
+
+class NodeSpfResult:
+    """Per-destination SPF result (ref LinkState.h:211-268)."""
+
+    __slots__ = ("_metric", "path_links", "next_hops")
+
+    def __init__(self, metric: int):
+        self._metric = metric
+        self.path_links: list[PathLink] = []
+        self.next_hops: set[str] = set()  # root's neighbors on shortest paths
+
+    @property
+    def metric(self) -> int:
+        return self._metric
+
+    def reset(self, metric: int) -> None:
+        self._metric = metric
+        self.path_links.clear()
+        self.next_hops.clear()
+
+
+# SpfResult: destination node name -> NodeSpfResult
+SpfResult = dict
+
+# Path: list of Links from src to dst
+Path = list
+
+
+def path_a_in_path_b(a: Path, b: Path) -> bool:
+    """True if every link of a appears in b (ref LinkState::pathAInPathB)."""
+    return all(any(la == lb for lb in b) for la in a)
+
+
+class LinkState:
+    """One area's link-state graph (ref LinkState.h:185)."""
+
+    def __init__(self, area: str = "0"):
+        self.area = area
+        self._adj_dbs: dict[str, AdjacencyDatabase] = {}
+        self._link_map: dict[str, set[Link]] = {}
+        self._all_links: set[Link] = set()
+        self._node_overloads: dict[str, HoldableValue] = {}
+        self._node_metric_increments: dict[str, int] = {}
+        # memo caches, invalidated on topology change
+        self._spf_results: dict[tuple[str, bool], SpfResult] = {}
+        self._kth_paths: dict[tuple[str, str, int], list[Path]] = {}
+
+    # -- introspection ------------------------------------------------------
+
+    def has_node(self, node: str) -> bool:
+        return node in self._adj_dbs
+
+    def node_names(self) -> list[str]:
+        return list(self._adj_dbs)
+
+    def get_adjacency_databases(self) -> dict[str, AdjacencyDatabase]:
+        return self._adj_dbs
+
+    def links_from_node(self, node: str) -> set[Link]:
+        return self._link_map.get(node, set())
+
+    def ordered_links_from_node(self, node: str) -> list[Link]:
+        return sorted(self._link_map.get(node, set()))
+
+    def all_links(self) -> set[Link]:
+        return self._all_links
+
+    def is_node_overloaded(self, node: str) -> bool:
+        hv = self._node_overloads.get(node)
+        return hv is not None and hv.value
+
+    def node_metric_increment(self, node: str) -> int:
+        """Soft-drain metric penalty advertised by the node
+        (ref AdjacencyDatabase.nodeMetricIncrementVal)."""
+        return self._node_metric_increments.get(node, 0)
+
+    # -- construction / diffing --------------------------------------------
+
+    def _maybe_make_link(self, node: str, adj: Adjacency) -> Optional[Link]:
+        """Only create Link if the reverse adjacency exists (bidirectional
+        verification, ref LinkState.cpp maybeMakeLink:548)."""
+        other_db = self._adj_dbs.get(adj.other_node_name)
+        if other_db is None:
+            return None
+        for other_adj in other_db.adjacencies:
+            if (
+                other_adj.other_node_name == node
+                and adj.other_if_name == other_adj.if_name
+                and adj.if_name == other_adj.other_if_name
+            ):
+                return Link(self.area, node, adj, adj.other_node_name, other_adj)
+        return None
+
+    def _ordered_link_set(self, adj_db: AdjacencyDatabase) -> list[Link]:
+        links = []
+        for adj in adj_db.adjacencies:
+            link = self._maybe_make_link(adj_db.this_node_name, adj)
+            if link is not None:
+                links.append(link)
+        links.sort()
+        return links
+
+    def _add_link(self, link: Link) -> None:
+        self._link_map.setdefault(link.n1, set()).add(link)
+        self._link_map.setdefault(link.n2, set()).add(link)
+        self._all_links.add(link)
+
+    def _remove_link(self, link: Link) -> None:
+        self._link_map.get(link.n1, set()).discard(link)
+        self._link_map.get(link.n2, set()).discard(link)
+        self._all_links.discard(link)
+
+    def _remove_node(self, node: str) -> None:
+        for link in list(self._link_map.get(node, set())):
+            self._remove_link(link)
+        self._link_map.pop(node, None)
+        self._node_overloads.pop(node, None)
+        self._node_metric_increments.pop(node, None)
+
+    def _update_node_overloaded(
+        self, node: str, overloaded: bool, hold_up: int, hold_down: int
+    ) -> bool:
+        if node in self._node_overloads:
+            return self._node_overloads[node].update_value(
+                overloaded, hold_up, hold_down
+            )
+        self._node_overloads[node] = HoldableValue(overloaded)
+        return False  # new node: not a change (ref LinkState.cpp:503)
+
+    def update_adjacency_database(
+        self,
+        new_db: AdjacencyDatabase,
+        hold_up_ttl: int = 0,
+        hold_down_ttl: int = 0,
+    ) -> LinkStateChange:
+        """Diff old vs new adjacency database of one node
+        (ref LinkState.cpp:584-756)."""
+        assert new_db.area == self.area, (new_db.area, self.area)
+        change = LinkStateChange()
+        node = new_db.this_node_name
+
+        prior_db = self._adj_dbs.get(node)
+        old_links = self.ordered_links_from_node(node)
+        self._adj_dbs[node] = new_db
+        new_links = self._ordered_link_set(new_db)
+
+        change.topology_changed |= self._update_node_overloaded(
+            node, new_db.is_overloaded, hold_up_ttl, hold_down_ttl
+        )
+        change.node_label_changed = (
+            prior_db is None and new_db.node_label != 0
+        ) or (prior_db is not None and prior_db.node_label != new_db.node_label)
+        old_incr = self._node_metric_increments.get(node, 0)
+        if old_incr != new_db.node_metric_increment:
+            self._node_metric_increments[node] = new_db.node_metric_increment
+            if prior_db is not None:
+                change.topology_changed = True
+
+        i = j = 0
+        while i < len(new_links) or j < len(old_links):
+            if i < len(new_links) and (
+                j >= len(old_links) or new_links[i] < old_links[j]
+            ):
+                nl = new_links[i]
+                # fresh link coming up; may be held down via hold_up_ttl —
+                # modeled by marking overload holds is unnecessary: reference
+                # applies setHoldUpTtl; here new links simply count as
+                # topology change when up
+                change.topology_changed |= nl.is_up()
+                self._add_link(nl)
+                change.added_links.append(nl)
+                i += 1
+                continue
+            if j < len(old_links) and (
+                i >= len(new_links) or old_links[j] < new_links[i]
+            ):
+                ol = old_links[j]
+                change.topology_changed |= ol.is_up()
+                self._remove_link(ol)
+                j += 1
+                continue
+            # same link: diff directional attributes from `node`'s side
+            nl, ol = new_links[i], old_links[j]
+            if nl.metric_from_node(node) != ol.metric_from_node(node):
+                change.topology_changed |= ol.set_metric_from_node(
+                    node, nl.metric_from_node(node), hold_up_ttl, hold_down_ttl
+                )
+            if nl.overload_from_node(node) != ol.overload_from_node(node):
+                change.topology_changed |= ol.set_overload_from_node(
+                    node, nl.overload_from_node(node), hold_up_ttl, hold_down_ttl
+                )
+            if nl.adj_label_from_node(node) != ol.adj_label_from_node(node):
+                change.link_attributes_changed = True
+                ol.set_adj_label_from_node(node, nl.adj_label_from_node(node))
+            if nl.weight_from_node(node) != ol.weight_from_node(node):
+                change.link_attributes_changed = True
+                ol.set_weight_from_node(node, nl.weight_from_node(node))
+            i += 1
+            j += 1
+
+        if change.topology_changed:
+            self._spf_results.clear()
+            self._kth_paths.clear()
+        return change
+
+    def delete_adjacency_database(self, node: str) -> LinkStateChange:
+        """ref LinkState.cpp:758-775."""
+        change = LinkStateChange()
+        if node in self._adj_dbs:
+            self._remove_node(node)
+            del self._adj_dbs[node]
+            self._spf_results.clear()
+            self._kth_paths.clear()
+            change.topology_changed = True
+        return change
+
+    def decrement_holds(self) -> LinkStateChange:
+        change = LinkStateChange()
+        for link in self._all_links:
+            change.topology_changed |= link.decrement_holds()
+        for hv in self._node_overloads.values():
+            change.topology_changed |= hv.decrement_ttl()
+        if change.topology_changed:
+            self._spf_results.clear()
+            self._kth_paths.clear()
+        return change
+
+    def has_holds(self) -> bool:
+        return any(l.has_holds() for l in self._all_links) or any(
+            hv.has_hold() for hv in self._node_overloads.values()
+        )
+
+    # -- SPF ---------------------------------------------------------------
+
+    def get_spf_result(self, root: str, use_link_metric: bool = True) -> SpfResult:
+        """Memoized per (root, use_link_metric) (ref LinkState.cpp:821-831)."""
+        key = (root, use_link_metric)
+        res = self._spf_results.get(key)
+        if res is None:
+            res = self.run_spf(root, use_link_metric)
+            self._spf_results[key] = res
+        return res
+
+    def run_spf(
+        self,
+        root: str,
+        use_link_metric: bool = True,
+        links_to_ignore: Iterable[Link] = (),
+    ) -> SpfResult:
+        """Dijkstra with ECMP `>=` relaxation (ref LinkState.cpp:836-911).
+
+        Per-destination result: metric, reverse path links, and the set of
+        the *root's* neighbors lying on some shortest path (the next hops).
+        Overloaded nodes carry no transit: their adjacencies are not
+        relaxed (except for the root itself).
+        """
+        ignore = set(links_to_ignore)
+        result: SpfResult = {}
+        pending: dict[str, NodeSpfResult] = {root: NodeSpfResult(0)}
+        heap: list[tuple[int, str]] = [(0, root)]
+        while heap:
+            metric, name = heapq.heappop(heap)
+            node_res = pending.get(name)
+            if node_res is None or node_res.metric != metric:
+                continue  # stale heap entry
+            del pending[name]
+            result[name] = node_res
+
+            if name != root and self.is_node_overloaded(name):
+                continue  # drained: record reachability, no transit
+            for link in self._link_map.get(name, ()):
+                other = link.other_node(name)
+                if not link.is_up() or other in result or link in ignore:
+                    continue
+                w = link.metric_from_node(name) if use_link_metric else 1
+                cand = metric + w
+                other_res = pending.get(other)
+                if other_res is None:
+                    other_res = NodeSpfResult(cand)
+                    pending[other] = other_res
+                    heapq.heappush(heap, (cand, other))
+                if other_res.metric >= cand:
+                    if other_res.metric > cand:
+                        other_res.reset(cand)
+                        heapq.heappush(heap, (cand, other))
+                    other_res.path_links.append(PathLink(link, name))
+                    other_res.next_hops.update(node_res.next_hops)
+                    if not other_res.next_hops:
+                        other_res.next_hops.add(other)  # direct neighbor
+        return result
+
+    def get_metric_from_a_to_b(
+        self, a: str, b: str, use_link_metric: bool = True
+    ) -> Optional[int]:
+        if a == b:
+            return 0
+        res = self.get_spf_result(a, use_link_metric)
+        node = res.get(b)
+        return None if node is None else node.metric
+
+    # -- k edge-disjoint paths (ref LinkState.cpp:790-819) -----------------
+
+    def _trace_one_path(
+        self, src: str, dest: str, result: SpfResult, links_to_ignore: set[Link]
+    ) -> Optional[Path]:
+        """DFS one src->dest path over the SPF DAG, consuming links
+        (ref LinkState.cpp:418-439)."""
+        if src == dest:
+            return []
+        for path_link in result[dest].path_links:
+            if path_link.link in links_to_ignore:
+                continue
+            links_to_ignore.add(path_link.link)
+            path = self._trace_one_path(src, path_link.prev_node, result, links_to_ignore)
+            if path is not None:
+                path.append(path_link.link)
+                return path
+        return None
+
+    def get_kth_paths(self, src: str, dest: str, k: int) -> list[Path]:
+        assert k >= 1
+        key = (src, dest, k)
+        cached = self._kth_paths.get(key)
+        if cached is not None:
+            return cached
+        links_to_ignore: set[Link] = set()
+        for i in range(1, k):
+            for path in self.get_kth_paths(src, dest, i):
+                links_to_ignore.update(path)
+        paths: list[Path] = []
+        res = (
+            self.get_spf_result(src, True)
+            if not links_to_ignore
+            else self.run_spf(src, True, links_to_ignore)
+        )
+        if dest in res:
+            visited: set[Link] = set()
+            while True:
+                path = self._trace_one_path(src, dest, res, visited)
+                if not path:
+                    break
+                paths.append(path)
+        self._kth_paths[key] = paths
+        return paths
+
+    # -- UCMP weight propagation (ref LinkState.cpp:913-1033) --------------
+
+    def resolve_ucmp_weights(
+        self,
+        spf_graph: SpfResult,
+        leaf_node_weights: dict[str, int],
+        use_prefix_weight: bool,
+        use_link_metric: bool = True,
+    ) -> dict[str, "NodeUcmpResult"]:
+        """Walk the SPF DAG leaf->root accumulating advertised weights.
+
+        use_prefix_weight selects SP_UCMP_PREFIX_WEIGHT_PROPAGATION (sum of
+        next-hop prefix weights) vs SP_UCMP_ADJ_WEIGHT_PROPAGATION (sum of
+        next-hop link weights). All leaves must be equidistant from the SPF
+        root or the resolution is skipped (returns {}).
+        """
+        result: dict[str, NodeUcmpResult] = {}
+        pending: dict[str, NodeUcmpResult] = {}
+        heap: list[tuple[int, str]] = []
+        spf_metric: Optional[int] = None
+        for leaf, weight in leaf_node_weights.items():
+            node = spf_graph.get(leaf)
+            if node is None:
+                continue
+            if spf_metric is None:
+                spf_metric = node.metric
+            elif spf_metric != node.metric:
+                return {}  # leaves not equidistant: skip UCMP
+            r = NodeUcmpResult(0)
+            r.weight = weight
+            pending[leaf] = r
+            heapq.heappush(heap, (0, leaf))
+
+        while heap:
+            metric, name = heapq.heappop(heap)
+            curr = pending.get(name)
+            if curr is None or curr.metric != metric:
+                continue
+            del pending[name]
+
+            if curr.weight is None:
+                advertised = 0
+                for iface, nh in curr.next_hop_links.items():
+                    if use_prefix_weight:
+                        advertised += nh.weight
+                    else:
+                        advertised += nh.link.weight_from_node(name)
+                curr.weight = advertised
+
+            for path_link in spf_graph[name].path_links:
+                w = (
+                    path_link.link.metric_from_node(path_link.prev_node)
+                    if use_link_metric
+                    else 1
+                )
+                prev = pending.get(path_link.prev_node)
+                if prev is None:
+                    prev = NodeUcmpResult(metric + w)
+                    pending[path_link.prev_node] = prev
+                    heapq.heappush(heap, (metric + w, path_link.prev_node))
+                iface = path_link.link.iface_from_node(path_link.prev_node)
+                prev.add_next_hop_link(iface, path_link.link, name, curr.weight)
+
+            curr.normalize_next_hop_weights()
+            result[name] = curr
+        return result
+
+
+@dataclass
+class UcmpNextHopLink:
+    link: Link
+    next_node: str
+    weight: int
+
+
+class NodeUcmpResult:
+    """ref LinkState.h:275-335 NodeUcmpResult."""
+
+    __slots__ = ("metric", "weight", "next_hop_links")
+
+    def __init__(self, metric: int):
+        self.metric = metric
+        self.weight: Optional[int] = None
+        self.next_hop_links: dict[str, UcmpNextHopLink] = {}
+
+    def add_next_hop_link(
+        self, iface: str, link: Link, next_node: str, weight: int
+    ) -> None:
+        existing = self.next_hop_links.get(iface)
+        if existing is None:
+            self.next_hop_links[iface] = UcmpNextHopLink(link, next_node, weight)
+        else:
+            existing.weight += weight
+
+    def normalize_next_hop_weights(self) -> None:
+        """gcd-normalize weights (ref LinkState.cpp normalizeNextHopWeights)."""
+        import math
+
+        weights = [nh.weight for nh in self.next_hop_links.values() if nh.weight > 0]
+        if not weights:
+            return
+        g = 0
+        for w in weights:
+            g = math.gcd(g, w)
+        if g > 1:
+            for nh in self.next_hop_links.values():
+                nh.weight //= g

@@ -1,0 +1,165 @@
+"""RIB value types + route-delta containers.
+
+Role of the reference's openr/decision/RibEntry.h (RibUnicastEntry:43,
+RibMplsEntry:112, filterNexthopsToUniqueAction:158) and RouteUpdate.h:29
+(DecisionRouteUpdate), plus the delta computation DecisionRouteDb::
+calculateUpdate (SpfSolver.h:57-98).
+
+NextHop re-expresses thrift::NextHopThrift: in this framework a next hop is
+identified structurally by (neighbor node, local interface, area) — the
+address fields are carried for Fib programming but excluded from routing
+equality only where the reference does the same (it compares full structs;
+so do we).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field, replace  # noqa: F401
+from typing import Optional
+
+from openr_tpu.types import PerfEvents, PrefixEntry
+
+
+class MplsActionCode(enum.IntEnum):
+    """ref Network.thrift MplsActionCode."""
+
+    PUSH = 0
+    SWAP = 1
+    PHP = 2  # Penultimate hop popping: POP and FORWARD
+    POP_AND_LOOKUP = 3
+
+
+@dataclass(frozen=True)
+class MplsAction:
+    action: MplsActionCode
+    swap_label: Optional[int] = None
+    push_labels: tuple[int, ...] = ()
+
+
+@dataclass(frozen=True)
+class NextHop:
+    """ref Network.thrift NextHopThrift / createNextHop (LsdbUtil)."""
+
+    address: str  # neighbor's link address (v4 or v6), "" if abstract
+    if_name: str = ""
+    metric: int = 0  # IGP cost to destination over this next hop
+    mpls_action: Optional[MplsAction] = None
+    area: str = ""
+    neighbor_node_name: str = ""
+    weight: int = 0  # 0 = ECMP; >0 = UCMP normalized weight
+
+
+# MPLS label validity range (ref LsdbUtil isMplsLabelValid; RFC 3032:
+# 16 reserved labels, 20-bit label space)
+MAX_MPLS_LABEL = (1 << 20) - 1
+MIN_MPLS_LABEL = 16
+
+
+def is_mpls_label_valid(label: int) -> bool:
+    return MIN_MPLS_LABEL <= label <= MAX_MPLS_LABEL
+
+
+def filter_nexthops_to_unique_action(
+    nexthops: frozenset[NextHop],
+) -> frozenset[NextHop]:
+    """Keep only next hops whose MPLS action matches the min-metric next
+    hop's action (hardware can't mix SWAP/PHP in one ECMP group;
+    ref RibEntry.h:158)."""
+    if not nexthops:
+        return nexthops
+    best = min(
+        nexthops,
+        key=lambda nh: (
+            nh.metric,
+            nh.mpls_action.action if nh.mpls_action else -1,
+        ),
+    )
+    best_action = best.mpls_action.action if best.mpls_action else None
+    return frozenset(
+        nh
+        for nh in nexthops
+        if (nh.mpls_action.action if nh.mpls_action else None) == best_action
+    )
+
+
+@dataclass(frozen=True)
+class RibUnicastEntry:
+    """One computed unicast route (ref RibEntry.h:43-110)."""
+
+    prefix: str
+    nexthops: frozenset[NextHop] = frozenset()
+    best_prefix_entry: Optional[PrefixEntry] = None
+    best_node_area: tuple[str, str] = ("", "")
+    do_not_install: bool = False
+    igp_cost: int = 0
+    ucmp_weight: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class RibMplsEntry:
+    """One computed MPLS label route (ref RibEntry.h:112-156)."""
+
+    label: int
+    nexthops: frozenset[NextHop] = frozenset()
+
+
+class RouteUpdateType(enum.IntEnum):
+    """ref RouteUpdate.h:34."""
+
+    FULL_SYNC = 1
+    INCREMENTAL = 2
+
+
+@dataclass
+class DecisionRouteUpdate:
+    """Delta container Decision -> Fib/PrefixManager (ref RouteUpdate.h:29)."""
+
+    type: RouteUpdateType = RouteUpdateType.INCREMENTAL
+    unicast_routes_to_update: dict[str, RibUnicastEntry] = field(default_factory=dict)
+    unicast_routes_to_delete: list[str] = field(default_factory=list)
+    mpls_routes_to_update: dict[int, RibMplsEntry] = field(default_factory=dict)
+    mpls_routes_to_delete: list[int] = field(default_factory=list)
+    perf_events: Optional[PerfEvents] = None
+    prefix_type: Optional[int] = None  # set for static-route updates
+
+    def empty(self) -> bool:
+        return not (
+            self.unicast_routes_to_update
+            or self.unicast_routes_to_delete
+            or self.mpls_routes_to_update
+            or self.mpls_routes_to_delete
+        )
+
+
+@dataclass
+class DecisionRouteDb:
+    """Full computed RIB (ref SpfSolver.h:57-98)."""
+
+    unicast_routes: dict[str, RibUnicastEntry] = field(default_factory=dict)
+    mpls_routes: dict[int, RibMplsEntry] = field(default_factory=dict)
+
+    def add_unicast_route(self, entry: RibUnicastEntry) -> None:
+        self.unicast_routes[entry.prefix] = entry
+
+    def add_mpls_route(self, entry: RibMplsEntry) -> None:
+        self.mpls_routes[entry.label] = entry
+
+    def calculate_update(self, new_db: "DecisionRouteDb") -> DecisionRouteUpdate:
+        """Delta from self -> new_db (ref DecisionRouteDb::calculateUpdate)."""
+        upd = DecisionRouteUpdate()
+        for prefix, entry in new_db.unicast_routes.items():
+            old = self.unicast_routes.get(prefix)
+            if old is None or old != entry:
+                upd.unicast_routes_to_update[prefix] = entry
+        for prefix in self.unicast_routes:
+            if prefix not in new_db.unicast_routes:
+                upd.unicast_routes_to_delete.append(prefix)
+        for label, entry in new_db.mpls_routes.items():
+            old = self.mpls_routes.get(label)
+            if old is None or old != entry:
+                upd.mpls_routes_to_update[label] = entry
+        for label in self.mpls_routes:
+            if label not in new_db.mpls_routes:
+                upd.mpls_routes_to_delete.append(label)
+        return upd

@@ -30,8 +30,14 @@ def spawn_logged(coro: Coroutine[Any, Any, Any], name: str = "") -> asyncio.Task
         if t.cancelled():
             return
         exc = t.exception()
-        if exc is not None:
-            log.error("task %s crashed", t.get_name(), exc_info=exc)
+        if exc is None:
+            return
+        # Queue closure is the quiet shutdown path, same as Actor.add_task.
+        from openr_tpu.messaging import QueueClosedError
+
+        if isinstance(exc, QueueClosedError):
+            return
+        log.error("task %s crashed", t.get_name(), exc_info=exc)
 
     task.add_done_callback(_done)
     return task

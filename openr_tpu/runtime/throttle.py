@@ -59,21 +59,22 @@ class AsyncDebounce:
     (VERDICT r1 weak #3)."""
 
     def __init__(self, min_s: float, max_s: float, callback: Callable[[], Any]):
-        assert min_s <= max_s
+        assert 0 < min_s <= max_s, "debounce window must be positive"
         self.min_s = min_s
         self.max_s = max_s
         self._callback = callback
         self._handle: Optional[asyncio.TimerHandle] = None
-        self._current = 0.0  # 0 = backoff idle (no pending fire)
+        self._armed = False  # a fire is pending
+        self._current = 0.0  # current backoff window (valid while armed)
 
     def __call__(self) -> None:
-        if self._current >= self.max_s:
+        if self._armed and self._current >= self.max_s:
             # At max backoff: do not postpone the already-scheduled fire.
-            assert self._handle is not None
             return
         self._current = (
-            self.min_s if self._current == 0 else min(self._current * 2, self.max_s)
+            self.min_s if not self._armed else min(self._current * 2, self.max_s)
         )
+        self._armed = True
         if self._handle is not None:
             self._handle.cancel()
         loop = asyncio.get_running_loop()
@@ -81,7 +82,7 @@ class AsyncDebounce:
 
     def _fire(self) -> None:
         self._handle = None
-        self._current = 0.0  # reset backoff so the next call starts at min_s
+        self._armed = False  # reset backoff so the next call starts at min_s
         res = self._callback()
         if asyncio.iscoroutine(res):
             spawn_logged(res, name=f"{type(self).__name__}.callback")
@@ -91,7 +92,7 @@ class AsyncDebounce:
         if self._handle is not None:
             self._handle.cancel()
             self._handle = None
-        self._current = 0.0
+        self._armed = False
 
     @property
     def is_active(self) -> bool:

@@ -102,7 +102,10 @@ class PrefixMetrics:
 
     path_preference: int = 1000
     source_preference: int = 100
-    # distance is igp metric to the announcer, computed not advertised
+    # Advertised inter-area hop distance, bumped by PrefixManager on
+    # cross-area redistribution; SHORTEST_DISTANCE selection minimizes it
+    # (ref Types.thrift:364, LsdbUtil.cpp selectShortestDistance).
+    distance: int = 0
     drain_metric: int = 0  # advertised by soft-drained nodes, lower wins
 
 

@@ -1,0 +1,290 @@
+"""LinkState graph tests — semantics of the reference's
+openr/decision/tests/LinkStateTest.cpp: bidirectional link verification,
+adjacency diffing, holds, SPF with ECMP + overload drain, k-paths, UCMP."""
+
+from openr_tpu.decision.link_state import HoldableValue, LinkState
+from openr_tpu.models import topologies
+from openr_tpu.types import Adjacency, AdjacencyDatabase
+
+
+def adj(me, other, metric=1, **kw):
+    return Adjacency(
+        other_node_name=other,
+        if_name=f"if-{me}-{other}",
+        other_if_name=f"if-{other}-{me}",
+        metric=metric,
+        **kw,
+    )
+
+
+def adj_db(node, adjs, **kw):
+    return AdjacencyDatabase(this_node_name=node, adjacencies=tuple(adjs), **kw)
+
+
+def line_link_state(n=3, metric=1):
+    """0 -- 1 -- 2 ... linear chain."""
+    ls = LinkState("0")
+    names = [f"n{i}" for i in range(n)]
+    for i, name in enumerate(names):
+        adjs = []
+        if i > 0:
+            adjs.append(adj(name, names[i - 1], metric))
+        if i < n - 1:
+            adjs.append(adj(name, names[i + 1], metric))
+        ls.update_adjacency_database(adj_db(name, adjs))
+    return ls, names
+
+
+# -- HoldableValue ---------------------------------------------------------
+
+def test_holdable_value_no_hold():
+    hv = HoldableValue(10)
+    assert hv.update_value(5, 0, 0) is True
+    assert hv.value == 5
+    assert hv.update_value(5, 0, 0) is False
+
+
+def test_holdable_value_hold_down_then_decrement():
+    hv = HoldableValue(1)
+    # metric 1 -> 10 is "bringing down": uses hold_down ttl
+    assert hv.update_value(10, 2, 3) is False
+    assert hv.value == 1 and hv.has_hold()
+    assert hv.decrement_ttl() is False
+    assert hv.decrement_ttl() is False
+    assert hv.decrement_ttl() is True  # 3rd tick flushes
+    assert hv.value == 10 and not hv.has_hold()
+
+
+def test_holdable_value_bool_direction():
+    hv = HoldableValue(False)
+    # overload False->True is "down"
+    assert hv.update_value(True, 1, 2) is False
+    hv.decrement_ttl()
+    assert hv.decrement_ttl() is True
+    assert hv.value is True
+
+
+# -- link construction / diffing ------------------------------------------
+
+def test_link_requires_bidirectional_adjacency():
+    ls = LinkState("0")
+    change = ls.update_adjacency_database(adj_db("a", [adj("a", "b")]))
+    # b hasn't advertised the reverse adjacency yet: no link, no topology
+    assert not change.topology_changed
+    assert ls.links_from_node("a") == set()
+    change = ls.update_adjacency_database(adj_db("b", [adj("b", "a")]))
+    assert change.topology_changed
+    assert len(ls.links_from_node("a")) == 1
+    assert len(change.added_links) == 1
+
+
+def test_adjacency_diff_metric_and_attribute_changes():
+    ls = LinkState("0")
+    ls.update_adjacency_database(adj_db("a", [adj("a", "b")]))
+    ls.update_adjacency_database(adj_db("b", [adj("b", "a")]))
+    # metric change -> topology changed
+    change = ls.update_adjacency_database(adj_db("a", [adj("a", "b", metric=5)]))
+    assert change.topology_changed
+    link = next(iter(ls.links_from_node("a")))
+    assert link.metric_from_node("a") == 5
+    assert link.metric_from_node("b") == 1
+    # adj label change -> attributes only
+    change = ls.update_adjacency_database(
+        adj_db("a", [adj("a", "b", metric=5, adj_label=50001)])
+    )
+    assert not change.topology_changed
+    assert change.link_attributes_changed
+    # node label change flag
+    change = ls.update_adjacency_database(
+        adj_db("a", [adj("a", "b", metric=5, adj_label=50001)], node_label=105)
+    )
+    assert change.node_label_changed
+
+
+def test_link_removal_and_node_delete():
+    ls = LinkState("0")
+    ls.update_adjacency_database(adj_db("a", [adj("a", "b")]))
+    ls.update_adjacency_database(adj_db("b", [adj("b", "a")]))
+    change = ls.update_adjacency_database(adj_db("a", []))
+    assert change.topology_changed
+    assert ls.links_from_node("b") == set()
+    change = ls.delete_adjacency_database("b")
+    assert change.topology_changed
+    assert not ls.has_node("b")
+
+
+def test_link_overload_makes_link_down():
+    ls = LinkState("0")
+    ls.update_adjacency_database(adj_db("a", [adj("a", "b")]))
+    ls.update_adjacency_database(adj_db("b", [adj("b", "a")]))
+    change = ls.update_adjacency_database(
+        adj_db("a", [adj("a", "b", is_overloaded=True)])
+    )
+    assert change.topology_changed
+    link = next(iter(ls.links_from_node("a")))
+    assert not link.is_up()
+
+
+def test_metric_hold_up_and_down():
+    ls = LinkState("0")
+    ls.update_adjacency_database(adj_db("a", [adj("a", "b", metric=10)]))
+    ls.update_adjacency_database(adj_db("b", [adj("b", "a")]))
+    # lowering metric = bringing up: held for hold_up_ttl=2 ticks
+    change = ls.update_adjacency_database(
+        adj_db("a", [adj("a", "b", metric=1)]), hold_up_ttl=2, hold_down_ttl=4
+    )
+    assert not change.topology_changed
+    assert ls.has_holds()
+    link = next(iter(ls.links_from_node("a")))
+    assert link.metric_from_node("a") == 10  # still reporting old value
+    assert not ls.decrement_holds().topology_changed
+    assert ls.decrement_holds().topology_changed
+    assert link.metric_from_node("a") == 1
+
+
+# -- SPF -------------------------------------------------------------------
+
+def test_spf_line_metrics_and_next_hops():
+    ls, names = line_link_state(4, metric=2)
+    res = ls.run_spf("n0")
+    assert res["n0"].metric == 0
+    assert res["n1"].metric == 2
+    assert res["n3"].metric == 6
+    assert res["n1"].next_hops == {"n1"}
+    assert res["n3"].next_hops == {"n1"}
+
+
+def test_spf_ecmp_square():
+    #   a -- b
+    #   |    |     all metric 1: a->d via b and via c (cost 2)
+    #   c -- d
+    ls = LinkState("0")
+    ls.update_adjacency_database(adj_db("a", [adj("a", "b"), adj("a", "c")]))
+    ls.update_adjacency_database(adj_db("b", [adj("b", "a"), adj("b", "d")]))
+    ls.update_adjacency_database(adj_db("c", [adj("c", "a"), adj("c", "d")]))
+    ls.update_adjacency_database(adj_db("d", [adj("d", "b"), adj("d", "c")]))
+    res = ls.run_spf("a")
+    assert res["d"].metric == 2
+    assert res["d"].next_hops == {"b", "c"}
+    assert len(res["d"].path_links) == 2
+
+
+def test_spf_overloaded_node_carries_no_transit():
+    ls = LinkState("0")
+    ls.update_adjacency_database(adj_db("a", [adj("a", "b"), adj("a", "c")]))
+    ls.update_adjacency_database(
+        adj_db("b", [adj("b", "a"), adj("b", "d")], is_overloaded=True)
+    )
+    ls.update_adjacency_database(adj_db("c", [adj("c", "a"), adj("c", "d", metric=5)]))
+    ls.update_adjacency_database(adj_db("d", [adj("d", "b"), adj("d", "c", metric=5)]))
+    res = ls.run_spf("a")
+    # b reachable but no transit through b: d costs 1+5 via c, not 2 via b
+    assert res["b"].metric == 1
+    assert res["d"].metric == 6
+    assert res["d"].next_hops == {"c"}
+    # overloaded root still routes its own traffic
+    res_b = ls.run_spf("b")
+    assert res_b["d"].metric == 1
+
+
+def test_spf_memoization_and_invalidation():
+    ls, names = line_link_state(3)
+    r1 = ls.get_spf_result("n0")
+    assert ls.get_spf_result("n0") is r1  # memo hit
+    ls.update_adjacency_database(
+        adj_db("n1", [adj("n1", "n0"), adj("n1", "n2", 7)])
+    )
+    r2 = ls.get_spf_result("n0")
+    assert r2 is not r1
+    assert r2["n2"].metric == 8
+
+
+def test_get_metric_a_to_b():
+    ls, names = line_link_state(3, metric=3)
+    assert ls.get_metric_from_a_to_b("n0", "n2") == 6
+    assert ls.get_metric_from_a_to_b("n0", "n0") == 0
+    assert ls.get_metric_from_a_to_b("n0", "nx") is None
+
+
+# -- k shortest (edge-disjoint) paths --------------------------------------
+
+def test_kth_paths_square():
+    ls = LinkState("0")
+    ls.update_adjacency_database(adj_db("a", [adj("a", "b"), adj("a", "c")]))
+    ls.update_adjacency_database(adj_db("b", [adj("b", "a"), adj("b", "d")]))
+    ls.update_adjacency_database(adj_db("c", [adj("c", "a"), adj("c", "d", metric=2)]))
+    ls.update_adjacency_database(adj_db("d", [adj("d", "b"), adj("d", "c", metric=2)]))
+    p1 = ls.get_kth_paths("a", "d", 1)
+    assert len(p1) == 1 and len(p1[0]) == 2  # a-b-d strictly shortest
+    p2 = ls.get_kth_paths("a", "d", 2)
+    assert len(p2) == 1 and len(p2[0]) == 2  # a-c-d, edge-disjoint
+    used = {l for p in p1 for l in p}
+    assert all(l not in used for p in p2 for l in p)
+
+
+def test_kth_paths_ecmp_traces_disjoint():
+    ls = LinkState("0")
+    ls.update_adjacency_database(adj_db("a", [adj("a", "b"), adj("a", "c")]))
+    ls.update_adjacency_database(adj_db("b", [adj("b", "a"), adj("b", "d")]))
+    ls.update_adjacency_database(adj_db("c", [adj("c", "a"), adj("c", "d")]))
+    ls.update_adjacency_database(adj_db("d", [adj("d", "b"), adj("d", "c")]))
+    p1 = ls.get_kth_paths("a", "d", 1)
+    assert len(p1) == 2  # both equal-cost paths traced from the SPF DAG
+
+
+# -- UCMP ------------------------------------------------------------------
+
+def test_ucmp_weight_propagation():
+    # two leaves with weights 2 and 4 behind a middle node
+    #  root -- m -- l1(w2)
+    #           \-- l2(w4)
+    ls = LinkState("0")
+    ls.update_adjacency_database(adj_db("root", [adj("root", "m")]))
+    ls.update_adjacency_database(
+        adj_db("m", [adj("m", "root"), adj("m", "l1"), adj("m", "l2")])
+    )
+    ls.update_adjacency_database(adj_db("l1", [adj("l1", "m")]))
+    ls.update_adjacency_database(adj_db("l2", [adj("l2", "m")]))
+    # equidistant leaves required
+    spf = ls.get_spf_result("root")
+    res = ls.resolve_ucmp_weights(spf, {"l1": 2, "l2": 4}, use_prefix_weight=True)
+    assert res["m"].weight == 6  # sum of leaf prefix weights
+    m_links = res["m"].next_hop_links
+    weights = sorted(nh.weight for nh in m_links.values())
+    assert weights == [1, 2]  # gcd-normalized 2:4
+    assert res["root"].weight == 6
+
+
+def test_ucmp_unequal_leaf_distance_skipped():
+    ls, names = line_link_state(3)
+    spf = ls.get_spf_result("n0")
+    res = ls.resolve_ucmp_weights(spf, {"n1": 1, "n2": 1}, use_prefix_weight=True)
+    assert res == {}
+
+
+# -- generators sanity -----------------------------------------------------
+
+def test_topology_generators_shapes():
+    adj_dbs, prefix_dbs = topologies.grid(3)
+    assert len(adj_dbs) == 9 and len(prefix_dbs) == 9
+    link_states, prefix_state = topologies.build_states(adj_dbs, prefix_dbs)
+    ls = link_states["0"]
+    assert len(ls.all_links()) == 12  # 2*n*(n-1) grid edges
+    res = ls.run_spf("node-0-0")
+    assert res["node-2-2"].metric == 4
+    assert len(prefix_state.prefixes()) == 9
+
+    adj_dbs, _ = topologies.fat_tree()
+    names = {db.this_node_name for db in adj_dbs}
+    assert len(names) == 2 * 4 + 2 * 2 + 2 * 4  # ssw + fsw + rsw
+    link_states, _ = topologies.build_states(adj_dbs, [])
+    ft = link_states["0"]
+    # rsw in pod0 reaches rsw in pod1 in 4 hops via fsw-ssw-fsw
+    res = ft.run_spf("rsw-0-0")
+    assert res["rsw-1-0"].metric == 4
+    assert len(res["rsw-1-0"].next_hops) == 2  # both planes ECMP
+
+    adj_dbs, _ = topologies.random_mesh(20, seed=3)
+    link_states, _ = topologies.build_states(adj_dbs, [])
+    res = link_states["0"].run_spf("node-0")
+    assert len(res) == 20  # connected

@@ -1,0 +1,325 @@
+"""SpfSolver (CPU oracle) tests — semantics of the reference's
+openr/decision/tests/DecisionTest.cpp route-computation assertions:
+ECMP next hops, best-route selection, drained-node filtering, min-nexthop,
+self-advertised skip, MPLS label routes, KSP2, route-db deltas."""
+
+from openr_tpu.decision.link_state import LinkState
+from openr_tpu.decision.prefix_state import PrefixState
+from openr_tpu.decision.rib import (
+    DecisionRouteDb,
+    MplsActionCode,
+    NextHop,
+    RibUnicastEntry,
+)
+from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.models import topologies
+from openr_tpu.types import (
+    Adjacency,
+    AdjacencyDatabase,
+    PrefixDatabase,
+    PrefixEntry,
+    PrefixForwardingAlgorithm,
+    PrefixForwardingType,
+    PrefixMetrics,
+)
+from tests.test_link_state import adj, adj_db
+
+
+def prefix_db(node, prefix, area="0", delete=False, **entry_kw):
+    return PrefixDatabase(
+        this_node_name=node,
+        prefix_entries=(PrefixEntry(prefix=prefix, **entry_kw),),
+        area=area,
+        delete_prefix=delete,
+    )
+
+
+def square_states():
+    #   a -- b
+    #   |    |    unit metrics
+    #   c -- d
+    ls = LinkState("0")
+    ls.update_adjacency_database(
+        adj_db("a", [adj("a", "b"), adj("a", "c")], node_label=101)
+    )
+    ls.update_adjacency_database(
+        adj_db("b", [adj("b", "a"), adj("b", "d")], node_label=102)
+    )
+    ls.update_adjacency_database(
+        adj_db("c", [adj("c", "a"), adj("c", "d")], node_label=103)
+    )
+    ls.update_adjacency_database(
+        adj_db("d", [adj("d", "b"), adj("d", "c")], node_label=104)
+    )
+    return {"0": ls}
+
+
+def nh_names(route):
+    return {nh.neighbor_node_name for nh in route.nexthops}
+
+
+def test_route_to_single_announcer():
+    states = square_states()
+    ps = PrefixState()
+    ps.update_prefix_database(prefix_db("d", "fd00::d/128"))
+    solver = SpfSolver("a")
+    db = solver.build_route_db("a", states, ps)
+    route = db.unicast_routes["fd00::d/128"]
+    assert nh_names(route) == {"b", "c"}  # ECMP both ways
+    assert route.igp_cost == 2
+    for nh in route.nexthops:
+        assert nh.metric == 2
+        assert nh.mpls_action is None
+
+
+def test_anycast_shortest_announcer_wins():
+    states = square_states()
+    ps = PrefixState()
+    ps.update_prefix_database(prefix_db("b", "fd00::100/128"))
+    ps.update_prefix_database(prefix_db("d", "fd00::100/128"))
+    solver = SpfSolver("a")
+    db = solver.build_route_db("a", states, ps)
+    route = db.unicast_routes["fd00::100/128"]
+    # b at distance 1 beats d at distance 2
+    assert nh_names(route) == {"b"}
+    assert route.igp_cost == 1
+
+
+def test_path_preference_beats_distance():
+    states = square_states()
+    ps = PrefixState()
+    ps.update_prefix_database(
+        prefix_db(
+            "b", "fd00::100/128", metrics=PrefixMetrics(path_preference=500)
+        )
+    )
+    ps.update_prefix_database(
+        prefix_db(
+            "d", "fd00::100/128", metrics=PrefixMetrics(path_preference=1000)
+        )
+    )
+    solver = SpfSolver("a")
+    db = solver.build_route_db("a", states, ps)
+    route = db.unicast_routes["fd00::100/128"]
+    assert route.best_node_area == ("d", "0")
+    assert nh_names(route) == {"b", "c"}  # ECMP toward d
+    assert route.igp_cost == 2
+
+
+def test_advertised_distance_tiebreak():
+    states = square_states()
+    ps = PrefixState()
+    ps.update_prefix_database(
+        prefix_db("b", "fd00::100/128", metrics=PrefixMetrics(distance=2))
+    )
+    ps.update_prefix_database(
+        prefix_db("d", "fd00::100/128", metrics=PrefixMetrics(distance=1))
+    )
+    solver = SpfSolver("a")
+    db = solver.build_route_db("a", states, ps)
+    # d wins on advertised distance despite longer igp path
+    assert db.unicast_routes["fd00::100/128"].best_node_area == ("d", "0")
+
+
+def test_drained_announcer_filtered_unless_all_drained():
+    states = square_states()
+    # drain d (node overload)
+    states["0"].update_adjacency_database(
+        adj_db("d", [adj("d", "b"), adj("d", "c")], node_label=104, is_overloaded=True)
+    )
+    ps = PrefixState()
+    ps.update_prefix_database(prefix_db("b", "fd00::100/128"))
+    ps.update_prefix_database(prefix_db("d", "fd00::100/128"))
+    solver = SpfSolver("a")
+    db = solver.build_route_db("a", states, ps)
+    assert nh_names(db.unicast_routes["fd00::100/128"]) == {"b"}
+    # both drained: fall back to unfiltered set
+    states["0"].update_adjacency_database(
+        adj_db("b", [adj("b", "a"), adj("b", "d")], node_label=102, is_overloaded=True)
+    )
+    db = solver.build_route_db("a", states, ps)
+    assert "fd00::100/128" in db.unicast_routes
+
+
+def test_unreachable_announcer_dropped():
+    states = square_states()
+    ps = PrefixState()
+    ps.update_prefix_database(prefix_db("zz", "fd00::100/128"))
+    solver = SpfSolver("a")
+    db = solver.build_route_db("a", states, ps)
+    assert "fd00::100/128" not in db.unicast_routes
+
+
+def test_self_advertised_prefix_skipped():
+    states = square_states()
+    ps = PrefixState()
+    ps.update_prefix_database(prefix_db("a", "fd00::a/128"))
+    solver = SpfSolver("a")
+    db = solver.build_route_db("a", states, ps)
+    assert "fd00::a/128" not in db.unicast_routes
+
+
+def test_min_nexthop_threshold():
+    states = square_states()
+    ps = PrefixState()
+    ps.update_prefix_database(prefix_db("b", "fd00::100/128", min_nexthop=2))
+    solver = SpfSolver("a")
+    db = solver.build_route_db("a", states, ps)
+    # only one shortest next hop (via b) < required 2: dropped
+    assert "fd00::100/128" not in db.unicast_routes
+    ps.update_prefix_database(prefix_db("d", "fd00::200/128", min_nexthop=2))
+    db = solver.build_route_db("a", states, ps)
+    assert nh_names(db.unicast_routes["fd00::200/128"]) == {"b", "c"}
+
+
+def test_v4_disabled_skips_v4_prefix():
+    states = square_states()
+    ps = PrefixState()
+    ps.update_prefix_database(prefix_db("d", "10.0.0.0/24"))
+    solver = SpfSolver("a", enable_v4=False)
+    db = solver.build_route_db("a", states, ps)
+    assert "10.0.0.0/24" not in db.unicast_routes
+    solver = SpfSolver("a", enable_v4=True)
+    db = solver.build_route_db("a", states, ps)
+    assert "10.0.0.0/24" in db.unicast_routes
+
+
+def test_node_not_in_graph_returns_none():
+    states = square_states()
+    solver = SpfSolver("zz")
+    assert solver.build_route_db("zz", states, PrefixState()) is None
+
+
+def test_node_segment_label_routes():
+    states = square_states()
+    solver = SpfSolver("a", enable_node_segment_label=True)
+    db = solver.build_route_db("a", states, PrefixState())
+    # own label: POP_AND_LOOKUP
+    own = db.mpls_routes[101]
+    assert next(iter(own.nexthops)).mpls_action.action == MplsActionCode.POP_AND_LOOKUP
+    # neighbor b label: PHP (nexthop is destination)
+    to_b = db.mpls_routes[102]
+    assert {nh.neighbor_node_name for nh in to_b.nexthops} == {"b"}
+    assert next(iter(to_b.nexthops)).mpls_action.action == MplsActionCode.PHP
+    # far node d label: SWAP via both ECMP neighbors
+    to_d = db.mpls_routes[104]
+    assert {nh.neighbor_node_name for nh in to_d.nexthops} == {"b", "c"}
+    for nh in to_d.nexthops:
+        assert nh.mpls_action.action == MplsActionCode.SWAP
+        assert nh.mpls_action.swap_label == 104
+
+
+def test_adjacency_label_routes():
+    ls = LinkState("0")
+    ls.update_adjacency_database(adj_db("a", [adj("a", "b", adj_label=50001)]))
+    ls.update_adjacency_database(adj_db("b", [adj("b", "a", adj_label=50002)]))
+    solver = SpfSolver("a", enable_adjacency_labels=True)
+    db = solver.build_route_db("a", {"0": ls}, PrefixState())
+    route = db.mpls_routes[50001]
+    nh = next(iter(route.nexthops))
+    assert nh.neighbor_node_name == "b"
+    assert nh.mpls_action.action == MplsActionCode.PHP
+
+
+def test_ksp2_two_disjoint_paths_with_labels():
+    states = square_states()
+    ps = PrefixState()
+    ps.update_prefix_database(
+        prefix_db(
+            "d",
+            "fd00::d/128",
+            forwarding_type=PrefixForwardingType.SR_MPLS,
+            forwarding_algorithm=PrefixForwardingAlgorithm.KSP2_ED_ECMP,
+        )
+    )
+    solver = SpfSolver("a")
+    db = solver.build_route_db("a", states, ps)
+    route = db.unicast_routes["fd00::d/128"]
+    assert nh_names(route) == {"b", "c"}  # both edge-disjoint paths
+    for nh in route.nexthops:
+        # PHP'd first hop: only d's node label is pushed
+        assert nh.mpls_action.action == MplsActionCode.PUSH
+        assert nh.mpls_action.push_labels == (104,)
+
+
+def test_static_routes_merge_and_yield_to_computed():
+    states = square_states()
+    ps = PrefixState()
+    ps.update_prefix_database(prefix_db("d", "fd00::d/128"))
+    solver = SpfSolver("a")
+    static_entry = RibUnicastEntry(
+        prefix="fd00::s/128",
+        nexthops=frozenset({NextHop(address="fe80::x", neighbor_node_name="x")}),
+    )
+    shadowed = RibUnicastEntry(prefix="fd00::d/128", nexthops=frozenset())
+    solver.update_static_unicast_routes(
+        {"fd00::s/128": static_entry, "fd00::d/128": shadowed}, []
+    )
+    db = solver.build_route_db("a", states, ps)
+    assert db.unicast_routes["fd00::s/128"] == static_entry
+    # computed route has priority over the static for the same prefix
+    assert nh_names(db.unicast_routes["fd00::d/128"]) == {"b", "c"}
+    solver.update_static_unicast_routes({}, ["fd00::s/128"])
+    db = solver.build_route_db("a", states, ps)
+    assert "fd00::s/128" not in db.unicast_routes
+
+
+def test_incremental_create_route_matches_full_build():
+    adj_dbs, prefix_dbs = topologies.grid(4)
+    states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    solver = SpfSolver("node-0-0")
+    full = solver.build_route_db("node-0-0", states, ps)
+    for prefix in ps.prefixes():
+        route = solver.create_route_for_prefix_or_get_static(
+            "node-0-0", states, ps, prefix
+        )
+        if prefix == "fd00::1/128":  # node-0-0's own loopback (skipped)
+            assert route is None
+            continue
+        assert route == full.unicast_routes[prefix]
+
+
+def test_route_db_delta():
+    old = DecisionRouteDb()
+    e1 = RibUnicastEntry(prefix="fd00::1/128", igp_cost=1)
+    e2 = RibUnicastEntry(prefix="fd00::2/128", igp_cost=2)
+    old.add_unicast_route(e1)
+    old.add_unicast_route(e2)
+    new = DecisionRouteDb()
+    new.add_unicast_route(e1)  # unchanged
+    e2b = RibUnicastEntry(prefix="fd00::2/128", igp_cost=5)  # changed
+    e3 = RibUnicastEntry(prefix="fd00::3/128")  # added
+    new.add_unicast_route(e2b)
+    new.add_unicast_route(e3)
+    upd = old.calculate_update(new)
+    assert set(upd.unicast_routes_to_update) == {"fd00::2/128", "fd00::3/128"}
+    assert upd.unicast_routes_to_delete == []
+    upd2 = new.calculate_update(old)
+    assert upd2.unicast_routes_to_delete == ["fd00::3/128"]
+
+
+def test_ucmp_weights_attached():
+    # root -- m -- l1 / l2, prefix announced by l1 (w=2) and l2 (w=4)
+    ls = LinkState("0")
+    ls.update_adjacency_database(adj_db("root", [adj("root", "m")]))
+    ls.update_adjacency_database(
+        adj_db("m", [adj("m", "root"), adj("m", "l1"), adj("m", "l2")])
+    )
+    ls.update_adjacency_database(adj_db("l1", [adj("l1", "m")]))
+    ls.update_adjacency_database(adj_db("l2", [adj("l2", "m")]))
+    ps = PrefixState()
+    for node, w in (("l1", 2), ("l2", 4)):
+        ps.update_prefix_database(
+            prefix_db(
+                node,
+                "fd00::100/128",
+                forwarding_algorithm=PrefixForwardingAlgorithm.SP_UCMP_PREFIX_WEIGHT_PROPAGATION,
+                weight=w,
+            )
+        )
+    solver = SpfSolver("m", enable_ucmp=True)
+    db = solver.build_route_db("m", {"0": ls}, ps)
+    route = db.unicast_routes["fd00::100/128"]
+    weights = sorted(nh.weight for nh in route.nexthops)
+    assert weights == [1, 2]  # 2:4 gcd-normalized
+    assert route.ucmp_weight == 6  # advertised aggregate
