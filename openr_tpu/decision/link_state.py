@@ -291,6 +291,9 @@ class LinkState:
         # memo caches, invalidated on topology change
         self._spf_results: dict[tuple[str, bool], SpfResult] = {}
         self._kth_paths: dict[tuple[str, str, int], list[Path]] = {}
+        # Monotonic change counter: bumps on any applied change so derived
+        # mirrors (ops/csr.py device arrays) know when to refresh.
+        self.generation = 0
 
     # -- introspection ------------------------------------------------------
 
@@ -448,6 +451,10 @@ class LinkState:
         if change.topology_changed:
             self._spf_results.clear()
             self._kth_paths.clear()
+        if change or prior_db is None:
+            # a first-time adjacency db with no usable links still adds the
+            # node (has_node becomes true) — mirrors must refresh for it
+            self.generation += 1
         return change
 
     def delete_adjacency_database(self, node: str) -> LinkStateChange:
@@ -459,6 +466,7 @@ class LinkState:
             self._spf_results.clear()
             self._kth_paths.clear()
             change.topology_changed = True
+            self.generation += 1
         return change
 
     def decrement_holds(self) -> LinkStateChange:
@@ -470,6 +478,7 @@ class LinkState:
         if change.topology_changed:
             self._spf_results.clear()
             self._kth_paths.clear()
+            self.generation += 1
         return change
 
     def has_holds(self) -> bool:

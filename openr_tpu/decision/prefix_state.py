@@ -10,12 +10,15 @@ from __future__ import annotations
 
 from typing import Optional
 
+import functools
+
 from openr_tpu.types import PrefixDatabase, PrefixEntry, parse_prefix
 
 # (node, area) -> advertised entry
 PrefixEntries = dict
 
 
+@functools.lru_cache(maxsize=65536)
 def canonical_prefix(prefix: str) -> str:
     return str(parse_prefix(prefix))
 
@@ -23,6 +26,9 @@ def canonical_prefix(prefix: str) -> str:
 class PrefixState:
     def __init__(self) -> None:
         self._prefixes: dict[str, PrefixEntries] = {}
+        # bumped on every applied change; derived structures (the device
+        # announcer matrix, ops/csr.py) key their caches on it
+        self.generation = 0
 
     def prefixes(self) -> dict[str, PrefixEntries]:
         return self._prefixes
@@ -49,6 +55,8 @@ class PrefixState:
                 if entries.get(node_area) != entry:
                     entries[node_area] = entry
                     changed.add(pfx)
+        if changed:
+            self.generation += 1
         return changed
 
     def delete_entries_of(self, node: str, area: str) -> set[str]:
@@ -62,6 +70,8 @@ class PrefixState:
                 if not entries:
                     del self._prefixes[pfx]
                 changed.add(pfx)
+        if changed:
+            self.generation += 1
         return changed
 
     def received_routes(

@@ -1,0 +1,239 @@
+"""Device-resident mirror of a LinkState graph.
+
+Role in the architecture (SURVEY §7 step 3): the TPU solver does not walk
+the host Link/adjacency objects — it operates on a padded array mirror
+rebuilt (or delta-updated) from LinkState whenever Decision applies a
+publication. This module owns that mirror.
+
+Format: padded in-neighbor lists (ELL), not classic CSR index arrays.
+The SSSP relaxation step
+
+    dist'[v] = min(dist[v], min_k dist[in_nbr[v, k]] + in_w[v, k])
+
+is then a dense gather + min-reduce over a static [N_cap, K_cap] array —
+no scatter — which is the shape XLA tiles well onto the TPU VPU. (A
+scatter-based segment-min over true CSR arrays is the GPU-idiomatic
+formulation; on TPU scatters serialize, so we trade padding memory for
+vectorization. Classic CSR arrays are also kept for out-edge enumeration
+on the host side.)
+
+Capacity classes: N_cap/K_cap/E_cap round up to the next power of two so
+topology churn reuses compiled kernels instead of recompiling per node
+count (SURVEY §7 hard part 3: dynamic topology in static shapes).
+
+Mirrors the graph semantics of openr/decision/LinkState.h:185:
+per-direction metrics, link up = neither side overloaded, node overload
+(transit drain), and the root's out-edge table used for first-hop ("next
+hop") extraction matching runSpf's accumulation (LinkState.cpp:885-901).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from openr_tpu.decision.link_state import Link, LinkState
+
+INF32 = np.int32(2**30)  # effectively-infinite metric, addition-safe
+
+
+def _next_pow2(n: int, floor: int = 8) -> int:
+    c = floor
+    while c < n:
+        c *= 2
+    return c
+
+
+@dataclass
+class EllGraph:
+    """Host (numpy) padded-in-neighbor mirror; ship to device as-is."""
+
+    n_nodes: int  # real node count (<= n_cap)
+    n_cap: int
+    k_cap: int  # padded max in-degree
+    # [n_cap, k_cap]; in_nbr -1 = padding slot
+    in_nbr: np.ndarray  # int32
+    in_w: np.ndarray  # int32 (metric of edge in_nbr[v,k] -> v)
+    in_up: np.ndarray  # bool  (link is up)
+    node_overloaded: np.ndarray  # bool [n_cap]
+    node_valid: np.ndarray  # bool [n_cap]
+    # node index <-> name
+    node_names: list  # idx -> name
+    node_index: dict  # name -> idx
+    # out-edge table per node (host side, for first-hop slot extraction):
+    # out_slots[node_idx] = list of (neighbor_idx, metric, up, Link)
+    out_slots: list
+
+    def out_table(self, root_idx: int, d_cap: Optional[int] = None):
+        """Root's out-edge slot arrays for next-hop extraction:
+        (nbr[d_cap], w[d_cap], up[d_cap], links list). Slot order is the
+        deterministic sorted-Link order."""
+        slots = self.out_slots[root_idx]
+        d_cap = d_cap or _next_pow2(max(len(slots), 1), floor=4)
+        nbr = np.full(d_cap, -1, np.int32)
+        w = np.full(d_cap, INF32, np.int32)
+        up = np.zeros(d_cap, bool)
+        links = []
+        for d, (nidx, metric, is_up, link) in enumerate(slots[:d_cap]):
+            nbr[d] = nidx
+            w[d] = metric
+            up[d] = is_up
+            links.append(link)
+        return nbr, w, up, links
+
+
+def build_ell(link_state: LinkState, n_cap: int = 0, k_cap: int = 0) -> EllGraph:
+    """Mirror a LinkState into padded arrays (full rebuild path).
+
+    Vectorized where it matters; called on topologyChanged. Metric-only
+    churn can instead patch in_w via `edge_positions` + update_metrics.
+    """
+    names = sorted(link_state.get_adjacency_databases().keys())
+    index = {n: i for i, n in enumerate(names)}
+    n = len(names)
+    n_cap = max(n_cap, _next_pow2(n))
+
+    # directed edge lists (u -> v with metric from u's side)
+    srcs: list[int] = []
+    dsts: list[int] = []
+    ws: list[int] = []
+    ups: list[bool] = []
+    links_per_edge: list[Link] = []
+    out_slots: list[list] = [[] for _ in range(n_cap)]
+    for link in sorted(link_state.all_links()):
+        up = link.is_up()
+        for u_name in (link.n1, link.n2):
+            v_name = link.other_node(u_name)
+            u, v = index[u_name], index[v_name]
+            w = link.metric_from_node(u_name)
+            srcs.append(u)
+            dsts.append(v)
+            ws.append(w)
+            ups.append(up)
+            links_per_edge.append(link)
+            out_slots[u].append((v, w, up, link))
+
+    in_deg = np.zeros(n_cap, np.int64)
+    for v in dsts:
+        in_deg[v] += 1
+    k = int(in_deg.max()) if len(dsts) else 0
+    k_cap = max(k_cap, _next_pow2(max(k, 1), floor=4))
+
+    in_nbr = np.full((n_cap, k_cap), -1, np.int32)
+    in_w = np.full((n_cap, k_cap), INF32, np.int32)
+    in_up = np.zeros((n_cap, k_cap), bool)
+    fill = np.zeros(n_cap, np.int64)
+    for u, v, w, up in zip(srcs, dsts, ws, ups):
+        s = fill[v]
+        in_nbr[v, s] = u
+        in_w[v, s] = w
+        in_up[v, s] = up
+        fill[v] = s + 1
+
+    node_overloaded = np.zeros(n_cap, bool)
+    node_valid = np.zeros(n_cap, bool)
+    node_valid[:n] = True
+    for i, name in enumerate(names):
+        node_overloaded[i] = link_state.is_node_overloaded(name)
+
+    return EllGraph(
+        n_nodes=n,
+        n_cap=n_cap,
+        k_cap=k_cap,
+        in_nbr=in_nbr,
+        in_w=in_w,
+        in_up=in_up,
+        node_overloaded=node_overloaded,
+        node_valid=node_valid,
+        node_names=names,
+        node_index=index,
+        out_slots=out_slots,
+    )
+
+
+@dataclass
+class PrefixMatrix:
+    """Per-prefix announcer table for vectorized best-route selection.
+
+    Row p mirrors PrefixState.entries_for(prefix_list[p]); columns are
+    announcer slots (padded to a_cap). Preferences are compared
+    lexicographically on device in the reference's order
+    (path_preference desc, source_preference desc, advertised distance
+    asc — LsdbUtil.cpp selectRoutes:842).
+    """
+
+    prefix_list: list  # row -> prefix string
+    node_areas: list  # [p][a] -> (node, area) or None
+    ann_node: np.ndarray  # int32 [P_cap, A_cap], -1 pad
+    ann_valid: np.ndarray  # bool
+    path_pref: np.ndarray  # int32
+    source_pref: np.ndarray  # int32
+    dist_adv: np.ndarray  # int32
+    # host-side columns for vectorized route materialization
+    min_nexthop: np.ndarray = None  # int32 [P_cap, A_cap], -1 = unset
+    is_v4: np.ndarray = None  # bool [P_cap]
+
+
+def build_prefix_matrix(
+    prefix_state,
+    node_index: dict,
+    area: str,
+    prefixes: Optional[list] = None,
+    p_cap: int = 0,
+    a_cap: int = 0,
+) -> PrefixMatrix:
+    """Pack one area's announcer entries into arrays. Announcers outside
+    `node_index` (not in this area's graph) are dropped — same effect as
+    the solver's reachability filter for unknown nodes."""
+    all_prefixes = prefixes if prefixes is not None else sorted(prefix_state.prefixes())
+    rows = []
+    for pfx in all_prefixes:
+        entries = prefix_state.entries_for(pfx) or {}
+        anns = [
+            (na, e)
+            for na, e in sorted(entries.items())
+            if na[1] == area and na[0] in node_index
+        ]
+        rows.append((pfx, anns))
+    p = len(rows)
+    a_max = max((len(anns) for _, anns in rows), default=1)
+    p_cap = max(p_cap, _next_pow2(max(p, 1)))
+    a_cap = max(a_cap, _next_pow2(max(a_max, 1), floor=2))
+
+    ann_node = np.full((p_cap, a_cap), -1, np.int32)
+    ann_valid = np.zeros((p_cap, a_cap), bool)
+    path_pref = np.full((p_cap, a_cap), np.int32(-(2**31)), np.int32)
+    source_pref = np.full((p_cap, a_cap), np.int32(-(2**31)), np.int32)
+    dist_adv = np.full((p_cap, a_cap), INF32, np.int32)
+    min_nexthop = np.full((p_cap, a_cap), -1, np.int32)
+    is_v4 = np.zeros(p_cap, bool)
+    prefix_list = []
+    node_areas = []
+    for pi, (pfx, anns) in enumerate(rows):
+        prefix_list.append(pfx)
+        is_v4[pi] = ":" not in pfx
+        row_nas = []
+        for ai, (na, entry) in enumerate(anns[:a_cap]):
+            ann_node[pi, ai] = node_index[na[0]]
+            ann_valid[pi, ai] = True
+            m = entry.metrics
+            path_pref[pi, ai] = m.path_preference
+            source_pref[pi, ai] = m.source_preference
+            dist_adv[pi, ai] = m.distance
+            if entry.min_nexthop is not None:
+                min_nexthop[pi, ai] = entry.min_nexthop
+            row_nas.append(na)
+        node_areas.append(row_nas)
+    return PrefixMatrix(
+        prefix_list=prefix_list,
+        node_areas=node_areas,
+        ann_node=ann_node,
+        ann_valid=ann_valid,
+        path_pref=path_pref,
+        source_pref=source_pref,
+        dist_adv=dist_adv,
+        min_nexthop=min_nexthop,
+        is_v4=is_v4,
+    )

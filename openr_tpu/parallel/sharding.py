@@ -1,0 +1,167 @@
+"""Multi-chip sharding of the route-computation pipeline.
+
+The reference is single-process C++ with no device parallelism; the scale
+axis it offers is per-area partitioning (SURVEY §5 long-context analogue).
+Here the TPU-native scale story is explicit (SURVEY §2 parallelism
+checklist):
+
+  - **batch axis ("dp")**: independent SSSP roots — whole-fabric RIB
+    computation (every node's routes, e.g. the benchmark and the
+    any-vantage ctrl API) shards roots across devices; zero communication.
+  - **graph axis ("tp"/"cp")**: the node dimension of the ELL mirror is
+    sharded across devices; each relaxation step computes new distances
+    for the local node shard from the full frontier, then reassembles the
+    frontier with jax.lax.all_gather over the 'graph' axis (the halo
+    exchange of this domain). This is what lets a 1M+-node LSDB exceed a
+    single chip's HBM.
+
+Both axes compose in one jax.sharding.Mesh('batch', 'graph') and ride ICI
+when the mesh maps onto a physical slice. Collectives used: all_gather
+(frontier), psum-of-bool (convergence vote, folded into the fixed-trip
+count here: lax.fori_loop with a diameter bound keeps every device in
+lockstep without a host round-trip).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+from openr_tpu.ops.csr import INF32
+
+INF = int(INF32)
+
+
+def make_mesh(n_devices: Optional[int] = None, batch: Optional[int] = None):
+    """Factor devices into a ('batch', 'graph') mesh. Prefers a wider
+    batch axis (root fan-out is embarrassingly parallel; graph sharding
+    pays an all_gather per relaxation step)."""
+    import jax
+
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    devs = devs[:n]
+    if batch is None:
+        graph = 1
+        # give the graph axis a factor of 2 when we have >= 4 devices so
+        # both kinds of sharding are exercised
+        if n >= 4 and n % 2 == 0:
+            graph = 2
+        batch = n // graph
+    else:
+        graph = n // batch
+    assert batch * graph == n, (batch, graph, n)
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(devs).reshape(batch, graph), ("batch", "graph"))
+
+
+def _sharded_step_fn(mesh, n_cap: int, n_iters: int):
+    """Build the shard_mapped multi-root SSSP + selection step."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    graph_size = mesh.shape["graph"]
+    shard_rows = n_cap // graph_size
+
+    def local_step(
+        in_nbr,  # [N/g, K]   node-sharded over 'graph'
+        in_w,
+        in_up,
+        node_over,  # [N]     replicated
+        roots,  # [R/b]       root-sharded over 'batch'
+        ann_node,  # [P, A]   replicated prefix matrix
+        ann_valid,
+        path_pref,
+        source_pref,
+        dist_adv,
+    ):
+        my_shard = jax.lax.axis_index("graph")
+        row0 = my_shard * shard_rows
+
+        def one_root(root):
+            dist0 = jnp.full((n_cap,), INF, jnp.int32).at[root].set(0)
+            usable = in_up & (in_nbr >= 0) & ((in_nbr == root) | ~node_over[in_nbr])
+
+            def body(_, dist):
+                # relax local node rows against the full frontier
+                nbr_dist = dist[in_nbr]  # [N/g, K] gather from full dist
+                cand = jnp.where(
+                    usable & (nbr_dist < INF), nbr_dist + in_w, INF
+                ).min(axis=1)
+                local_new = jnp.minimum(
+                    jax.lax.dynamic_slice(dist, (row0,), (shard_rows,)), cand
+                )
+                # frontier reassembly: the halo exchange of this domain
+                return jax.lax.all_gather(
+                    local_new, "graph", tiled=True
+                )
+
+            dist = jax.lax.fori_loop(0, n_iters, body, dist0)
+
+            # selection for this root over the (replicated) prefix matrix —
+            # shared kernel with the single-chip pipeline
+            from openr_tpu.decision.tpu_solver import _select_metric_kernel
+
+            metric, _s3, _s4, _idx = _select_metric_kernel(
+                dist, node_over, ann_node, ann_valid, path_pref, source_pref, dist_adv
+            )
+            return dist, metric
+
+        return jax.vmap(one_root)(roots)
+
+    from jax import shard_map
+
+    return jax.jit(
+        shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(
+                P("graph", None),  # in_nbr: node rows sharded
+                P("graph", None),
+                P("graph", None),
+                P(),  # node_over replicated
+                P("batch"),  # roots sharded
+                P(),  # prefix matrix replicated
+                P(),
+                P(),
+                P(),
+                P(),
+            ),
+            out_specs=(P("batch", None), P("batch", None)),
+            check_vma=False,
+        )
+    )
+
+
+@functools.lru_cache(maxsize=8)
+def _cached_step(mesh, n_cap, n_iters):
+    return _sharded_step_fn(mesh, n_cap, n_iters)
+
+
+def sharded_rib_step(mesh, graph, roots, matrix, n_iters: Optional[int] = None):
+    """Run the sharded multi-root pipeline: returns (dist[R, N_cap],
+    metric[R, P_cap]) computed across the mesh.
+
+    graph: ops.csr.EllGraph; roots: np int32 array (length must divide the
+    batch axis evenly — pad with root 0); matrix: ops.csr.PrefixMatrix.
+    n_iters defaults to a safe diameter bound (n_nodes), callers with a
+    known topology should pass something tighter.
+    """
+    n_iters = n_iters or max(graph.n_nodes, 1)
+    step = _cached_step(mesh, graph.n_cap, n_iters)
+    return step(
+        graph.in_nbr,
+        graph.in_w,
+        graph.in_up,
+        graph.node_overloaded,
+        roots.astype(np.int32),
+        matrix.ann_node,
+        matrix.ann_valid,
+        matrix.path_pref,
+        matrix.source_pref,
+        matrix.dist_adv,
+    )

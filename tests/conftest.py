@@ -2,19 +2,25 @@
 
 Tests run JAX on CPU with 8 virtual devices so multi-chip sharding
 (openr_tpu/parallel) is exercised without TPU hardware; the driver's bench
-run uses the real chip. This must happen before jax is imported anywhere.
+run uses the real chip. The axon sitecustomize pre-imports jax and pins
+JAX_PLATFORMS=axon, so env-var overrides are ineffective — we override via
+jax.config before any backend initializes (backends init lazily at first
+device use, not at import).
 """
 
 import asyncio
 import functools
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # pragma: no cover
+    pass
 
 
 def run_async(fn):

@@ -1,0 +1,309 @@
+"""CPU-vs-TPU solver differential tests (the golden harness, SURVEY §4
+takeaway (5)): both backends are pure functions of (areaLinkStates,
+prefixState); their full RIBs must match exactly on every topology
+generator, including drained nodes, anycast selection, metric churn, and
+link flaps. Runs on the virtual-CPU JAX platform (conftest)."""
+
+import numpy as np
+import pytest
+
+from openr_tpu.decision.link_state import LinkState
+from openr_tpu.decision.prefix_state import PrefixState
+from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.decision.tpu_solver import TpuSpfSolver, sssp_all_pairs
+from openr_tpu.models import topologies
+from openr_tpu.ops.csr import INF32, build_ell
+from openr_tpu.types import (
+    Adjacency,
+    AdjacencyDatabase,
+    PrefixForwardingAlgorithm,
+    PrefixMetrics,
+)
+from tests.test_link_state import adj, adj_db
+from tests.test_spf_solver import prefix_db, square_states
+
+
+def assert_rib_equal(cpu_db, tpu_db, context=""):
+    assert cpu_db.unicast_routes.keys() == tpu_db.unicast_routes.keys(), context
+    for pfx, cpu_route in cpu_db.unicast_routes.items():
+        tpu_route = tpu_db.unicast_routes[pfx]
+        assert cpu_route == tpu_route, f"{context}: mismatch for {pfx}:\n{cpu_route}\nvs\n{tpu_route}"
+    assert cpu_db.mpls_routes == tpu_db.mpls_routes, context
+
+
+def run_both(my_node, states, ps, **kw):
+    cpu = SpfSolver(my_node, **kw)
+    tpu = TpuSpfSolver(my_node, **kw)
+    cpu_db = cpu.build_route_db(my_node, states, ps)
+    tpu_db = tpu.build_route_db(my_node, states, ps)
+    if cpu_db is None:
+        assert tpu_db is None
+        return None, None
+    assert_rib_equal(cpu_db, tpu_db, my_node)
+    return cpu_db, tpu_db
+
+
+# -- SSSP kernel against Dijkstra ------------------------------------------
+
+def sssp_vs_dijkstra(link_state, sample_roots=None):
+    graph = build_ell(link_state)
+    roots = sample_roots or graph.node_names
+    root_idx = np.array([graph.node_index[r] for r in roots], np.int32)
+    dist = np.asarray(sssp_all_pairs(graph, root_idx))
+    for ri, root in enumerate(roots):
+        spf = link_state.run_spf(root)
+        for name in graph.node_names:
+            expect = spf[name].metric if name in spf else int(INF32)
+            got = int(dist[ri, graph.node_index[name]])
+            assert got == expect, (root, name, got, expect)
+
+
+def test_sssp_matches_dijkstra_grid():
+    adj_dbs, _ = topologies.grid(5)
+    states, _ = topologies.build_states(adj_dbs, [])
+    sssp_vs_dijkstra(states["0"])
+
+
+def test_sssp_matches_dijkstra_random_mesh_with_overloads():
+    adj_dbs, _ = topologies.random_mesh(30, seed=7)
+    states, _ = topologies.build_states(adj_dbs, [])
+    ls = states["0"]
+    # drain two nodes + vary some metrics
+    for i, db in enumerate(adj_dbs):
+        if i in (3, 11):
+            ls.update_adjacency_database(
+                AdjacencyDatabase(
+                    this_node_name=db.this_node_name,
+                    adjacencies=tuple(
+                        Adjacency(**{**a.__dict__, "metric": 1 + (hash(a.other_node_name) % 5)})
+                        for a in db.adjacencies
+                    ),
+                    is_overloaded=True,
+                    area="0",
+                )
+            )
+    sssp_vs_dijkstra(ls)
+
+
+def test_sssp_matches_dijkstra_fat_tree():
+    adj_dbs, _ = topologies.fat_tree()
+    states, _ = topologies.build_states(adj_dbs, [])
+    sssp_vs_dijkstra(states["0"], sample_roots=["rsw-0-0", "ssw-1-3", "fsw-1-0"])
+
+
+# -- full RIB differential -------------------------------------------------
+
+def test_rib_differential_square_basic():
+    states = square_states()
+    ps = PrefixState()
+    ps.update_prefix_database(prefix_db("d", "fd00::d/128"))
+    ps.update_prefix_database(prefix_db("b", "fd00::b/128"))
+    ps.update_prefix_database(prefix_db("a", "fd00::a/128"))  # self: skipped
+    cpu_db, _ = run_both("a", states, ps)
+    assert set(cpu_db.unicast_routes) == {"fd00::d/128", "fd00::b/128"}
+
+
+def test_rib_differential_anycast_preferences_distance():
+    states = square_states()
+    ps = PrefixState()
+    ps.update_prefix_database(
+        prefix_db("b", "fd00::100/128", metrics=PrefixMetrics(path_preference=500))
+    )
+    ps.update_prefix_database(
+        prefix_db("d", "fd00::100/128", metrics=PrefixMetrics(path_preference=1000))
+    )
+    ps.update_prefix_database(
+        prefix_db("b", "fd00::200/128", metrics=PrefixMetrics(distance=3))
+    )
+    ps.update_prefix_database(
+        prefix_db("d", "fd00::200/128", metrics=PrefixMetrics(distance=1))
+    )
+    ps.update_prefix_database(
+        prefix_db("c", "fd00::300/128", metrics=PrefixMetrics(source_preference=900))
+    )
+    ps.update_prefix_database(prefix_db("d", "fd00::300/128"))
+    run_both("a", states, ps)
+
+
+def test_rib_differential_drained_announcers():
+    states = square_states()
+    states["0"].update_adjacency_database(
+        adj_db("d", [adj("d", "b"), adj("d", "c")], node_label=104, is_overloaded=True)
+    )
+    ps = PrefixState()
+    ps.update_prefix_database(prefix_db("b", "fd00::100/128"))
+    ps.update_prefix_database(prefix_db("d", "fd00::100/128"))
+    ps.update_prefix_database(prefix_db("d", "fd00::d/128"))  # all-drained fallback
+    run_both("a", states, ps)
+
+
+def test_rib_differential_min_nexthop():
+    states = square_states()
+    ps = PrefixState()
+    ps.update_prefix_database(prefix_db("b", "fd00::100/128", min_nexthop=2))
+    ps.update_prefix_database(prefix_db("d", "fd00::200/128", min_nexthop=2))
+    cpu_db, _ = run_both("a", states, ps)
+    assert set(cpu_db.unicast_routes) == {"fd00::200/128"}
+
+
+def test_rib_differential_grid_all_vantages():
+    adj_dbs, prefix_dbs = topologies.grid(4)
+    states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    for me in ("node-0-0", "node-1-2", "node-3-3"):
+        run_both(me, states, ps)
+
+
+def test_rib_differential_fat_tree():
+    adj_dbs, prefix_dbs = topologies.fat_tree()
+    states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    run_both("rsw-0-0", states, ps)
+    run_both("ssw-0-0", states, ps)
+
+
+def test_rib_differential_random_mesh_churn():
+    """Metric churn + link flap: mirror must refresh on generation bump."""
+    adj_dbs, prefix_dbs = topologies.random_mesh(25, seed=11)
+    states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    ls = states["0"]
+    cpu = SpfSolver("node-0")
+    tpu = TpuSpfSolver("node-0")
+    assert_rib_equal(
+        cpu.build_route_db("node-0", states, ps),
+        tpu.build_route_db("node-0", states, ps),
+        "initial",
+    )
+    # flap: drop node-5's links entirely, then restore with new metrics
+    victim = next(d for d in adj_dbs if d.this_node_name == "node-5")
+    ls.update_adjacency_database(
+        AdjacencyDatabase(this_node_name="node-5", adjacencies=(), area="0")
+    )
+    assert_rib_equal(
+        cpu.build_route_db("node-0", states, ps),
+        tpu.build_route_db("node-0", states, ps),
+        "after flap down",
+    )
+    ls.update_adjacency_database(
+        AdjacencyDatabase(
+            this_node_name="node-5",
+            adjacencies=tuple(
+                Adjacency(**{**a.__dict__, "metric": 7}) for a in victim.adjacencies
+            ),
+            area="0",
+        )
+    )
+    assert_rib_equal(
+        cpu.build_route_db("node-0", states, ps),
+        tpu.build_route_db("node-0", states, ps),
+        "after restore",
+    )
+
+
+def test_rib_differential_mesh_4node():
+    """BASELINE config 1: every node's RIB matches on the 4-node mesh."""
+    adj_dbs, prefix_dbs = topologies.full_mesh(4)
+    states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    for me in (db.this_node_name for db in adj_dbs):
+        run_both(me, states, ps)
+
+
+def test_ksp2_and_ucmp_fall_back_to_cpu_identically():
+    states = square_states()
+    ps = PrefixState()
+    ps.update_prefix_database(
+        prefix_db(
+            "d",
+            "fd00::d/128",
+            forwarding_type=1,  # SR_MPLS
+            forwarding_algorithm=PrefixForwardingAlgorithm.KSP2_ED_ECMP,
+        )
+    )
+    ps.update_prefix_database(prefix_db("b", "fd00::b/128"))  # fast path
+    cpu_db, tpu_db = run_both("a", states, ps)
+    assert set(cpu_db.unicast_routes) == {"fd00::d/128", "fd00::b/128"}
+
+
+def test_multi_area_falls_back_to_cpu():
+    ls0 = LinkState("0")
+    ls0.update_adjacency_database(adj_db("a", [adj("a", "b")], area="0"))
+    ls0.update_adjacency_database(adj_db("b", [adj("b", "a")], area="0"))
+    ls1 = LinkState("1")
+    ls1.update_adjacency_database(adj_db("a", [adj("a", "c")], area="1"))
+    ls1.update_adjacency_database(adj_db("c", [adj("c", "a")], area="1"))
+    states = {"0": ls0, "1": ls1}
+    ps = PrefixState()
+    ps.update_prefix_database(prefix_db("b", "fd00::100/128", area="0"))
+    ps.update_prefix_database(prefix_db("c", "fd00::100/128", area="1"))
+    cpu_db, tpu_db = run_both("a", states, ps)
+    assert "fd00::100/128" in cpu_db.unicast_routes
+
+
+def test_topology_change_renumbering_invalidates_matrix_cache():
+    """Regression (code review r2 #1): adding a node that shifts node
+    indices must refresh the cached announcer matrix even when prefix
+    state is untouched."""
+    states = square_states()
+    ps = PrefixState()
+    ps.update_prefix_database(prefix_db("d", "fd00::d/128"))
+    cpu = SpfSolver("b")
+    tpu = TpuSpfSolver("b")
+    assert_rib_equal(
+        cpu.build_route_db("b", states, ps),
+        tpu.build_route_db("b", states, ps),
+        "before renumber",
+    )
+    # 'aa' sorts before every existing node -> all indices shift by one
+    states["0"].update_adjacency_database(adj_db("aa", [adj("aa", "a")]))
+    states["0"].update_adjacency_database(
+        adj_db("a", [adj("a", "b"), adj("a", "c"), adj("a", "aa")], node_label=101)
+    )
+    assert_rib_equal(
+        cpu.build_route_db("b", states, ps),
+        tpu.build_route_db("b", states, ps),
+        "after renumber",
+    )
+
+
+def test_any_vantage_queries_do_not_share_root_cache():
+    """Regression (code review r2 #2): back-to-back solves from different
+    vantage nodes with unchanged generations must not reuse the previous
+    root's out-edge table."""
+    states = square_states()
+    ps = PrefixState()
+    ps.update_prefix_database(prefix_db("d", "fd00::d/128"))
+    ps.update_prefix_database(prefix_db("a", "fd00::a/128"))
+    tpu = TpuSpfSolver("a")
+    for me in ("a", "b", "c", "a", "b"):
+        cpu_db = SpfSolver(me).build_route_db(me, states, ps)
+        tpu_db = tpu.build_route_db(me, states, ps)
+        assert_rib_equal(cpu_db, tpu_db, f"vantage {me}")
+
+
+def test_new_node_with_no_links_bumps_generation():
+    """Regression (code review r2 #3): a first-time adjacency db with no
+    usable links still adds the node and must refresh mirrors."""
+    states = square_states()
+    ls = states["0"]
+    tpu = TpuSpfSolver("a")
+    ps = PrefixState()
+    tpu.build_route_db("a", states, ps)  # warm the mirror
+    g1 = ls.generation
+    ls.update_adjacency_database(
+        AdjacencyDatabase(this_node_name="zz", adjacencies=(), area="0")
+    )
+    assert ls.generation > g1
+    assert ls.has_node("zz")
+    # solving from the new node: CPU yields empty-but-present db; TPU must
+    # not KeyError on a stale mirror
+    cpu_db = SpfSolver("zz").build_route_db("zz", states, ps)
+    tpu_db = tpu.build_route_db("zz", states, ps)
+    assert (cpu_db is None) == (tpu_db is None)
+    if cpu_db is not None:
+        assert_rib_equal(cpu_db, tpu_db, "new node vantage")
+
+
+def test_node_labels_via_tpu_backend():
+    states = square_states()
+    cpu_db, tpu_db = run_both(
+        "a", states, PrefixState(), enable_node_segment_label=True
+    )
+    assert set(cpu_db.mpls_routes) == {101, 102, 103, 104}
