@@ -1,0 +1,11 @@
+from openr_tpu.kvstore.engine import (  # noqa: F401
+    KvStoreFilters,
+    MergeStats,
+    TtlCountdownQueue,
+    compare_values,
+    dump_all_with_filters,
+    dump_difference,
+    dump_hash_with_filters,
+    merge_key_values,
+)
+from openr_tpu.kvstore.kvstore import KvStore, KvStoreArea, Peer  # noqa: F401

@@ -1,0 +1,307 @@
+"""Pure KvStore merge/diff logic — the CRDT core, no I/O.
+
+Role of the reference's openr/kvstore/KvStoreUtil.{h,cpp}:
+  - merge_key_values: last-writer-wins merge (KvStoreUtil.cpp:42-210) —
+    higher version, then originator id, then value bytes; equal triples
+    retain the higher ttl_version (TTL refresh without data change).
+  - compare_values (KvStoreUtil.cpp:215-249).
+  - dump_difference: the 3-way full-sync delta computation
+    (KvStoreUtil.cpp:339-379).
+  - dump_all / dump_hashes with prefix+originator filters
+    (KvStoreUtil.cpp:385-430).
+
+TTL bookkeeping (countdown queue, ref KvStore.h:652-656 + cleanupTtlCountdownQueue)
+lives here too since it is pure given a clock.
+"""
+
+from __future__ import annotations
+
+import heapq
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from openr_tpu.types import (
+    FilterOperator,
+    Publication,
+    TTL_INFINITY,
+    Value,
+    compute_hash,
+)
+
+
+@dataclass
+class MergeStats:
+    """Why keys did not merge (ref KvStoreNoMergeReasonStats)."""
+
+    no_matched_key: int = 0
+    invalid_ttl: int = 0
+    old_version: int = 0
+    no_need_to_update: int = 0
+    val_updates: int = 0
+    ttl_updates: int = 0
+
+
+@dataclass
+class KvStoreFilters:
+    """Key-prefix and originator-id match (ref KvStoreUtil.cpp:252-299).
+
+    OR: match if any prefix matches OR any originator matches.
+    AND: both must match. Empty term lists match everything for that term.
+    """
+
+    key_prefixes: tuple[str, ...] = ()
+    originator_ids: frozenset[str] = frozenset()
+    operator: FilterOperator = FilterOperator.OR
+
+    def key_match(self, key: str, value: Value) -> bool:
+        key_ok = not self.key_prefixes or any(
+            key.startswith(p) for p in self.key_prefixes
+        )
+        orig_ok = not self.originator_ids or value.originator_id in self.originator_ids
+        if self.operator == FilterOperator.AND:
+            return key_ok and orig_ok
+        # OR: but an empty term list shouldn't make everything match when
+        # the other term is restrictive — OR over *present* terms.
+        if not self.key_prefixes and not self.originator_ids:
+            return True
+        if not self.key_prefixes:
+            return orig_ok
+        if not self.originator_ids:
+            return key_ok
+        return key_ok or orig_ok
+
+
+def merge_key_values(
+    kv: dict[str, Value],
+    key_vals: dict[str, Value],
+    filters: Optional[KvStoreFilters] = None,
+    stats: Optional[MergeStats] = None,
+) -> dict[str, Value]:
+    """Merge `key_vals` into `kv` in place; return the accepted updates
+    (the received values) to publish/flood. Exact reference semantics
+    (KvStoreUtil.cpp:42-210)."""
+    updates: dict[str, Value] = {}
+    st = stats if stats is not None else MergeStats()
+
+    for key, value in key_vals.items():
+        if filters is not None and not filters.key_match(key, value):
+            st.no_matched_key += 1
+            continue
+        # TTL must be infinite or positive
+        if value.ttl_ms != TTL_INFINITY and value.ttl_ms <= 0:
+            st.invalid_ttl += 1
+            continue
+        # versions start at 1 (ref "versions must start at 1"); a version-0
+        # value would tie my_version=0 for a missing key and fall into the
+        # originator compare against no local entry
+        if value.version < 1:
+            st.old_version += 1
+            continue
+
+        mine = kv.get(key)
+        my_version = mine.version if mine is not None else 0
+        if value.version < my_version:
+            st.old_version += 1
+            continue
+
+        update_all = False
+        update_ttl = False
+        if value.value is not None:
+            if value.version > my_version:
+                update_all = True
+            elif value.originator_id > mine.originator_id:
+                update_all = True
+            elif value.originator_id == mine.originator_id:
+                # Same version+originator: deterministically let the higher
+                # value win so re-incarnated stores converge.
+                if mine.value is None or value.value > mine.value:
+                    update_all = True
+                elif value.value == mine.value:
+                    if value.ttl_version > mine.ttl_version:
+                        update_ttl = True
+        elif (
+            mine is not None
+            and value.version == mine.version
+            and value.originator_id == mine.originator_id
+            and value.ttl_version > mine.ttl_version
+        ):
+            # hash-only TTL refresh
+            update_ttl = True
+
+        if not update_all and not update_ttl:
+            st.no_need_to_update += 1
+            continue
+
+        if update_all:
+            st.val_updates += 1
+            new_value = Value(
+                version=value.version,
+                originator_id=value.originator_id,
+                value=value.value,
+                ttl_ms=value.ttl_ms,
+                ttl_version=value.ttl_version,
+                hash=value.hash
+                if value.hash is not None
+                else compute_hash(value.version, value.originator_id, value.value),
+            )
+            kv[key] = new_value
+        else:  # update_ttl
+            st.ttl_updates += 1
+            assert mine is not None
+            mine.ttl_ms = value.ttl_ms
+            mine.ttl_version = value.ttl_version
+
+        updates[key] = value
+    return updates
+
+
+def compare_values(v1: Value, v2: Value) -> int:
+    """1 if v1 better, -1 if v2 better, 0 equal, -2 unknown
+    (ref KvStoreUtil.cpp:215-249)."""
+    if v1.version != v2.version:
+        return 1 if v1.version > v2.version else -1
+    if v1.originator_id != v2.originator_id:
+        return 1 if v1.originator_id > v2.originator_id else -1
+    if v1.hash is not None and v2.hash is not None and v1.hash == v2.hash:
+        if v1.ttl_version != v2.ttl_version:
+            return 1 if v1.ttl_version > v2.ttl_version else -1
+        return 0
+    if v1.value is not None and v2.value is not None:
+        if v1.value > v2.value:
+            return 1
+        if v1.value < v2.value:
+            return -1
+        return 0
+    return -2  # a value is missing; can't tell
+
+
+def dump_difference(
+    area: str,
+    my_key_vals: dict[str, Value],
+    req_key_vals: dict[str, Value],
+) -> Publication:
+    """3-way full-sync delta (ref KvStoreUtil.cpp:339-379): return my full
+    values where mine is better/unknown, and list the keys where the
+    requester's copy is better/unknown (it should send those back)."""
+    pub = Publication(area=area)
+    for key, my_val in my_key_vals.items():
+        req_val = req_key_vals.get(key)
+        if req_val is None:
+            pub.key_vals[key] = my_val
+            continue
+        rc = compare_values(my_val, req_val)
+        if rc in (1, -2):
+            pub.key_vals[key] = my_val
+        if rc in (-1, -2):
+            pub.to_be_updated_keys.append(key)
+    for key in req_key_vals:
+        if key not in my_key_vals:
+            pub.to_be_updated_keys.append(key)
+    return pub
+
+
+def dump_all_with_filters(
+    area: str,
+    kv: dict[str, Value],
+    filters: Optional[KvStoreFilters] = None,
+    do_not_publish_value: bool = False,
+) -> Publication:
+    """ref KvStoreUtil.cpp:385-408."""
+    pub = Publication(area=area)
+    for key, val in kv.items():
+        if filters is not None and not filters.key_match(key, val):
+            continue
+        pub.key_vals[key] = _strip_value(val) if do_not_publish_value else val
+    return pub
+
+
+def dump_hash_with_filters(
+    area: str,
+    kv: dict[str, Value],
+    filters: Optional[KvStoreFilters] = None,
+) -> Publication:
+    """Hash-only dump for delta sync (ref KvStoreUtil.cpp:410-430)."""
+    pub = Publication(area=area)
+    for key, val in kv.items():
+        if filters is not None and not filters.key_match(key, val):
+            continue
+        pub.key_vals[key] = _strip_value(val)
+    return pub
+
+
+def _strip_value(val: Value) -> Value:
+    return Value(
+        version=val.version,
+        originator_id=val.originator_id,
+        value=None,
+        ttl_ms=val.ttl_ms,
+        ttl_version=val.ttl_version,
+        hash=val.hash,
+    )
+
+
+# ---------------------------------------------------------------------------
+# TTL countdown (ref KvStore.h:652-656, cleanupTtlCountdownQueue)
+# ---------------------------------------------------------------------------
+
+@dataclass(order=True)
+class _TtlEntry:
+    expiry: float
+    key: str = field(compare=False)
+    version: int = field(compare=False)
+    originator_id: str = field(compare=False)
+    ttl_version: int = field(compare=False)
+
+
+class TtlCountdownQueue:
+    """Min-heap of key expiries with lazy invalidation: an entry only kills
+    the key if (version, originator, ttl_version) still match the live
+    value — a refresh or newer write strands the stale entry."""
+
+    def __init__(self) -> None:
+        self._heap: list[_TtlEntry] = []
+
+    def track(self, key: str, value: Value, now: Optional[float] = None) -> None:
+        if value.ttl_ms == TTL_INFINITY:
+            return
+        now = time.monotonic() if now is None else now
+        heapq.heappush(
+            self._heap,
+            _TtlEntry(
+                expiry=now + value.ttl_ms / 1e3,
+                key=key,
+                version=value.version,
+                originator_id=value.originator_id,
+                ttl_version=value.ttl_version,
+            ),
+        )
+
+    def next_expiry_in_s(self, now: Optional[float] = None) -> Optional[float]:
+        if not self._heap:
+            return None
+        now = time.monotonic() if now is None else now
+        return max(0.0, self._heap[0].expiry - now)
+
+    def expire(
+        self, kv: dict[str, Value], now: Optional[float] = None
+    ) -> list[str]:
+        """Pop due entries; delete matching live keys from `kv`; return the
+        expired key names."""
+        now = time.monotonic() if now is None else now
+        expired: list[str] = []
+        while self._heap and self._heap[0].expiry <= now:
+            entry = heapq.heappop(self._heap)
+            live = kv.get(entry.key)
+            if (
+                live is not None
+                and live.version == entry.version
+                and live.originator_id == entry.originator_id
+                and live.ttl_version == entry.ttl_version
+            ):
+                del kv[entry.key]
+                expired.append(entry.key)
+        return expired
+
+    def __len__(self) -> int:
+        return len(self._heap)

@@ -1,0 +1,748 @@
+"""KvStore actor — the distributed store / inter-node comm backend.
+
+Role of the reference's openr/kvstore/KvStore.{h,cpp} (KvStore<ClientType>
+:732, per-area KvStoreDb :148):
+
+  - eventually-consistent replicated map per area, CRDT-LWW merge
+    (engine.merge_key_values; ref KvStoreUtil.cpp:42-210)
+  - peer FSM IDLE -> SYNCING -> INITIALIZED with exponential backoff on
+    transport errors (ref KvStore.cpp:981 getNextState, :2134-2141)
+  - 3-way initial full sync: send local hashes, peer returns delta +
+    to-be-updated list, initiator finalizes back
+    (ref KvStore.cpp:1838 requestThriftPeerSync, :1974 processThriftSuccess,
+    :3022 finalizeFullSync); parallel-sync limit doubles 2 -> max
+  - incremental flooding with node_ids path-vector loop suppression and
+    rate limiting (ref KvStore.cpp:3155-3290)
+  - TTL countdown + expiry publications (ref KvStore.h:652-656)
+  - self-originated keys: persist + ttl-refresh + version-bump-to-win
+    (ref KvStore.h:48-61,184,304-309,678-698)
+
+Transport is runtime/rpc.py (role of fbthrift KvStoreService). The actor
+consumes peerUpdatesQueue (PeerEvent) and kvRequestQueue (KeyValueRequest),
+publishes Publication | InitializationEvent to kvStoreUpdatesQueue, and
+emits KvStoreSyncEvent to kvStoreEventsQueue (ref Main.cpp:223-266 wiring).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from openr_tpu.config import KvstoreConfig
+from openr_tpu.kvstore.engine import (
+    KvStoreFilters,
+    MergeStats,
+    TtlCountdownQueue,
+    dump_all_with_filters,
+    dump_difference,
+    dump_hash_with_filters,
+    merge_key_values,
+)
+from openr_tpu.messaging import RQueue, ReplicateQueue
+from openr_tpu.runtime.actor import Actor
+from openr_tpu.runtime.counters import counters
+from openr_tpu.runtime.rpc import RpcClient, RpcServer
+from openr_tpu.runtime.throttle import ExponentialBackoff
+from openr_tpu.serde import from_plain, to_plain
+from openr_tpu.types import (
+    AreaPeerEvent,
+    InitializationEvent,
+    KeyValueRequest,
+    KeyValueRequestType,
+    KvStorePeerState,
+    KvStoreSyncEvent,
+    PeerSpec,
+    Publication,
+    TTL_INFINITY,
+    Value,
+    compute_hash,
+)
+
+log = logging.getLogger(__name__)
+
+_PEER_SYNC_BACKOFF_MIN_S = 0.2  # scaled-down ref Constants (4s/256s) for
+_PEER_SYNC_BACKOFF_MAX_S = 10.0  # single-process emulation timescales
+_INITIAL_PARALLEL_SYNCS = 2  # doubles to max on progress (ref KvStore.cpp)
+_TTL_ERASE_MS = 256  # short ttl for unset tombstones
+
+
+@dataclass
+class Peer:
+    """Per-peer session state (ref KvStore.h KvStorePeer :584-627)."""
+
+    node_name: str
+    spec: PeerSpec
+    state: KvStorePeerState = KvStorePeerState.IDLE
+    client: Optional[RpcClient] = None
+    backoff: ExponentialBackoff = field(
+        default_factory=lambda: ExponentialBackoff(
+            _PEER_SYNC_BACKOFF_MIN_S, _PEER_SYNC_BACKOFF_MAX_S
+        )
+    )
+
+
+@dataclass
+class SelfOriginatedValue:
+    """ref KvStore.h:48-61."""
+
+    value: Value
+    persisted: bool = False  # re-advertise-to-win + periodic ttl refresh
+
+
+class KvStoreArea:
+    """Per-area store + peers (ref KvStoreDb, KvStore.h:148)."""
+
+    def __init__(self, area: str, node_name: str, cfg: KvstoreConfig):
+        self.area = area
+        self.node_name = node_name
+        self.cfg = cfg
+        self.kv: dict[str, Value] = {}
+        self.peers: dict[str, Peer] = {}
+        self.self_originated: dict[str, SelfOriginatedValue] = {}
+        self.ttl_queue = TtlCountdownQueue()
+        self.initial_sync_done = False  # all initial peers INITIALIZED
+
+    def hashes(self) -> dict[str, Value]:
+        return dump_hash_with_filters(self.area, self.kv).key_vals
+
+
+class KvStore(Actor):
+    """The distributed-store actor; one RPC server, N areas."""
+
+    def __init__(
+        self,
+        node_name: str,
+        config: KvstoreConfig,
+        areas: list[str],
+        peer_updates_queue: RQueue,
+        kv_request_queue: RQueue,
+        kvstore_updates_queue: ReplicateQueue,
+        kvstore_events_queue: ReplicateQueue,
+        listen_port: int = 0,
+    ):
+        super().__init__(f"kvstore:{node_name}")
+        self.node_name = node_name
+        self.cfg = config
+        self.areas: dict[str, KvStoreArea] = {
+            a: KvStoreArea(a, node_name, config) for a in areas
+        }
+        self._peer_updates = peer_updates_queue
+        self._kv_requests = kv_request_queue
+        self._updates_q = kvstore_updates_queue
+        self._events_q = kvstore_events_queue
+        self._listen_port = listen_port
+        self.server = RpcServer(self.name)
+        self.port: int = 0
+        self._parallel_sync_limit = _INITIAL_PARALLEL_SYNCS
+        self._sync_wakeup = asyncio.Event()
+        self._ttl_wakeup = asyncio.Event()
+        self._flood_tokens = float(config.flood_rate_burst_size or 0)
+        self._flood_tokens_ts = time.monotonic()
+        self._initialized_signalled = False
+        # KVSTORE_SYNCED gates on the initial peer event from LinkMonitor
+        # (ref initialization protocol): an empty initial event means a
+        # standalone node, which is synced trivially.
+        self._initial_peers_received = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def on_start(self) -> None:
+        self.server.register("kvstore.set_key_vals", self._rpc_set_key_vals)
+        self.server.register("kvstore.dump_filtered", self._rpc_dump_filtered)
+        self.server.register("kvstore.dump_hashes", self._rpc_dump_hashes)
+        self.port = await self.server.start(port=self._listen_port)
+        self.add_task(self._peer_updates_loop(), name=f"{self.name}.peers")
+        self.add_task(self._kv_requests_loop(), name=f"{self.name}.requests")
+        self.add_task(self._sync_loop(), name=f"{self.name}.sync")
+        self.add_task(self._ttl_loop(), name=f"{self.name}.ttl")
+        self.add_task(self._ttl_refresh_loop(), name=f"{self.name}.ttl-refresh")
+
+    async def on_stop(self) -> None:
+        await self.server.stop()
+        for area in self.areas.values():
+            for peer in area.peers.values():
+                if peer.client is not None:
+                    await peer.client.close()
+
+    # -- RPC server side ---------------------------------------------------
+
+    async def _rpc_set_key_vals(
+        self, area: str, publication: dict, sender_id: str = ""
+    ) -> dict:
+        """Peer flood / finalize-sync ingress (ref KvStoreDb::setKeyVals)."""
+        pub = from_plain(publication, Publication)
+        pub.area = area
+        counters.increment(f"kvstore.{self.node_name}.thrift.num_flood_pub")
+        self._merge_and_flood(pub, sender_id=sender_id)
+        return {"ok": True}
+
+    async def _rpc_dump_filtered(
+        self,
+        area: str,
+        prefixes: Optional[list] = None,
+        originator_ids: Optional[list] = None,
+        key_val_hashes: Optional[dict] = None,
+    ) -> dict:
+        """Full-sync / filtered dump (ref getKvStoreKeyValsFilteredArea)."""
+        st = self.areas[area]
+        filters = KvStoreFilters(
+            key_prefixes=tuple(prefixes or ()),
+            originator_ids=frozenset(originator_ids or ()),
+        )
+        if key_val_hashes is not None:
+            req_hashes = {
+                k: from_plain(v, Value) for k, v in key_val_hashes.items()
+            }
+            pub = dump_difference(area, st.kv, req_hashes)
+            counters.increment(f"kvstore.{self.node_name}.full_sync_served")
+        else:
+            pub = dump_all_with_filters(area, st.kv, filters)
+        self._decrement_out_ttls(pub)
+        return to_plain(pub)
+
+    async def _rpc_dump_hashes(self, area: str, prefix: str = "") -> dict:
+        st = self.areas[area]
+        filters = KvStoreFilters(key_prefixes=(prefix,) if prefix else ())
+        return to_plain(dump_hash_with_filters(area, st.kv, filters))
+
+    def _decrement_out_ttls(self, pub: Publication) -> None:
+        """Outgoing finite TTLs decay by ttl_decrement_ms so a key cannot
+        circulate forever (ref kTtlDecrement flood semantics)."""
+        dec = self.cfg.ttl_decrement_ms
+        for key in list(pub.key_vals):
+            v = pub.key_vals[key]
+            if v.ttl_ms == TTL_INFINITY:
+                continue
+            remaining = v.ttl_ms - dec
+            if remaining <= 0:
+                del pub.key_vals[key]
+                continue
+            pub.key_vals[key] = Value(
+                version=v.version,
+                originator_id=v.originator_id,
+                value=v.value,
+                ttl_ms=remaining,
+                ttl_version=v.ttl_version,
+                hash=v.hash,
+            )
+
+    # -- merge + publish + flood (ref mergePublication KvStore.cpp:3394) ---
+
+    def _merge_and_flood(self, pub: Publication, sender_id: str = "") -> None:
+        st = self.areas[pub.area]
+        stats = MergeStats()
+        updates = merge_key_values(st.kv, pub.key_vals, stats=stats)
+        counters.increment(
+            f"kvstore.{self.node_name}.updated_key_vals", len(updates)
+        )
+        for key in updates:
+            live = st.kv.get(key)
+            if live is not None:
+                st.ttl_queue.track(key, live)
+        self._resched_ttl()
+
+        # self-originated override protection: if a merged update beat one of
+        # our persisted keys, re-advertise with a bumped version
+        # (ref KvStore.cpp advertiseSelfOriginatedKeys / key-override check)
+        for key in list(updates):
+            own = st.self_originated.get(key)
+            if own is None or not own.persisted:
+                continue
+            live = st.kv[key]
+            if live.originator_id != self.node_name or live.value != own.value.value:
+                self._persist_self_originated(
+                    st, key, own.value.value, own.value.ttl_ms
+                )
+        if not updates and not pub.expired_keys:
+            return
+        out = Publication(
+            key_vals=updates,
+            expired_keys=list(pub.expired_keys),
+            node_ids=list(pub.node_ids),
+            area=pub.area,
+        )
+        self._publish_local(out)
+        if updates:
+            self._flood(st, out, sender_id=sender_id)
+
+    def _publish_local(self, pub: Publication) -> None:
+        self._updates_q.push(pub)
+
+    def _flood(self, st: KvStoreArea, pub: Publication, sender_id: str) -> None:
+        """Fan out to INITIALIZED peers not already on the publication's
+        path (ref floodPublication KvStore.cpp:3155-3290)."""
+        flood = Publication(
+            key_vals=dict(pub.key_vals),
+            node_ids=list(pub.node_ids) + [self.node_name],
+            area=st.area,
+        )
+        self._decrement_out_ttls(flood)
+        if not flood.key_vals:
+            return
+        for peer in st.peers.values():
+            # Flood to INITIALIZED peers, and to SYNCING peers with a live
+            # session: a merge landing between a peer's dump-request and our
+            # sync completion would otherwise never reach it (the 3-way
+            # exchange only covers keys present at dump time). IDLE peers
+            # catch up via the eventual full sync.
+            if peer.state == KvStorePeerState.IDLE or (
+                peer.state == KvStorePeerState.SYNCING and peer.client is None
+            ):
+                continue
+            if peer.node_name == sender_id or peer.node_name in pub.node_ids:
+                continue
+            self.add_task(
+                self._flood_to_peer(st, peer, flood),
+                name=f"{self.name}.flood:{peer.node_name}",
+            )
+
+    async def _flood_to_peer(
+        self, st: KvStoreArea, peer: Peer, pub: Publication
+    ) -> None:
+        await self._flood_rate_limit()
+        if peer.state == KvStorePeerState.IDLE or peer.client is None:
+            return  # peer torn down while we waited
+        try:
+            await peer.client.request(
+                "kvstore.set_key_vals",
+                {
+                    "area": st.area,
+                    "publication": to_plain(pub),
+                    "sender_id": self.node_name,
+                },
+            )
+            counters.increment(f"kvstore.{self.node_name}.thrift.num_flood_sent")
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            # transport failure resets the peer to IDLE for re-sync
+            # (ref processThriftFailure KvStore.cpp:2134-2141)
+            log.info(
+                "%s: flood to %s failed: %s", self.name, peer.node_name, e
+            )
+            self._reset_peer(st, peer)
+
+    async def _flood_rate_limit(self) -> None:
+        """Token bucket (ref flood rate-limit + buffered batch)."""
+        rate = self.cfg.flood_rate_msgs_per_sec
+        if rate <= 0:
+            return
+        burst = max(1.0, float(self.cfg.flood_rate_burst_size or 1))
+        while True:
+            now = time.monotonic()
+            self._flood_tokens = min(
+                burst, self._flood_tokens + (now - self._flood_tokens_ts) * rate
+            )
+            self._flood_tokens_ts = now
+            if self._flood_tokens >= 1.0:
+                self._flood_tokens -= 1.0
+                return
+            await asyncio.sleep((1.0 - self._flood_tokens) / rate)
+
+    # -- peer management + sync FSM ----------------------------------------
+
+    async def _peer_updates_loop(self) -> None:
+        while True:
+            event = await self._peer_updates.get()
+            for area, area_event in event.items():
+                if not isinstance(area_event, AreaPeerEvent):
+                    area_event = from_plain(area_event, AreaPeerEvent)
+                self._handle_peer_event(area, area_event)
+
+    def _handle_peer_event(self, area: str, ev: AreaPeerEvent) -> None:
+        st = self.areas.get(area)
+        if st is None:
+            log.warning("%s: peer event for unknown area %r", self.name, area)
+            return
+        for name in ev.peers_to_del:
+            peer = st.peers.pop(name, None)
+            if peer is not None and peer.client is not None:
+                self.add_task(
+                    peer.client.close(), name=f"{self.name}.close:{name}"
+                )
+        for name, spec in ev.peers_to_add.items():
+            existing = st.peers.get(name)
+            if existing is not None and existing.spec == spec:
+                continue
+            if existing is not None and existing.client is not None:
+                self.add_task(
+                    existing.client.close(), name=f"{self.name}.close:{name}"
+                )
+            st.peers[name] = Peer(node_name=name, spec=spec)
+            counters.increment(f"kvstore.{self.node_name}.peers_added")
+        self._initial_peers_received = True
+        self._sync_wakeup.set()
+        self._maybe_signal_initial_sync()  # empty initial event => synced
+
+    def _reset_peer(self, st: KvStoreArea, peer: Peer) -> None:
+        if st.peers.get(peer.node_name) is not peer:
+            return
+        peer.state = KvStorePeerState.IDLE
+        peer.backoff.report_error()
+        if peer.client is not None:
+            client, peer.client = peer.client, None
+            self.add_task(
+                client.close(), name=f"{self.name}.close:{peer.node_name}"
+            )
+        self._sync_wakeup.set()
+
+    async def _sync_loop(self) -> None:
+        """Drive IDLE peers through full sync, bounded by the parallel-sync
+        limit which doubles on progress (ref requestSync KvStore.cpp)."""
+        in_flight: set[str] = set()
+
+        while True:
+            self._sync_wakeup.clear()
+            idle = [
+                (st, p)
+                for st in self.areas.values()
+                for p in st.peers.values()
+                if p.state == KvStorePeerState.IDLE
+                and p.node_name not in in_flight
+            ]
+            started = False
+            for st, peer in idle:
+                if len(in_flight) >= self._parallel_sync_limit:
+                    break
+                if not peer.backoff.can_try_now():
+                    continue
+                peer.state = KvStorePeerState.SYNCING
+                in_flight.add(peer.node_name)
+                started = True
+
+                async def run_sync(st=st, peer=peer):
+                    try:
+                        await self._full_sync(st, peer)
+                    finally:
+                        in_flight.discard(peer.node_name)
+                        self._sync_wakeup.set()
+
+                self.add_task(
+                    run_sync(), name=f"{self.name}.sync:{peer.node_name}"
+                )
+            if started:
+                continue
+            # Nothing startable: wait for wakeup, or the earliest backoff
+            # retry. Peers blocked only by the concurrency cap have no
+            # timeout of their own — a sync completion sets the wakeup.
+            at_capacity = len(in_flight) >= self._parallel_sync_limit
+            delays = [
+                p.backoff.time_until_retry_s()
+                for st in self.areas.values()
+                for p in st.peers.values()
+                if p.state == KvStorePeerState.IDLE
+                and p.node_name not in in_flight
+                and not p.backoff.can_try_now()
+            ] if not at_capacity else []
+            timeout = min(delays) if delays else None
+            try:
+                await asyncio.wait_for(
+                    self._sync_wakeup.wait(),
+                    None if timeout is None else max(0.01, timeout),
+                )
+            except asyncio.TimeoutError:
+                pass
+
+    async def _full_sync(self, st: KvStoreArea, peer: Peer) -> None:
+        """3-way full sync, initiator side (ref requestThriftPeerSync
+        KvStore.cpp:1838, processThriftSuccess :1974, finalizeFullSync
+        :3022)."""
+        t0 = time.monotonic()
+        try:
+            if peer.client is None:
+                peer.client = RpcClient(
+                    peer.spec.peer_addr,
+                    peer.spec.ctrl_port,
+                    name=f"{self.node_name}->{peer.node_name}",
+                )
+            hashes = {k: to_plain(v) for k, v in st.hashes().items()}
+            resp = await peer.client.request(
+                "kvstore.dump_filtered",
+                {"area": st.area, "key_val_hashes": hashes},
+            )
+            pub = from_plain(resp, Publication)
+            # merge peer's better values; flood onward (we are now part of
+            # the flood topology for these updates)
+            self._merge_and_flood(
+                Publication(
+                    key_vals=pub.key_vals,
+                    node_ids=[peer.node_name],
+                    area=st.area,
+                ),
+                sender_id=peer.node_name,
+            )
+            # finalize: send back full values for keys where ours is better
+            finalize = {
+                k: st.kv[k] for k in pub.to_be_updated_keys if k in st.kv
+            }
+            if finalize:
+                fin_pub = Publication(
+                    key_vals=dict(finalize),
+                    node_ids=[self.node_name],
+                    area=st.area,
+                )
+                self._decrement_out_ttls(fin_pub)
+                await peer.client.request(
+                    "kvstore.set_key_vals",
+                    {
+                        "area": st.area,
+                        "publication": to_plain(fin_pub),
+                        "sender_id": self.node_name,
+                    },
+                )
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            log.info(
+                "%s: full sync with %s failed: %s", self.name, peer.node_name, e
+            )
+            counters.increment(f"kvstore.{self.node_name}.full_sync_failure")
+            self._reset_peer(st, peer)
+            return
+
+        if st.peers.get(peer.node_name) is not peer:
+            return  # peer replaced mid-sync
+        peer.state = KvStorePeerState.INITIALIZED
+        peer.backoff.report_success()
+        self._parallel_sync_limit = min(
+            self.cfg.max_parallel_initial_syncs, self._parallel_sync_limit * 2
+        )
+        counters.increment(f"kvstore.{self.node_name}.full_sync_success")
+        counters.add_stat_value(
+            f"kvstore.{self.node_name}.full_sync_ms",
+            (time.monotonic() - t0) * 1e3,
+        )
+        self._events_q.push(KvStoreSyncEvent(peer.node_name, st.area))
+        self._maybe_signal_initial_sync()
+
+    def _maybe_signal_initial_sync(self) -> None:
+        """Emit KVSTORE_SYNCED once every configured peer reached
+        INITIALIZED (ref initialization gating, KvStore.cpp
+        processInitializationEvent)."""
+        if self._initialized_signalled or not self._initial_peers_received:
+            return
+        for st in self.areas.values():
+            for p in st.peers.values():
+                if p.state != KvStorePeerState.INITIALIZED:
+                    return
+        self._initialized_signalled = True
+        self._updates_q.push(InitializationEvent.KVSTORE_SYNCED)
+
+    # -- self-originated keys (ref KvStore.h:304-309) ----------------------
+
+    async def _kv_requests_loop(self) -> None:
+        while True:
+            req = await self._kv_requests.get()
+            self.process_key_value_request(req)
+
+    def process_key_value_request(self, req: KeyValueRequest) -> None:
+        st = self.areas.get(req.area)
+        if st is None:
+            log.warning(
+                "%s: key-value request for unknown area %r", self.name, req.area
+            )
+            return
+        if req.request_type == KeyValueRequestType.PERSIST:
+            self._persist_self_originated(
+                st, req.key, req.value, req.set_ttl or self.cfg.key_ttl_ms
+            )
+        elif req.request_type == KeyValueRequestType.SET:
+            self._set_self_originated(
+                st,
+                req.key,
+                req.value,
+                req.version,
+                req.set_ttl or self.cfg.key_ttl_ms,
+            )
+        elif req.request_type == KeyValueRequestType.CLEAR:
+            self._unset_self_originated(st, req.key, req.value)
+
+    def _persist_self_originated(
+        self,
+        st: KvStoreArea,
+        key: str,
+        value: Optional[bytes],
+        ttl_ms: int,
+        min_version: int = 1,
+    ) -> None:
+        """Advertise + own the key: version-bump to beat any existing value
+        (ref persistSelfOriginatedKey)."""
+        existing = st.kv.get(key)
+        version = min_version
+        if existing is not None:
+            if (
+                existing.originator_id == self.node_name
+                and existing.value == value
+            ):
+                version = max(existing.version, min_version)  # ours, unchanged
+            else:
+                version = max(existing.version + 1, min_version)
+        new_val = Value(
+            version=version,
+            originator_id=self.node_name,
+            value=value,
+            ttl_ms=ttl_ms,
+            ttl_version=0,
+        )
+        st.self_originated[key] = SelfOriginatedValue(new_val, persisted=True)
+        self._merge_and_flood(
+            Publication(key_vals={key: new_val}, area=st.area)
+        )
+
+    def _set_self_originated(
+        self,
+        st: KvStoreArea,
+        key: str,
+        value: Optional[bytes],
+        version: Optional[int],
+        ttl_ms: int,
+    ) -> None:
+        """One-shot set: ttl-refreshed but not defended
+        (ref setSelfOriginatedKey)."""
+        if version is None:
+            existing = st.kv.get(key)
+            version = (existing.version + 1) if existing is not None else 1
+        new_val = Value(
+            version=version,
+            originator_id=self.node_name,
+            value=value,
+            ttl_ms=ttl_ms,
+            ttl_version=0,
+        )
+        st.self_originated[key] = SelfOriginatedValue(new_val, persisted=False)
+        self._merge_and_flood(
+            Publication(key_vals={key: new_val}, area=st.area)
+        )
+
+    def _unset_self_originated(
+        self, st: KvStoreArea, key: str, tombstone: Optional[bytes]
+    ) -> None:
+        """Stop defending + advertise a short-ttl tombstone so the key ages
+        out network-wide (ref unsetSelfOriginatedKey)."""
+        st.self_originated.pop(key, None)
+        existing = st.kv.get(key)
+        version = (existing.version + 1) if existing is not None else 1
+        new_val = Value(
+            version=version,
+            originator_id=self.node_name,
+            value=tombstone if tombstone is not None else b"",
+            ttl_ms=_TTL_ERASE_MS,
+            ttl_version=0,
+        )
+        self._merge_and_flood(
+            Publication(key_vals={key: new_val}, area=st.area)
+        )
+
+    async def _ttl_refresh_loop(self) -> None:
+        """Periodically bump ttl_version on finite-ttl self-originated keys
+        (ref advertiseTtlUpdates KvStore.h:512; refresh at ttl/4)."""
+        while True:
+            interval = max(0.05, self.cfg.key_ttl_ms / 1e3 / 4)
+            await asyncio.sleep(interval)
+            for st in self.areas.values():
+                refresh: dict[str, Value] = {}
+                for key, own in st.self_originated.items():
+                    if own.value.ttl_ms == TTL_INFINITY:
+                        continue
+                    live = st.kv.get(key)
+                    if live is None or live.originator_id != self.node_name:
+                        continue  # lost ownership; persist path defends
+                    own.value.ttl_version = live.ttl_version + 1
+                    refresh[key] = Value(
+                        version=live.version,
+                        originator_id=self.node_name,
+                        value=None,  # ttl-only advertisement
+                        ttl_ms=own.value.ttl_ms,
+                        ttl_version=live.ttl_version + 1,
+                        hash=live.hash,
+                    )
+                if refresh:
+                    self._merge_and_flood(
+                        Publication(key_vals=refresh, area=st.area)
+                    )
+
+    # -- TTL expiry --------------------------------------------------------
+
+    def _resched_ttl(self) -> None:
+        """New TTL entries may expire sooner than the current sleep."""
+        self._ttl_wakeup.set()
+
+    async def _ttl_loop(self) -> None:
+        while True:
+            delays = [
+                st.ttl_queue.next_expiry_in_s() for st in self.areas.values()
+            ]
+            delays = [d for d in delays if d is not None]
+            timeout = min(delays) if delays else None
+            try:
+                await asyncio.wait_for(
+                    self._ttl_wakeup.wait(),
+                    None if timeout is None else max(0.01, timeout),
+                )
+                self._ttl_wakeup.clear()
+                continue  # new entries tracked; recompute earliest expiry
+            except asyncio.TimeoutError:
+                pass
+            for st in self.areas.values():
+                expired = st.ttl_queue.expire(st.kv)
+                if not expired:
+                    continue
+                # A persisted self-originated key that expired locally (e.g.
+                # the refresh tick was starved past ttl) must be defended,
+                # not dropped: re-advertise it immediately.
+                reported: list[str] = []
+                for key in expired:
+                    own = st.self_originated.get(key)
+                    if own is not None and own.persisted:
+                        # min_version beats copies of the expired incarnation
+                        # other stores may still hold
+                        self._persist_self_originated(
+                            st,
+                            key,
+                            own.value.value,
+                            own.value.ttl_ms,
+                            min_version=own.value.version + 1,
+                        )
+                    else:
+                        st.self_originated.pop(key, None)
+                        reported.append(key)
+                counters.increment(
+                    f"kvstore.{self.node_name}.expired_keys", len(reported)
+                )
+                if reported:
+                    # expiry publications are local-only: every store ages
+                    # keys independently (ref KvStore.cpp cleanup)
+                    self._publish_local(
+                        Publication(expired_keys=reported, area=st.area)
+                    )
+
+    # -- module API (role of semifuture_* KvStore.h:774-840) ---------------
+
+    async def get_key_vals(self, area: str, keys: list[str]) -> dict[str, Value]:
+        st = self.areas[area]
+        return {k: st.kv[k] for k in keys if k in st.kv}
+
+    async def dump_all(
+        self, area: str, prefix: str = ""
+    ) -> dict[str, Value]:
+        st = self.areas[area]
+        filters = KvStoreFilters(key_prefixes=(prefix,) if prefix else ())
+        return dump_all_with_filters(area, st.kv, filters).key_vals
+
+    async def set_key_vals(self, area: str, key_vals: dict[str, Value]) -> None:
+        """Locally-originated write (ctrl API path)."""
+        self._merge_and_flood(Publication(key_vals=dict(key_vals), area=area))
+
+    def get_peers(self, area: str) -> dict[str, PeerSpec]:
+        st = self.areas[area]
+        return {
+            name: PeerSpec(
+                peer_addr=p.spec.peer_addr,
+                ctrl_port=p.spec.ctrl_port,
+                state=p.state,
+            )
+            for name, p in st.peers.items()
+        }

@@ -1,0 +1,158 @@
+"""In-process multi-instance KvStore test harness.
+
+Role of the reference's openr/kvstore/KvStoreWrapper.{h,cpp}: run real
+KvStore actors in one process, peered over real TCP on localhost — multi-node
+behavior without a cluster. Tests and the N-node system wrapper
+(tests/test_system.py) both build on this.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+from openr_tpu.config import KvstoreConfig
+from openr_tpu.kvstore.kvstore import KvStore
+from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.types import (
+    AreaPeerEvent,
+    KeyValueRequest,
+    KeyValueRequestType,
+    KvStorePeerState,
+    PeerSpec,
+    Publication,
+    Value,
+)
+
+
+class KvStoreWrapper:
+    """One node's KvStore + its queues, with sync/peering helpers."""
+
+    def __init__(
+        self,
+        node_name: str,
+        areas: Optional[list[str]] = None,
+        config: Optional[KvstoreConfig] = None,
+    ):
+        self.node_name = node_name
+        self.areas = areas or ["0"]
+        self.peer_updates_queue = ReplicateQueue(f"{node_name}.peerUpdates")
+        self.kv_request_queue = ReplicateQueue(f"{node_name}.kvRequests")
+        self.updates_queue = ReplicateQueue(f"{node_name}.kvStoreUpdates")
+        self.events_queue = ReplicateQueue(f"{node_name}.kvStoreEvents")
+        self.store = KvStore(
+            node_name,
+            config or KvstoreConfig(),
+            self.areas,
+            self.peer_updates_queue.get_reader(),
+            self.kv_request_queue.get_reader(),
+            self.updates_queue,
+            self.events_queue,
+        )
+        # test-facing reader created before start so no update is missed
+        self.updates_reader = self.updates_queue.get_reader("test")
+
+    async def start(self) -> None:
+        await self.store.start()
+
+    async def stop(self) -> None:
+        self.updates_queue.close()
+        self.events_queue.close()
+        await self.store.stop()
+
+    @property
+    def port(self) -> int:
+        return self.store.port
+
+    def peer_spec(self) -> PeerSpec:
+        return PeerSpec(peer_addr="127.0.0.1", ctrl_port=self.port)
+
+    def add_peer(self, other: "KvStoreWrapper", area: str = "0") -> None:
+        self.peer_updates_queue.push(
+            {area: AreaPeerEvent(peers_to_add={other.node_name: other.peer_spec()})}
+        )
+
+    def del_peer(self, other_name: str, area: str = "0") -> None:
+        self.peer_updates_queue.push(
+            {area: AreaPeerEvent(peers_to_del=(other_name,))}
+        )
+
+    def set_key(
+        self,
+        key: str,
+        value: bytes,
+        version: int = 1,
+        originator: Optional[str] = None,
+        ttl_ms: int = -1,
+        area: str = "0",
+    ) -> None:
+        """Inject a key directly (role of KvStoreWrapper::setKey)."""
+        self.store._merge_and_flood(
+            Publication(
+                key_vals={
+                    key: Value(
+                        version=version,
+                        originator_id=originator or self.node_name,
+                        value=value,
+                        ttl_ms=ttl_ms,
+                    )
+                },
+                area=area,
+            )
+        )
+
+    def persist_key(self, key: str, value: bytes, area: str = "0",
+                    ttl_ms: Optional[int] = None) -> None:
+        self.kv_request_queue.push(
+            KeyValueRequest(
+                request_type=KeyValueRequestType.PERSIST,
+                area=area,
+                key=key,
+                value=value,
+                set_ttl=ttl_ms,
+            )
+        )
+
+    def get_key(self, key: str, area: str = "0") -> Optional[Value]:
+        return self.store.areas[area].kv.get(key)
+
+    def dump(self, area: str = "0") -> dict[str, Value]:
+        return dict(self.store.areas[area].kv)
+
+    def peer_state(
+        self, peer_name: str, area: str = "0"
+    ) -> Optional[KvStorePeerState]:
+        peer = self.store.areas[area].peers.get(peer_name)
+        return peer.state if peer else None
+
+
+async def wait_until(
+    predicate, timeout_s: float = 5.0, interval_s: float = 0.01
+) -> None:
+    """Await a condition with deadline; raises AssertionError on timeout."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        await asyncio.sleep(interval_s)
+    raise AssertionError(f"condition not met within {timeout_s}s")
+
+
+async def wait_converged(
+    wrappers: list[KvStoreWrapper], area: str = "0", timeout_s: float = 10.0
+) -> None:
+    """Wait until every store holds an identical key->(version, originator,
+    hash) map."""
+
+    def converged() -> bool:
+        dumps = [
+            {
+                k: (v.version, v.originator_id, v.hash)
+                for k, v in w.dump(area).items()
+            }
+            for w in wrappers
+        ]
+        return all(d == dumps[0] for d in dumps[1:])
+
+    await wait_until(converged, timeout_s)

@@ -118,6 +118,11 @@ class Actor:
             runner(), name=name or f"{self.name}.task"
         )
         self._tasks.append(task)
+        # Prune on completion: short-lived tasks (per-publication floods,
+        # client closes) must not accumulate for the actor's lifetime.
+        task.add_done_callback(
+            lambda t: self._tasks.remove(t) if t in self._tasks else None
+        )
         return task
 
     def make_timer(self, callback: Callable[[], Any]) -> Timer:

@@ -1,0 +1,313 @@
+"""Minimal asyncio JSON-RPC over TCP — the host-side inter-node substrate.
+
+Role of the reference's fbthrift async RPC (KvStoreService KvStore.thrift:698,
+OpenrCtrl.thrift:246, FibService Platform.thrift:170): request/response with
+per-connection multiplexing. We deliberately re-express it as
+newline-delimited JSON frames over asyncio TCP — debuggable, dependency-free,
+and fast enough for a control plane (the hot compute path never touches this
+layer; it is host<->device, ops/csr.py).
+
+Frame format (one JSON object per line):
+  request:  {"id": n, "method": "name", "params": {...}}
+  response: {"id": n, "result": ...} | {"id": n, "error": "msg"}
+
+Streaming (server push, role of thrift server-streaming subscriptions,
+OpenrCtrlHandler.h:351-389): a server method may return a Stream handle; the
+server then pushes {"id": n, "stream": item} frames until the stream closes
+with {"id": n, "done": true}.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import logging
+from typing import Any, Awaitable, Callable, Optional
+
+log = logging.getLogger(__name__)
+
+_MAX_FRAME = 256 * 1024 * 1024  # generous: full-sync dumps can be large
+
+
+class RpcError(RuntimeError):
+    """Remote handler raised; carries the remote error message."""
+
+
+class RpcConnectionError(ConnectionError):
+    """Transport failure (peer unreachable / connection dropped)."""
+
+
+class Stream:
+    """Server-side handle returned by a streaming method: the handler
+    registers a queue-feeding callback; the server forwards pushed items."""
+
+    def __init__(self) -> None:
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self.closed = False
+
+    def push(self, item: Any) -> None:
+        if not self.closed:
+            self._queue.put_nowait(item)
+
+    def close(self) -> None:
+        if not self.closed:
+            self.closed = True
+            self._queue.put_nowait(None)
+
+    async def _next(self) -> Optional[Any]:
+        return await self._queue.get()
+
+
+Handler = Callable[..., Awaitable[Any]]
+
+
+class RpcServer:
+    """Dispatches registered async handlers; one asyncio task per
+    connection, one per in-flight streaming response."""
+
+    def __init__(self, name: str = "rpc"):
+        self.name = name
+        self._handlers: dict[str, Handler] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._conn_tasks: set[asyncio.Task] = set()
+
+    def register(self, method: str, handler: Handler) -> None:
+        self._handlers[method] = handler
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._server = await asyncio.start_server(
+            self._handle_conn, host, port, limit=_MAX_FRAME
+        )
+        return self._server.sockets[0].getsockname()[1]
+
+    @property
+    def port(self) -> int:
+        assert self._server is not None
+        return self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        # Cancel connection handlers BEFORE wait_closed(): since py3.12
+        # wait_closed() waits for all handlers, and ours block in readline()
+        # until their connection drops.
+        for t in list(self._conn_tasks):
+            t.cancel()
+        for t in list(self._conn_tasks):
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._conn_tasks.clear()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _handle_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        task = asyncio.current_task()
+        if task is not None:
+            self._conn_tasks.add(task)
+            task.add_done_callback(self._conn_tasks.discard)
+        streams: set[asyncio.Task] = set()
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break
+                try:
+                    frame = json.loads(line)
+                except json.JSONDecodeError:
+                    log.warning("%s: malformed frame, closing conn", self.name)
+                    break
+                t = asyncio.get_running_loop().create_task(
+                    self._dispatch(frame, writer)
+                )
+                streams.add(t)
+                t.add_done_callback(streams.discard)
+        except (ConnectionResetError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            for t in list(streams):
+                t.cancel()
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _dispatch(self, frame: dict, writer: asyncio.StreamWriter) -> None:
+        req_id = frame.get("id")
+        method = frame.get("method", "")
+        handler = self._handlers.get(method)
+        try:
+            if handler is None:
+                raise RpcError(f"unknown method {method!r}")
+            result = await handler(**(frame.get("params") or {}))
+            if isinstance(result, Stream):
+                await self._pump_stream(req_id, result, writer)
+                return
+            out = {"id": req_id, "result": result}
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — error goes back to caller
+            out = {"id": req_id, "error": f"{type(e).__name__}: {e}"}
+        await self._send(out, writer)
+
+    async def _pump_stream(
+        self, req_id: Any, stream: Stream, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            while True:
+                item = await stream._next()
+                if item is None and stream.closed:
+                    await self._send({"id": req_id, "done": True}, writer)
+                    return
+                await self._send({"id": req_id, "stream": item}, writer)
+        finally:
+            stream.close()
+
+    async def _send(self, obj: dict, writer: asyncio.StreamWriter) -> None:
+        try:
+            writer.write(json.dumps(obj, separators=(",", ":")).encode() + b"\n")
+            await writer.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+
+
+class RpcClient:
+    """One connection to a peer server; concurrent requests multiplex over
+    it by id. Connection failures surface as RpcConnectionError — the
+    caller's FSM/backoff owns retry policy (ref KvStore.cpp:2134-2141)."""
+
+    def __init__(self, host: str, port: int, name: str = ""):
+        self.host = host
+        self.port = port
+        self.name = name or f"{host}:{port}"
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._pending: dict[int, asyncio.Future] = {}
+        self._stream_queues: dict[int, asyncio.Queue] = {}
+        self._ids = itertools.count(1)
+        self._read_task: Optional[asyncio.Task] = None
+        self._lock = asyncio.Lock()
+
+    @property
+    def connected(self) -> bool:
+        return self._writer is not None
+
+    async def connect(self, timeout_s: float = 5.0) -> None:
+        async with self._lock:
+            if self._writer is not None:
+                return
+            try:
+                self._reader, self._writer = await asyncio.wait_for(
+                    asyncio.open_connection(
+                        self.host, self.port, limit=_MAX_FRAME
+                    ),
+                    timeout_s,
+                )
+            except (OSError, asyncio.TimeoutError) as e:
+                raise RpcConnectionError(f"{self.name}: connect failed: {e}")
+            self._read_task = asyncio.get_running_loop().create_task(
+                self._read_loop(), name=f"rpc-client:{self.name}"
+            )
+
+    async def close(self) -> None:
+        async with self._lock:
+            self._teardown(RpcConnectionError(f"{self.name}: closed"))
+            if self._read_task is not None:
+                self._read_task.cancel()
+                try:
+                    await self._read_task
+                except (asyncio.CancelledError, Exception):
+                    pass
+                self._read_task = None
+
+    def _teardown(self, err: Exception) -> None:
+        if self._writer is not None:
+            self._writer.close()
+        self._writer = None
+        self._reader = None
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(err)
+        self._pending.clear()
+        for q in self._stream_queues.values():
+            q.put_nowait(err)
+        self._stream_queues.clear()
+
+    async def _read_loop(self) -> None:
+        assert self._reader is not None
+        reader = self._reader
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break
+                frame = json.loads(line)
+                req_id = frame.get("id")
+                if "stream" in frame or frame.get("done"):
+                    q = self._stream_queues.get(req_id)
+                    if q is not None:
+                        q.put_nowait(
+                            None if frame.get("done") else frame["stream"]
+                        )
+                        if frame.get("done"):
+                            self._stream_queues.pop(req_id, None)
+                    continue
+                fut = self._pending.pop(req_id, None)
+                if fut is None or fut.done():
+                    continue
+                if "error" in frame:
+                    fut.set_exception(RpcError(frame["error"]))
+                else:
+                    fut.set_result(frame.get("result"))
+        except (ConnectionResetError, asyncio.IncompleteReadError):
+            pass
+        except asyncio.CancelledError:
+            return
+        finally:
+            self._teardown(RpcConnectionError(f"{self.name}: connection lost"))
+
+    async def request(
+        self, method: str, params: Optional[dict] = None, timeout_s: float = 30.0
+    ) -> Any:
+        await self.connect()
+        assert self._writer is not None
+        req_id = next(self._ids)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[req_id] = fut
+        frame = {"id": req_id, "method": method, "params": params or {}}
+        try:
+            self._writer.write(
+                json.dumps(frame, separators=(",", ":")).encode() + b"\n"
+            )
+            await self._writer.drain()
+        except (ConnectionResetError, BrokenPipeError, AttributeError) as e:
+            self._pending.pop(req_id, None)
+            self._teardown(RpcConnectionError(f"{self.name}: send failed"))
+            raise RpcConnectionError(f"{self.name}: send failed: {e}")
+        try:
+            return await asyncio.wait_for(fut, timeout_s)
+        except asyncio.TimeoutError:
+            self._pending.pop(req_id, None)
+            raise RpcConnectionError(f"{self.name}: {method} timed out")
+
+    async def subscribe(
+        self, method: str, params: Optional[dict] = None
+    ) -> "asyncio.Queue":
+        """Start a server-push stream; returns a queue yielding items,
+        None on clean end, or an Exception instance on transport failure."""
+        await self.connect()
+        assert self._writer is not None
+        req_id = next(self._ids)
+        q: asyncio.Queue = asyncio.Queue()
+        self._stream_queues[req_id] = q
+        frame = {"id": req_id, "method": method, "params": params or {}}
+        self._writer.write(
+            json.dumps(frame, separators=(",", ":")).encode() + b"\n"
+        )
+        await self._writer.drain()
+        return q
