@@ -1,0 +1,376 @@
+"""Decision actor — route computation orchestration.
+
+Role of the reference's openr/decision/Decision.{h,cpp} (:130):
+
+  - consumes KvStore publications (kvStoreUpdatesQueue), parses "adj:" /
+    "prefix:" keys into per-area LinkState + global PrefixState
+    (ref Decision.cpp:731,743,767 updateKeyInLsdb/processPublication)
+  - applies the ordered cold-boot adjacency filter: an adjacency marked
+    adj_only_used_by_other_node is visible only to that other node
+    (ref Decision.cpp:567-644)
+  - batches via DecisionPendingUpdates + AsyncDebounce (debounce_min..max)
+    (ref Decision.h:40-108,328)
+  - full rebuild vs per-prefix incremental (ref rebuildRoutes :919-996)
+  - initialization gating: first route build waits for KVSTORE_SYNCED
+    (ref unblockInitialRoutesBuild :998-1016)
+  - applies RibPolicy, emits DecisionRouteUpdate FULL_SYNC/INCREMENTAL to
+    routeUpdatesQueue; consumes static routes from PrefixManager
+    (staticRouteUpdatesQueue, ref processStaticRoutesUpdate :873)
+  - runtime-selectable solver backend: "cpu" (SpfSolver oracle) or "tpu"
+    (batched JAX pipeline) behind the same build_route_db interface — the
+    DecisionTpuPlugin boundary (ref openr/plugin/Plugin.h:19-44).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from openr_tpu.config import DecisionConfig
+from openr_tpu.decision.link_state import LinkState, LinkStateChange
+from openr_tpu.decision.prefix_state import PrefixState
+from openr_tpu.decision.rib import (
+    DecisionRouteDb,
+    DecisionRouteUpdate,
+    RouteUpdateType,
+)
+from openr_tpu.decision.rib_policy import RibPolicy
+from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.messaging import RQueue, ReplicateQueue
+from openr_tpu.runtime.actor import Actor
+from openr_tpu.runtime.counters import counters
+from openr_tpu.runtime.throttle import AsyncDebounce
+from openr_tpu.serde import deserialize
+from openr_tpu.types import (
+    Adjacency,
+    AdjacencyDatabase,
+    InitializationEvent,
+    PerfEvents,
+    PrefixDatabase,
+    PrefixEntry,
+    Publication,
+    add_perf_event,
+    parse_adj_key,
+    parse_prefix_key,
+    replace,
+)
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class PendingUpdates:
+    """Batched dirty state between debounced rebuilds
+    (ref DecisionPendingUpdates, Decision.h:40-108)."""
+
+    needs_full_rebuild: bool = False
+    updated_prefixes: set[str] = field(default_factory=set)
+    count: int = 0
+    perf_events: Optional[PerfEvents] = None
+
+    def apply_link_state_change(
+        self, change: LinkStateChange, node_name: str
+    ) -> None:
+        self.count += 1
+        if change.topology_changed or change.link_attributes_changed:
+            self.needs_full_rebuild = True
+
+    def apply_prefix_changes(self, changed: set[str]) -> None:
+        if changed:
+            self.count += 1
+            self.updated_prefixes |= changed
+
+    def reset(self) -> None:
+        self.needs_full_rebuild = False
+        self.updated_prefixes = set()
+        self.count = 0
+        self.perf_events = None
+
+
+def make_solver(node_name: str, backend: str, **kwargs):
+    """The solver-backend hook (role of the plugin boundary)."""
+    if backend == "cpu":
+        return SpfSolver(node_name, **kwargs)
+    if backend in ("tpu", "auto"):
+        try:
+            from openr_tpu.decision.tpu_solver import TpuSpfSolver
+
+            return TpuSpfSolver(node_name, **kwargs)
+        except Exception:
+            if backend == "tpu":
+                raise
+            log.warning("tpu solver unavailable; falling back to cpu")
+            return SpfSolver(node_name, **kwargs)
+    raise ValueError(f"unknown solver backend {backend!r}")
+
+
+class Decision(Actor):
+    """ref Decision.h:130."""
+
+    def __init__(
+        self,
+        node_name: str,
+        config: DecisionConfig,
+        kvstore_updates_queue: RQueue,
+        static_routes_queue: Optional[RQueue],
+        route_updates_queue: ReplicateQueue,
+        solver_backend: Optional[str] = None,
+        solver_kwargs: Optional[dict] = None,
+    ):
+        super().__init__(f"decision:{node_name}")
+        self.node_name = node_name
+        self.cfg = config
+        self._kvstore_updates = kvstore_updates_queue
+        self._static_routes = static_routes_queue
+        self._route_updates_q = route_updates_queue
+
+        self.area_link_states: dict[str, LinkState] = {}
+        self.prefix_state = PrefixState()
+        backend = solver_backend or config.solver_backend
+        self.solver = make_solver(node_name, backend, **(solver_kwargs or {}))
+        self.rib_policy: Optional[RibPolicy] = None
+
+        self.pending = PendingUpdates()
+        self.route_db = DecisionRouteDb()
+        # gate: no route computation until KvStore initial sync completes
+        # (ref initialKvStoreSynced_, Decision.cpp:998-1016)
+        self._kvstore_synced = False
+        self._first_build_done = False
+        self._rebuild_debounced = None  # created on start (needs loop)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def on_start(self) -> None:
+        self._rebuild_debounced = AsyncDebounce(
+            self.cfg.debounce_min_ms / 1e3,
+            self.cfg.debounce_max_ms / 1e3,
+            self.rebuild_routes,
+        )
+        self.add_task(self._kvstore_loop(), name=f"{self.name}.kvstore")
+        if self._static_routes is not None:
+            self.add_task(self._static_loop(), name=f"{self.name}.static")
+
+    async def on_stop(self) -> None:
+        if self._rebuild_debounced is not None:
+            self._rebuild_debounced.cancel()
+
+    # -- queue consumption -------------------------------------------------
+
+    async def _kvstore_loop(self) -> None:
+        while True:
+            item = await self._kvstore_updates.get()
+            if isinstance(item, Publication):
+                self.process_publication(item)
+            elif item == InitializationEvent.KVSTORE_SYNCED:
+                self._kvstore_synced = True
+                # initial build: force a full rebuild now that the LSDB is
+                # complete (ref unblockInitialRoutesBuild)
+                self.pending.needs_full_rebuild = True
+                self._trigger_rebuild()
+
+    async def _static_loop(self) -> None:
+        while True:
+            update = await self._static_routes.get()
+            self.process_static_routes_update(update)
+
+    def process_static_routes_update(self, update: DecisionRouteUpdate) -> None:
+        """PrefixManager-sourced static routes (ref Decision.cpp:873)."""
+        self.solver.update_static_unicast_routes(
+            update.unicast_routes_to_update, update.unicast_routes_to_delete
+        )
+        self.pending.apply_prefix_changes(
+            set(update.unicast_routes_to_update)
+            | set(update.unicast_routes_to_delete)
+        )
+        self._trigger_rebuild()
+
+    # -- publication parsing (ref Decision.cpp:731-844) --------------------
+
+    def process_publication(self, pub: Publication) -> None:
+        area = pub.area
+        for key, value in pub.key_vals.items():
+            if value.value is None:
+                continue  # ttl refresh only
+            self._update_key_in_lsdb(area, key, value.value)
+        for key in pub.expired_keys:
+            self._delete_key_from_lsdb(area, key)
+        if self.pending.count > 0:
+            self._trigger_rebuild()
+
+    def _update_key_in_lsdb(self, area: str, key: str, raw: bytes) -> None:
+        if not raw:
+            # erase tombstone (KvStore unset): carries no database; the
+            # actual withdrawal arrives via key expiry
+            return
+        node = parse_adj_key(key)
+        if node is not None:
+            try:
+                adj_db = deserialize(raw, AdjacencyDatabase)
+            except Exception:
+                log.exception("%s: bad adj db for %s", self.name, key)
+                return
+            self._update_adjacency_db(area, adj_db)
+            return
+        parsed = parse_prefix_key(key)
+        if parsed is not None:
+            try:
+                prefix_db = deserialize(raw, PrefixDatabase)
+            except Exception:
+                log.exception("%s: bad prefix db for %s", self.name, key)
+                return
+            changed = self.prefix_state.update_prefix_database(prefix_db)
+            self.pending.apply_prefix_changes(changed)
+
+    def _update_adjacency_db(self, area: str, adj_db: AdjacencyDatabase) -> None:
+        link_state = self.area_link_states.setdefault(area, LinkState(area))
+        filtered = self._filter_adj_only_used_by_other_node(adj_db)
+        t0 = time.perf_counter()
+        change = link_state.update_adjacency_database(filtered)
+        counters.add_stat_value(
+            "decision.linkstate_update_ms", (time.perf_counter() - t0) * 1e3
+        )
+        if change:
+            self.pending.apply_link_state_change(change, adj_db.this_node_name)
+
+    def _filter_adj_only_used_by_other_node(
+        self, adj_db: AdjacencyDatabase
+    ) -> AdjacencyDatabase:
+        """Ordered cold-boot insertion (ref Decision.cpp:567-605): an
+        adjacency flagged adj_only_used_by_other_node is dropped unless WE
+        are that other node (the restarting node withholds transit use of
+        the adjacency until it has programmed routes; its neighbor may use
+        it immediately)."""
+        if not any(a.adj_only_used_by_other_node for a in adj_db.adjacencies):
+            return adj_db
+        kept: list[Adjacency] = []
+        for adj in adj_db.adjacencies:
+            if adj.adj_only_used_by_other_node:
+                if adj.other_node_name != self.node_name:
+                    continue
+                adj = replace(adj, adj_only_used_by_other_node=False)
+            kept.append(adj)
+        return replace(adj_db, adjacencies=tuple(kept))
+
+    def _delete_key_from_lsdb(self, area: str, key: str) -> None:
+        node = parse_adj_key(key)
+        if node is not None:
+            link_state = self.area_link_states.get(area)
+            if link_state is not None:
+                change = link_state.delete_adjacency_database(node)
+                if change:
+                    self.pending.apply_link_state_change(change, node)
+            return
+        parsed = parse_prefix_key(key)
+        if parsed is not None:
+            p_node, p_area, p_prefix = parsed
+            # expiry withdraws exactly that (node, area, prefix)
+            db = PrefixDatabase(
+                this_node_name=p_node,
+                prefix_entries=(PrefixEntry(prefix=p_prefix),),
+                area=p_area,
+                delete_prefix=True,
+            )
+            changed = self.prefix_state.update_prefix_database(db)
+            self.pending.apply_prefix_changes(changed)
+
+    # -- rebuild (ref Decision.cpp:919-996) --------------------------------
+
+    def _trigger_rebuild(self) -> None:
+        if not self._kvstore_synced:
+            return  # initialization gating
+        if self._rebuild_debounced is not None:
+            self._rebuild_debounced()
+
+    def rebuild_routes(self) -> None:
+        if not self._kvstore_synced:
+            return
+        pending = self.pending
+        self.pending = PendingUpdates()
+        t0 = time.perf_counter()
+
+        if pending.needs_full_rebuild or not self._first_build_done:
+            new_db = self.solver.build_route_db(
+                self.node_name, self.area_link_states, self.prefix_state
+            )
+            if new_db is None:
+                return  # we are not yet in the LSDB
+        else:
+            # incremental: recompute only changed prefixes
+            new_db = DecisionRouteDb(
+                unicast_routes=dict(self.route_db.unicast_routes),
+                mpls_routes=dict(self.route_db.mpls_routes),
+            )
+            for prefix in pending.updated_prefixes:
+                route = self.solver.create_route_for_prefix_or_get_static(
+                    self.node_name,
+                    self.area_link_states,
+                    self.prefix_state,
+                    prefix,
+                )
+                if route is None:
+                    new_db.unicast_routes.pop(prefix, None)
+                else:
+                    new_db.unicast_routes[prefix] = route
+
+        if self.rib_policy is not None and self.rib_policy.is_active():
+            self.rib_policy.apply_policy(new_db.unicast_routes)
+
+        update = self.route_db.calculate_update(new_db)
+        update.type = (
+            RouteUpdateType.INCREMENTAL
+            if self._first_build_done
+            else RouteUpdateType.FULL_SYNC
+        )
+        self.route_db = new_db
+        build_ms = (time.perf_counter() - t0) * 1e3
+        counters.add_stat_value("decision.route_build_ms", build_ms)
+        counters.increment("decision.route_builds")
+
+        if not self._first_build_done or not update.empty():
+            perf = pending.perf_events or PerfEvents()
+            add_perf_event(perf, self.node_name, "ROUTE_UPDATE")
+            update.perf_events = perf
+            self._route_updates_q.push(update)
+        if not self._first_build_done:
+            self._first_build_done = True
+            self._route_updates_q.push(InitializationEvent.RIB_COMPUTED)
+
+    # -- module API (role of semifuture_* Decision.h:154-195) --------------
+
+    async def get_decision_route_db(
+        self, from_node: Optional[str] = None
+    ) -> Optional[DecisionRouteDb]:
+        """Computed RIB, optionally from another node's perspective — the
+        RIB is a pure function of the LSDB (ref Decision.cpp:308-328)."""
+        node = from_node or self.node_name
+        if node == self.node_name:
+            return self.route_db
+        solver = make_solver(node, "cpu")
+        return solver.build_route_db(
+            node, self.area_link_states, self.prefix_state
+        )
+
+    async def get_adj_dbs(self) -> dict[str, dict[str, AdjacencyDatabase]]:
+        return {
+            area: dict(ls.get_adjacency_databases())
+            for area, ls in self.area_link_states.items()
+        }
+
+    async def get_received_routes(self):
+        return self.prefix_state.received_routes()
+
+    async def set_rib_policy(self, policy: RibPolicy) -> None:
+        policy.arm()
+        self.rib_policy = policy
+        self.pending.needs_full_rebuild = True
+        self._trigger_rebuild()
+
+    async def get_rib_policy(self) -> Optional[RibPolicy]:
+        return self.rib_policy
+
+    async def clear_rib_policy(self) -> None:
+        self.rib_policy = None
+        self.pending.needs_full_rebuild = True
+        self._trigger_rebuild()

@@ -94,6 +94,7 @@ class RibUnicastEntry:
     do_not_install: bool = False
     igp_cost: int = 0
     ucmp_weight: Optional[int] = None
+    counter_id: Optional[str] = None  # set by RibPolicy (ref RibEntry.h:70)
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,140 @@
+"""RibPolicy — post-computation route transformation.
+
+Role of the reference's openr/decision/RibPolicy.{h,cpp} (:23-124): an
+ordered list of statements, each a matcher (prefix set and/or tag set) plus
+an action (per-area / per-neighbor next-hop weights). Decision applies the
+policy to the computed unicast RIB before emitting the delta; zero-weight
+next hops are removed, and a route whose next hops all drop is deleted.
+Policies carry a TTL (validity window) and survive restarts via save/load
+with absolute-TTL adjustment (ref Decision.cpp:646-728).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from openr_tpu.decision.rib import NextHop, RibUnicastEntry
+
+
+@dataclass
+class RibRouteActionWeight:
+    """ref OpenrCtrl.thrift RibRouteActionWeight."""
+
+    default_weight: int = 0
+    area_to_weight: dict[str, int] = field(default_factory=dict)
+    neighbor_to_weight: dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class RibPolicyStatement:
+    """Match (prefix-list and/or tag-list) -> action
+    (ref RibPolicy.h RibPolicyStatement :23-60)."""
+
+    name: str = ""
+    prefixes: tuple[str, ...] = ()
+    tags: tuple[str, ...] = ()
+    action: RibRouteActionWeight = field(default_factory=RibRouteActionWeight)
+    counter_id: Optional[str] = None
+
+    def match(self, entry: RibUnicastEntry) -> bool:
+        """ref RibPolicyStatement::match — prefix OR tag membership."""
+        if self.prefixes and entry.prefix in self.prefixes:
+            return True
+        if self.tags and entry.best_prefix_entry is not None:
+            if set(self.tags) & set(entry.best_prefix_entry.tags):
+                return True
+        return False
+
+    def apply_action(self, entry: RibUnicastEntry) -> Optional[RibUnicastEntry]:
+        """Transform the route's next-hop weights; None if every next hop
+        dropped (ref RibPolicyStatement::applyAction)."""
+        new_nhs: set[NextHop] = set()
+        for nh in entry.nexthops:
+            weight = self.action.default_weight
+            if nh.area and nh.area in self.action.area_to_weight:
+                weight = self.action.area_to_weight[nh.area]
+            if (
+                nh.neighbor_node_name
+                and nh.neighbor_node_name in self.action.neighbor_to_weight
+            ):
+                weight = self.action.neighbor_to_weight[nh.neighbor_node_name]
+            if weight == 0:
+                continue  # zero weight removes the next hop
+            new_nhs.add(
+                NextHop(
+                    address=nh.address,
+                    if_name=nh.if_name,
+                    metric=nh.metric,
+                    mpls_action=nh.mpls_action,
+                    area=nh.area,
+                    neighbor_node_name=nh.neighbor_node_name,
+                    weight=weight,
+                )
+            )
+        if not new_nhs:
+            return None
+        return RibUnicastEntry(
+            prefix=entry.prefix,
+            nexthops=frozenset(new_nhs),
+            best_prefix_entry=entry.best_prefix_entry,
+            best_node_area=entry.best_node_area,
+            igp_cost=entry.igp_cost,
+            ucmp_weight=entry.ucmp_weight,
+            counter_id=self.counter_id,
+        )
+
+
+@dataclass
+class RibPolicy:
+    """ref RibPolicy.h RibPolicy :62-124 + OpenrCtrl.thrift RibPolicy:185."""
+
+    statements: tuple[RibPolicyStatement, ...] = ()
+    ttl_secs: int = 300
+    # absolute validity deadline (monotonic); None = not yet armed
+    valid_until: Optional[float] = None
+
+    def arm(self, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        self.valid_until = now + self.ttl_secs
+
+    def is_active(self, now: Optional[float] = None) -> bool:
+        if self.valid_until is None:
+            return False
+        now = time.monotonic() if now is None else now
+        return now < self.valid_until
+
+    def remaining_ttl_secs(self, now: Optional[float] = None) -> float:
+        if self.valid_until is None:
+            return 0.0
+        now = time.monotonic() if now is None else now
+        return max(0.0, self.valid_until - now)
+
+    def match(self, entry: RibUnicastEntry) -> Optional[RibPolicyStatement]:
+        for stmt in self.statements:
+            if stmt.match(entry):
+                return stmt
+        return None
+
+    def apply_policy(
+        self, unicast_routes: dict[str, RibUnicastEntry]
+    ) -> tuple[dict[str, RibUnicastEntry], list[str]]:
+        """Transform matching routes in place; returns (changed routes,
+        deleted prefixes) (ref RibPolicy::applyPolicy h:100-112)."""
+        changed: dict[str, RibUnicastEntry] = {}
+        deleted: list[str] = []
+        if not self.is_active():
+            return changed, deleted
+        for prefix, entry in list(unicast_routes.items()):
+            stmt = self.match(entry)
+            if stmt is None:
+                continue
+            new_entry = stmt.apply_action(entry)
+            if new_entry is None:
+                del unicast_routes[prefix]
+                deleted.append(prefix)
+            elif new_entry != entry:
+                unicast_routes[prefix] = new_entry
+                changed[prefix] = new_entry
+        return changed, deleted

@@ -258,6 +258,17 @@ class TpuSpfSolver:
     def update_static_mpls_routes(self, to_update, to_delete) -> None:
         self.cpu.update_static_mpls_routes(to_update, to_delete)
 
+    def create_route_for_prefix_or_get_static(
+        self, my_node_name, area_link_states, prefix_state, prefix
+    ):
+        """Incremental per-prefix path (Decision's changed-prefix rebuild):
+        single-prefix work has no batch to amortize a device launch over,
+        so it delegates to the CPU oracle. The resident SPF tensors keep
+        serving the full-rebuild path."""
+        return self.cpu.create_route_for_prefix_or_get_static(
+            my_node_name, area_link_states, prefix_state, prefix
+        )
+
     @property
     def static_unicast_routes(self):
         return self.cpu.static_unicast_routes

@@ -196,7 +196,13 @@ class KvStore(Actor):
             req_hashes = {
                 k: from_plain(v, Value) for k, v in key_val_hashes.items()
             }
-            pub = dump_difference(area, st.kv, req_hashes)
+            # filters restrict which of OUR keys enter the delta
+            my_kv = (
+                dump_all_with_filters(area, st.kv, filters).key_vals
+                if (prefixes or originator_ids)
+                else st.kv
+            )
+            pub = dump_difference(area, my_kv, req_hashes)
             counters.increment(f"kvstore.{self.node_name}.full_sync_served")
         else:
             pub = dump_all_with_filters(area, st.kv, filters)
@@ -303,8 +309,13 @@ class KvStore(Actor):
         self, st: KvStoreArea, peer: Peer, pub: Publication
     ) -> None:
         await self._flood_rate_limit()
-        if peer.state == KvStorePeerState.IDLE or peer.client is None:
-            return  # peer torn down while we waited
+        if peer.state == KvStorePeerState.IDLE:
+            return  # peer torn down while we waited; sync loop owns retry
+        if peer.client is None:
+            # INITIALIZED/SYNCING without a session is inconsistent — demote
+            # so the sync loop re-establishes it
+            self._reset_peer(st, peer)
+            return
         try:
             await peer.client.request(
                 "kvstore.set_key_vals",
@@ -505,6 +516,10 @@ class KvStore(Actor):
 
         if st.peers.get(peer.node_name) is not peer:
             return  # peer replaced mid-sync
+        if peer.state != KvStorePeerState.SYNCING or peer.client is None:
+            # a concurrent _reset_peer (failed flood) demoted us while the
+            # last RPC was resolving: stay IDLE and let the sync loop retry
+            return
         peer.state = KvStorePeerState.INITIALIZED
         peer.backoff.report_success()
         self._parallel_sync_limit = min(
@@ -640,7 +655,16 @@ class KvStore(Actor):
         """Periodically bump ttl_version on finite-ttl self-originated keys
         (ref advertiseTtlUpdates KvStore.h:512; refresh at ttl/4)."""
         while True:
-            interval = max(0.05, self.cfg.key_ttl_ms / 1e3 / 4)
+            # refresh at a quarter of the SHORTEST finite self-originated
+            # ttl (per-request set_ttl may be far below cfg.key_ttl_ms)
+            finite = [
+                own.value.ttl_ms
+                for st in self.areas.values()
+                for own in st.self_originated.values()
+                if own.value.ttl_ms != TTL_INFINITY
+            ]
+            base_ms = min(finite) if finite else self.cfg.key_ttl_ms
+            interval = max(0.02, base_ms / 1e3 / 4)
             await asyncio.sleep(interval)
             for st in self.areas.values():
                 refresh: dict[str, Value] = {}

@@ -82,9 +82,12 @@ class Actor:
         await self.on_stop()
         for t in self._timers:
             t.cancel()
-        for task in self._tasks:
+        # snapshot: the prune-on-completion callback mutates _tasks while we
+        # await, which would shift elements under a live iterator
+        tasks = list(self._tasks)
+        for task in tasks:
             task.cancel()
-        for task in self._tasks:
+        for task in tasks:
             try:
                 await task
             except (asyncio.CancelledError, QueueClosedError):

@@ -246,7 +246,13 @@ class RpcClient:
                 line = await reader.readline()
                 if not line:
                     break
-                frame = json.loads(line)
+                try:
+                    frame = json.loads(line)
+                except (json.JSONDecodeError, ValueError):
+                    log.warning(
+                        "%s: malformed frame from server, closing", self.name
+                    )
+                    break
                 req_id = frame.get("id")
                 if "stream" in frame or frame.get("done"):
                     q = self._stream_queues.get(req_id)
@@ -306,8 +312,13 @@ class RpcClient:
         q: asyncio.Queue = asyncio.Queue()
         self._stream_queues[req_id] = q
         frame = {"id": req_id, "method": method, "params": params or {}}
-        self._writer.write(
-            json.dumps(frame, separators=(",", ":")).encode() + b"\n"
-        )
-        await self._writer.drain()
+        try:
+            self._writer.write(
+                json.dumps(frame, separators=(",", ":")).encode() + b"\n"
+            )
+            await self._writer.drain()
+        except (ConnectionResetError, BrokenPipeError, AttributeError) as e:
+            self._stream_queues.pop(req_id, None)
+            self._teardown(RpcConnectionError(f"{self.name}: send failed"))
+            raise RpcConnectionError(f"{self.name}: subscribe failed: {e}")
         return q

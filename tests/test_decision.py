@@ -1,0 +1,386 @@
+"""Decision actor tests — publication-driven route assertions in the style
+of the reference's openr/decision/tests/DecisionTest.cpp: drive the actor
+through its kvstore-updates queue with serialized adj:/prefix: keys and
+assert the emitted DecisionRouteUpdate deltas, for both solver backends.
+"""
+
+import asyncio
+
+from openr_tpu.config import DecisionConfig
+from openr_tpu.decision.decision import Decision
+from openr_tpu.decision.rib import RouteUpdateType
+from openr_tpu.decision.rib_policy import (
+    RibPolicy,
+    RibPolicyStatement,
+    RibRouteActionWeight,
+)
+from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.serde import serialize
+from openr_tpu.types import (
+    Adjacency,
+    AdjacencyDatabase,
+    InitializationEvent,
+    PrefixDatabase,
+    PrefixEntry,
+    Publication,
+    Value,
+    adj_key,
+    prefix_key,
+)
+from tests.conftest import run_async
+
+AREA = "0"
+
+
+def adj(a: str, b: str, metric: int = 1, **kw) -> Adjacency:
+    return Adjacency(
+        other_node_name=b,
+        if_name=f"if-{a}-{b}",
+        other_if_name=f"if-{b}-{a}",
+        metric=metric,
+        **kw,
+    )
+
+
+def adj_db_kv(node: str, adjs: list[Adjacency], version: int = 1, **kw):
+    db = AdjacencyDatabase(
+        this_node_name=node, adjacencies=tuple(adjs), area=AREA, **kw
+    )
+    return adj_key(node), Value(
+        version=version, originator_id=node, value=serialize(db)
+    )
+
+
+def prefix_db_kv(node: str, prefix: str, version: int = 1, **entry_kw):
+    db = PrefixDatabase(
+        this_node_name=node,
+        prefix_entries=(PrefixEntry(prefix=prefix, **entry_kw),),
+        area=AREA,
+    )
+    return prefix_key(node, AREA, prefix), Value(
+        version=version, originator_id=node, value=serialize(db)
+    )
+
+
+class DecisionHarness:
+    """Queues + actor + a reader on the route-updates queue."""
+
+    def __init__(self, node: str = "1", backend: str = "cpu"):
+        self.kv_q = ReplicateQueue("kvStoreUpdates")
+        self.static_q = ReplicateQueue("staticRoutes")
+        self.routes_q = ReplicateQueue("routeUpdates")
+        self.routes_reader = self.routes_q.get_reader("test")
+        self.decision = Decision(
+            node,
+            DecisionConfig(debounce_min_ms=5, debounce_max_ms=20),
+            self.kv_q.get_reader(),
+            self.static_q.get_reader(),
+            self.routes_q,
+            solver_backend=backend,
+        )
+
+    async def __aenter__(self):
+        await self.decision.start()
+        return self
+
+    async def __aexit__(self, *exc):
+        self.routes_q.close()
+        await self.decision.stop()
+
+    def publish(self, *key_vals) -> None:
+        self.kv_q.push(Publication(key_vals=dict(key_vals), area=AREA))
+
+    def expire(self, *keys) -> None:
+        self.kv_q.push(Publication(expired_keys=list(keys), area=AREA))
+
+    def synced(self) -> None:
+        self.kv_q.push(InitializationEvent.KVSTORE_SYNCED)
+
+    async def next_route_update(self, timeout=5.0):
+        async def get():
+            while True:
+                item = await self.routes_reader.get()
+                if not isinstance(item, InitializationEvent):
+                    return item
+
+        return await asyncio.wait_for(get(), timeout)
+
+
+def two_node_mesh(h: DecisionHarness):
+    """1 -- 2 with loopbacks 10.0.0.1/32 (on 1) and 10.0.0.2/32 (on 2)."""
+    h.publish(adj_db_kv("1", [adj("1", "2")]), adj_db_kv("2", [adj("2", "1")]))
+    h.publish(prefix_db_kv("1", "10.0.0.1/32"), prefix_db_kv("2", "10.0.0.2/32"))
+
+
+class TestDecisionBasics:
+    @run_async
+    async def test_initial_full_sync_after_kvstore_synced(self):
+        async with DecisionHarness() as h:
+            two_node_mesh(h)
+            await asyncio.sleep(0.05)
+            # gated: no routes before KVSTORE_SYNCED
+            assert h.routes_reader.size() == 0
+            h.synced()
+            update = await h.next_route_update()
+            assert update.type == RouteUpdateType.FULL_SYNC
+            # route to 2's loopback, not our own
+            assert "10.0.0.2/32" in update.unicast_routes_to_update
+            assert "10.0.0.1/32" not in update.unicast_routes_to_update
+            nhs = update.unicast_routes_to_update["10.0.0.2/32"].nexthops
+            assert {nh.neighbor_node_name for nh in nhs} == {"2"}
+
+    @run_async
+    async def test_rib_computed_event_emitted(self):
+        async with DecisionHarness() as h:
+            two_node_mesh(h)
+            h.synced()
+            seen = []
+            async def drain():
+                while True:
+                    seen.append(await h.routes_reader.get())
+                    if InitializationEvent.RIB_COMPUTED in seen:
+                        return
+            await asyncio.wait_for(drain(), 5)
+
+    @run_async
+    async def test_incremental_prefix_update(self):
+        async with DecisionHarness() as h:
+            two_node_mesh(h)
+            h.synced()
+            await h.next_route_update()
+            # new prefix from node 2 -> INCREMENTAL delta with only it
+            h.publish(prefix_db_kv("2", "10.1.0.0/24"))
+            update = await h.next_route_update()
+            assert update.type == RouteUpdateType.INCREMENTAL
+            assert set(update.unicast_routes_to_update) == {"10.1.0.0/24"}
+            assert not update.unicast_routes_to_delete
+
+    @run_async
+    async def test_prefix_withdrawal_deletes_route(self):
+        async with DecisionHarness() as h:
+            two_node_mesh(h)
+            h.synced()
+            await h.next_route_update()
+            h.expire(prefix_key("2", AREA, "10.0.0.2/32"))
+            update = await h.next_route_update()
+            assert update.unicast_routes_to_delete == ["10.0.0.2/32"]
+
+    @run_async
+    async def test_adj_expiry_full_rebuild_removes_routes(self):
+        async with DecisionHarness() as h:
+            two_node_mesh(h)
+            h.synced()
+            await h.next_route_update()
+            h.expire(adj_key("2"))
+            update = await h.next_route_update()
+            # 2 unreachable: its loopback route is withdrawn
+            assert "10.0.0.2/32" in update.unicast_routes_to_delete
+
+    @run_async
+    async def test_metric_change_moves_nexthop(self):
+        """Line 1-2-3 plus direct 1-3 link: shortest to 3's loopback flips
+        when the direct link's metric changes."""
+        async with DecisionHarness() as h:
+            h.publish(
+                adj_db_kv("1", [adj("1", "2"), adj("1", "3", metric=10)]),
+                adj_db_kv("2", [adj("2", "1"), adj("2", "3")]),
+                adj_db_kv("3", [adj("3", "2"), adj("3", "1", metric=10)]),
+            )
+            h.publish(prefix_db_kv("3", "10.0.0.3/32"))
+            h.synced()
+            update = await h.next_route_update()
+            nhs = update.unicast_routes_to_update["10.0.0.3/32"].nexthops
+            assert {nh.neighbor_node_name for nh in nhs} == {"2"}  # cost 2 < 10
+            # direct link becomes cheap
+            h.publish(
+                adj_db_kv("1", [adj("1", "2"), adj("1", "3", metric=1)], version=2),
+                adj_db_kv("3", [adj("3", "2"), adj("3", "1", metric=1)], version=2),
+            )
+            update = await h.next_route_update()
+            nhs = update.unicast_routes_to_update["10.0.0.3/32"].nexthops
+            assert {nh.neighbor_node_name for nh in nhs} == {"3"}
+
+    @run_async
+    async def test_ecmp_two_paths(self):
+        """Diamond 1-2-4, 1-3-4: equal-cost paths to 4's loopback."""
+        async with DecisionHarness() as h:
+            h.publish(
+                adj_db_kv("1", [adj("1", "2"), adj("1", "3")]),
+                adj_db_kv("2", [adj("2", "1"), adj("2", "4")]),
+                adj_db_kv("3", [adj("3", "1"), adj("3", "4")]),
+                adj_db_kv("4", [adj("4", "2"), adj("4", "3")]),
+            )
+            h.publish(prefix_db_kv("4", "10.0.0.4/32"))
+            h.synced()
+            update = await h.next_route_update()
+            nhs = update.unicast_routes_to_update["10.0.0.4/32"].nexthops
+            assert {nh.neighbor_node_name for nh in nhs} == {"2", "3"}
+
+    @run_async
+    async def test_debounce_batches_updates(self):
+        """A burst of publications produces one batched route update."""
+        async with DecisionHarness() as h:
+            two_node_mesh(h)
+            h.synced()
+            await h.next_route_update()
+            for i in range(10):
+                h.publish(prefix_db_kv("2", f"10.2.{i}.0/24"))
+            update = await h.next_route_update()
+            got = set(update.unicast_routes_to_update)
+            # the debounce window must coalesce the burst into one delta
+            assert len(got) == 10, got
+            assert h.routes_reader.size() == 0
+
+
+class TestColdBootAdjFilter:
+    @run_async
+    async def test_adj_only_used_by_other_node(self):
+        """Restarting node 2 advertises its adjacency to 1 with the
+        one-way flag: node 3 must NOT route through 2, while node 1 (the
+        'other node') may use the adjacency (ref Decision.cpp:567-644)."""
+
+        def topo(h):
+            # line 3 - 1 - 2; 2's loopback behind the flagged adjacency
+            h.publish(
+                adj_db_kv("3", [adj("3", "1")]),
+                adj_db_kv("1", [adj("1", "3"), adj("1", "2")]),
+                adj_db_kv(
+                    "2",
+                    [adj("2", "1", adj_only_used_by_other_node=True)],
+                ),
+            )
+            h.publish(prefix_db_kv("2", "10.0.0.2/32"))
+
+        # from node 3's perspective: 2's adjacency is filtered -> the 1-2
+        # link is one-sided -> no route to 2's loopback
+        async with DecisionHarness(node="3") as h3:
+            topo(h3)
+            h3.synced()
+            update = await h3.next_route_update()
+            assert "10.0.0.2/32" not in update.unicast_routes_to_update
+
+        # from node 1's perspective (the other node): adjacency usable
+        async with DecisionHarness(node="1") as h1:
+            topo(h1)
+            h1.synced()
+            update = await h1.next_route_update()
+            assert "10.0.0.2/32" in update.unicast_routes_to_update
+
+
+class TestRibPolicy:
+    @run_async
+    async def test_policy_sets_weights(self):
+        async with DecisionHarness() as h:
+            two_node_mesh(h)
+            h.synced()
+            await h.next_route_update()
+            policy = RibPolicy(
+                statements=(
+                    RibPolicyStatement(
+                        name="w",
+                        prefixes=("10.0.0.2/32",),
+                        action=RibRouteActionWeight(
+                            default_weight=1,
+                            neighbor_to_weight={"2": 7},
+                        ),
+                    ),
+                ),
+                ttl_secs=60,
+            )
+            await h.decision.set_rib_policy(policy)
+            update = await h.next_route_update()
+            entry = update.unicast_routes_to_update["10.0.0.2/32"]
+            assert all(nh.weight == 7 for nh in entry.nexthops)
+
+    @run_async
+    async def test_policy_zero_weight_drops_route(self):
+        async with DecisionHarness() as h:
+            two_node_mesh(h)
+            h.synced()
+            await h.next_route_update()
+            policy = RibPolicy(
+                statements=(
+                    RibPolicyStatement(
+                        name="drop",
+                        prefixes=("10.0.0.2/32",),
+                        action=RibRouteActionWeight(default_weight=0),
+                    ),
+                ),
+                ttl_secs=60,
+            )
+            await h.decision.set_rib_policy(policy)
+            update = await h.next_route_update()
+            assert "10.0.0.2/32" in update.unicast_routes_to_delete
+
+    @run_async
+    async def test_clear_policy_restores_route(self):
+        async with DecisionHarness() as h:
+            two_node_mesh(h)
+            h.synced()
+            await h.next_route_update()
+            policy = RibPolicy(
+                statements=(
+                    RibPolicyStatement(
+                        name="drop",
+                        prefixes=("10.0.0.2/32",),
+                        action=RibRouteActionWeight(default_weight=0),
+                    ),
+                ),
+                ttl_secs=60,
+            )
+            await h.decision.set_rib_policy(policy)
+            await h.next_route_update()
+            await h.decision.clear_rib_policy()
+            update = await h.next_route_update()
+            assert "10.0.0.2/32" in update.unicast_routes_to_update
+
+
+class TestStaticRoutes:
+    @run_async
+    async def test_static_route_update(self):
+        from openr_tpu.decision.rib import (
+            DecisionRouteUpdate,
+            NextHop,
+            RibUnicastEntry,
+        )
+
+        async with DecisionHarness() as h:
+            two_node_mesh(h)
+            h.synced()
+            await h.next_route_update()
+            static = DecisionRouteUpdate(
+                unicast_routes_to_update={
+                    "10.99.0.0/16": RibUnicastEntry(
+                        prefix="10.99.0.0/16",
+                        nexthops=frozenset({NextHop(address="fe80::9")}),
+                    )
+                }
+            )
+            h.static_q.push(static)
+            update = await h.next_route_update()
+            assert "10.99.0.0/16" in update.unicast_routes_to_update
+
+
+class TestTpuBackendParity:
+    @run_async
+    async def test_same_routes_both_backends(self):
+        """The publication-driven harness run against cpu and tpu backends
+        must converge to identical RIBs (differential seam, SURVEY §4)."""
+        results = {}
+        for backend in ("cpu", "tpu"):
+            async with DecisionHarness(backend=backend) as h:
+                h.publish(
+                    adj_db_kv("1", [adj("1", "2"), adj("1", "3")]),
+                    adj_db_kv("2", [adj("2", "1"), adj("2", "4")]),
+                    adj_db_kv("3", [adj("3", "1"), adj("3", "4")]),
+                    adj_db_kv("4", [adj("4", "2"), adj("4", "3")]),
+                )
+                h.publish(
+                    prefix_db_kv("2", "10.0.0.2/32"),
+                    prefix_db_kv("4", "10.0.0.4/32"),
+                    prefix_db_kv("4", "10.4.0.0/24"),
+                )
+                h.synced()
+                update = await h.next_route_update()
+                results[backend] = update.unicast_routes_to_update
+        assert results["cpu"] == results["tpu"]
