@@ -366,6 +366,16 @@ class Decision(Actor):
         self.rib_policy = policy
         self.pending.needs_full_rebuild = True
         self._trigger_rebuild()
+        # re-arm a rebuild at policy expiry so its effects revert on time
+        # (ref Decision.cpp rib policy ttl timer :646-728)
+        self.schedule(
+            policy.remaining_ttl_secs() + 0.01, self._on_policy_expiry
+        )
+
+    def _on_policy_expiry(self) -> None:
+        if self.rib_policy is not None and not self.rib_policy.is_active():
+            self.pending.needs_full_rebuild = True
+            self._trigger_rebuild()
 
     async def get_rib_policy(self) -> Optional[RibPolicy]:
         return self.rib_policy

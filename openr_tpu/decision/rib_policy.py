@@ -12,7 +12,7 @@ with absolute-TTL adjustment (ref Decision.cpp:646-728).
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Optional
 
 from openr_tpu.decision.rib import NextHop, RibUnicastEntry
@@ -62,27 +62,11 @@ class RibPolicyStatement:
                 weight = self.action.neighbor_to_weight[nh.neighbor_node_name]
             if weight == 0:
                 continue  # zero weight removes the next hop
-            new_nhs.add(
-                NextHop(
-                    address=nh.address,
-                    if_name=nh.if_name,
-                    metric=nh.metric,
-                    mpls_action=nh.mpls_action,
-                    area=nh.area,
-                    neighbor_node_name=nh.neighbor_node_name,
-                    weight=weight,
-                )
-            )
+            new_nhs.add(replace(nh, weight=weight))
         if not new_nhs:
             return None
-        return RibUnicastEntry(
-            prefix=entry.prefix,
-            nexthops=frozenset(new_nhs),
-            best_prefix_entry=entry.best_prefix_entry,
-            best_node_area=entry.best_node_area,
-            igp_cost=entry.igp_cost,
-            ucmp_weight=entry.ucmp_weight,
-            counter_id=self.counter_id,
+        return replace(
+            entry, nexthops=frozenset(new_nhs), counter_id=self.counter_id
         )
 
 

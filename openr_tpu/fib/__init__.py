@@ -1,0 +1,6 @@
+from openr_tpu.fib.fib import CLIENT_ID_OPENR, Fib, FibState, RouteState  # noqa: F401
+from openr_tpu.fib.fib_service import (  # noqa: F401
+    FibServiceBase,
+    FibUpdateError,
+    MockFibService,
+)

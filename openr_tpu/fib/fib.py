@@ -1,0 +1,412 @@
+"""Fib actor — route programming agent client.
+
+Role of the reference's openr/fib/Fib.{h,cpp}:
+
+  - RouteState snapshot of desired routes + dirtyPrefixes/dirtyLabels retry
+    sets (ref Fib.h:224-247) and FSM AWAITING -> SYNCING -> SYNCED
+    (ref Fib.h:262-270)
+  - first FULL_SYNC from Decision triggers a full syncFib; later updates
+    program incrementally (ref processDecisionRouteUpdate, updateRoutes vs
+    syncRoutes)
+  - programming failures mark routes dirty; a retry fiber reprograms them
+    with exponential backoff (ref retryRoutesSignal, Fib.cpp:118,345-430)
+  - optional delayed deletes (route_delete_delay_ms)
+  - publishes the PROGRAMMED delta on fibRouteUpdatesQueue — the FIB-ACK
+    feature PrefixManager redistribution depends on (ref Main.cpp:381-400)
+  - keepAlive: poll agent aliveSince; a restart forces full re-sync
+    (ref Fib::keepAlive)
+  - perf-event convergence log ring (ref PerfDatabase, Types.thrift:598)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import enum
+import logging
+import time
+from typing import Optional
+
+from openr_tpu.config import FibConfig
+from openr_tpu.decision.rib import (
+    DecisionRouteUpdate,
+    RibMplsEntry,
+    RibUnicastEntry,
+    RouteUpdateType,
+)
+from openr_tpu.fib.fib_service import FibServiceBase, FibUpdateError
+from openr_tpu.messaging import RQueue, ReplicateQueue
+from openr_tpu.runtime.actor import Actor
+from openr_tpu.runtime.counters import counters
+from openr_tpu.runtime.throttle import ExponentialBackoff
+from openr_tpu.types import (
+    InitializationEvent,
+    PerfEvents,
+    add_perf_event,
+    total_perf_duration_ms,
+)
+
+log = logging.getLogger(__name__)
+
+CLIENT_ID_OPENR = 786  # ref Platform.thrift FibClient::OPENR
+
+
+class FibState(enum.IntEnum):
+    """ref Fib.h:262-270."""
+
+    AWAITING_UPDATE = 0
+    SYNCING = 1
+    SYNCED = 2
+
+
+class RouteState:
+    """Desired routes + dirty tracking (ref Fib.h RouteState :224-247)."""
+
+    def __init__(self) -> None:
+        self.unicast_routes: dict[str, RibUnicastEntry] = {}
+        self.mpls_routes: dict[int, RibMplsEntry] = {}
+        self.dirty_prefixes: dict[str, float] = {}  # prefix -> ready-at ts
+        self.dirty_labels: dict[int, float] = {}
+        self.state = FibState.AWAITING_UPDATE
+
+    def update(self, upd: DecisionRouteUpdate) -> None:
+        for prefix, entry in upd.unicast_routes_to_update.items():
+            self.unicast_routes[prefix] = entry
+        for prefix in upd.unicast_routes_to_delete:
+            self.unicast_routes.pop(prefix, None)
+        for label, entry in upd.mpls_routes_to_update.items():
+            self.mpls_routes[label] = entry
+        for label in upd.mpls_routes_to_delete:
+            self.mpls_routes.pop(label, None)
+
+
+class Fib(Actor):
+    """ref Fib.h:35."""
+
+    def __init__(
+        self,
+        node_name: str,
+        config: FibConfig,
+        fib_service: FibServiceBase,
+        route_updates_queue: RQueue,
+        fib_route_updates_queue: ReplicateQueue,
+        log_sample_queue: Optional[ReplicateQueue] = None,
+        retry_initial_backoff_s: float = 0.05,
+        retry_max_backoff_s: float = 2.0,
+    ):
+        super().__init__(f"fib:{node_name}")
+        self.node_name = node_name
+        self.cfg = config
+        self.service = fib_service
+        self._route_updates = route_updates_queue
+        self._fib_updates_q = fib_route_updates_queue
+        self._log_sample_q = log_sample_queue
+        self.route_state = RouteState()
+        self._retry_backoff = ExponentialBackoff(
+            retry_initial_backoff_s, retry_max_backoff_s
+        )
+        self._retry_signal = None  # asyncio.Event, created on start
+        self._agent_alive_since: Optional[float] = None
+        self._synced_signalled = False
+        self._pending_perf: Optional[PerfEvents] = None
+        # convergence perf-event ring (ref PerfDatabase)
+        self.perf_db: collections.deque[PerfEvents] = collections.deque(
+            maxlen=32
+        )
+
+    async def on_start(self) -> None:
+        self._retry_signal = asyncio.Event()
+        # baseline the agent's aliveSince NOW — recording it lazily on the
+        # first poll would miss a restart that happens before that poll
+        try:
+            self._agent_alive_since = await self.service.alive_since()
+        except Exception:
+            pass  # keepalive loop will establish it
+        self.add_task(self._route_updates_loop(), name=f"{self.name}.updates")
+        self.add_task(self._retry_loop(), name=f"{self.name}.retry")
+        self.add_task(self._keepalive_loop(), name=f"{self.name}.keepalive")
+
+    # -- main update path (ref processDecisionRouteUpdate) -----------------
+
+    async def _route_updates_loop(self) -> None:
+        while True:
+            item = await self._route_updates.get()
+            if isinstance(item, InitializationEvent):
+                continue
+            await self.process_decision_route_update(item)
+
+    async def process_decision_route_update(
+        self, upd: DecisionRouteUpdate
+    ) -> None:
+        rs = self.route_state
+        rs.update(upd)
+        if upd.perf_events is not None:
+            add_perf_event(upd.perf_events, self.node_name, "FIB_RECEIVED")
+
+        if rs.state == FibState.AWAITING_UPDATE:
+            if upd.type != RouteUpdateType.FULL_SYNC:
+                return  # wait for Decision's initial snapshot
+            rs.state = FibState.SYNCING
+            await self._sync_routes(upd.perf_events)
+            return
+
+        # SYNCED (or SYNCING retry pending): program incrementally
+        now = time.monotonic()
+        delete_delay = self.cfg.route_delete_delay_ms / 1e3
+        for prefix in upd.unicast_routes_to_update:
+            rs.dirty_prefixes[prefix] = now
+        for prefix in upd.unicast_routes_to_delete:
+            rs.dirty_prefixes[prefix] = now + delete_delay
+        for label in upd.mpls_routes_to_update:
+            rs.dirty_labels[label] = now
+        for label in upd.mpls_routes_to_delete:
+            rs.dirty_labels[label] = now + delete_delay
+        self._pending_perf = upd.perf_events
+        self._retry_signal.set()
+
+    # -- full sync (ref syncRoutes) ----------------------------------------
+
+    async def _sync_routes(self, perf: Optional[PerfEvents] = None) -> None:
+        rs = self.route_state
+        try:
+            await self.service.sync_fib(
+                CLIENT_ID_OPENR, list(rs.unicast_routes.values())
+            )
+            await self.service.sync_mpls_fib(
+                CLIENT_ID_OPENR, list(rs.mpls_routes.values())
+            )
+        except FibUpdateError as e:
+            # partial: only the failed subset stays dirty; publish ONLY what
+            # actually landed (FIB-ACK must never claim unprogrammed routes)
+            now = time.monotonic()
+            for p in e.failed_prefixes:
+                rs.dirty_prefixes[p] = now
+            for label in e.failed_labels:
+                rs.dirty_labels[label] = now
+            failed_p = set(e.failed_prefixes)
+            self._finish_sync(
+                perf,
+                unicast={
+                    p: r
+                    for p, r in rs.unicast_routes.items()
+                    if p not in failed_p
+                },
+                mpls={},  # sync_mpls_fib never ran on this path
+            )
+            self._schedule_retry()
+            return
+        except Exception as e:
+            log.warning("%s: syncFib failed: %s", self.name, e)
+            counters.increment("fib.sync_fib_failure")
+            self._schedule_retry()
+            return
+        rs.dirty_prefixes.clear()
+        rs.dirty_labels.clear()
+        self._retry_backoff.report_success()
+        self._finish_sync(
+            perf, unicast=dict(rs.unicast_routes), mpls=dict(rs.mpls_routes)
+        )
+
+    def _finish_sync(
+        self,
+        perf: Optional[PerfEvents],
+        unicast: dict[str, RibUnicastEntry],
+        mpls: dict[int, RibMplsEntry],
+    ) -> None:
+        rs = self.route_state
+        rs.state = FibState.SYNCED
+        counters.increment("fib.full_sync")
+        self._publish_programmed(
+            DecisionRouteUpdate(
+                type=RouteUpdateType.FULL_SYNC,
+                unicast_routes_to_update=unicast,
+                mpls_routes_to_update=mpls,
+            ),
+            perf,
+        )
+        if not self._synced_signalled:
+            self._synced_signalled = True
+            self._fib_updates_q.push(InitializationEvent.FIB_SYNCED)
+
+    # -- dirty-route retry (ref retryRoutes Fib.cpp:345-430) ---------------
+
+    def _schedule_retry(self) -> None:
+        self._retry_backoff.report_error()
+        counters.increment("fib.route_programming_failure")
+        self._retry_signal.set()
+
+    async def _retry_loop(self) -> None:
+        while True:
+            await self._retry_signal.wait()
+            self._retry_signal.clear()
+            rs = self.route_state
+            # honor backoff after failures
+            delay = self._retry_backoff.time_until_retry_s()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            if rs.state == FibState.SYNCING:
+                await self._sync_routes()
+                continue
+            if not rs.dirty_prefixes and not rs.dirty_labels:
+                continue
+            # wait for the earliest delayed delete to come due
+            now = time.monotonic()
+            due_in = [
+                ts - now
+                for ts in list(rs.dirty_prefixes.values())
+                + list(rs.dirty_labels.values())
+                if ts > now
+            ]
+            await self._program_dirty_routes()
+            if due_in:
+                await asyncio.sleep(max(0.01, min(due_in)))
+                self._retry_signal.set()
+
+    async def _program_dirty_routes(self) -> None:
+        """Program everything due in the dirty sets; failures stay dirty
+        (ref updateRoutes + createUpdate from dirty state)."""
+        rs = self.route_state
+        now = time.monotonic()
+        perf = self._pending_perf
+        self._pending_perf = None
+
+        add_prefixes = [
+            p
+            for p, ts in rs.dirty_prefixes.items()
+            if ts <= now and p in rs.unicast_routes
+        ]
+        del_prefixes = [
+            p
+            for p, ts in rs.dirty_prefixes.items()
+            if ts <= now and p not in rs.unicast_routes
+        ]
+        add_labels = [
+            l
+            for l, ts in rs.dirty_labels.items()
+            if ts <= now and l in rs.mpls_routes
+        ]
+        del_labels = [
+            l
+            for l, ts in rs.dirty_labels.items()
+            if ts <= now and l not in rs.mpls_routes
+        ]
+        programmed = DecisionRouteUpdate(type=RouteUpdateType.INCREMENTAL)
+        ok = True
+        try:
+            if add_prefixes:
+                await self.service.add_unicast_routes(
+                    CLIENT_ID_OPENR,
+                    [rs.unicast_routes[p] for p in add_prefixes],
+                )
+            for p in add_prefixes:
+                rs.dirty_prefixes.pop(p, None)
+                programmed.unicast_routes_to_update[p] = rs.unicast_routes[p]
+        except FibUpdateError as e:
+            ok = False
+            for p in add_prefixes:
+                if p not in e.failed_prefixes:
+                    rs.dirty_prefixes.pop(p, None)
+                    programmed.unicast_routes_to_update[p] = rs.unicast_routes[p]
+        except Exception as e:
+            log.warning("%s: add_unicast failed: %s", self.name, e)
+            ok = False
+
+        try:
+            if del_prefixes:
+                await self.service.delete_unicast_routes(
+                    CLIENT_ID_OPENR, del_prefixes
+                )
+            for p in del_prefixes:
+                rs.dirty_prefixes.pop(p, None)
+                programmed.unicast_routes_to_delete.append(p)
+        except Exception as e:
+            log.warning("%s: delete_unicast failed: %s", self.name, e)
+            ok = False
+
+        try:
+            if add_labels:
+                await self.service.add_mpls_routes(
+                    CLIENT_ID_OPENR, [rs.mpls_routes[l] for l in add_labels]
+                )
+            for l in add_labels:
+                rs.dirty_labels.pop(l, None)
+                programmed.mpls_routes_to_update[l] = rs.mpls_routes[l]
+        except FibUpdateError as e:
+            ok = False
+            for l in add_labels:
+                if l not in e.failed_labels:
+                    rs.dirty_labels.pop(l, None)
+                    programmed.mpls_routes_to_update[l] = rs.mpls_routes[l]
+        except Exception as e:
+            log.warning("%s: add_mpls failed: %s", self.name, e)
+            ok = False
+
+        try:
+            if del_labels:
+                await self.service.delete_mpls_routes(CLIENT_ID_OPENR, del_labels)
+            for l in del_labels:
+                rs.dirty_labels.pop(l, None)
+                programmed.mpls_routes_to_delete.append(l)
+        except Exception as e:
+            log.warning("%s: delete_mpls failed: %s", self.name, e)
+            ok = False
+
+        if not programmed.empty():
+            self._publish_programmed(programmed, perf)
+        if ok:
+            self._retry_backoff.report_success()
+        else:
+            self._schedule_retry()
+
+    # -- programmed-delta publication (FIB-ACK) ----------------------------
+
+    def _publish_programmed(
+        self, programmed: DecisionRouteUpdate, perf: Optional[PerfEvents]
+    ) -> None:
+        if perf is not None:
+            add_perf_event(perf, self.node_name, "FIB_PROGRAMMED")
+            programmed.perf_events = perf
+            self.perf_db.append(perf)
+            counters.add_stat_value(
+                "fib.convergence_time_ms", total_perf_duration_ms(perf)
+            )
+        counters.increment("fib.routes_programmed")
+        self._fib_updates_q.push(programmed)
+
+    # -- agent liveness (ref Fib::keepAlive) -------------------------------
+
+    async def _keepalive_loop(self) -> None:
+        while True:
+            await asyncio.sleep(0.2)
+            try:
+                alive = await self.service.alive_since()
+            except Exception:
+                continue
+            if self._agent_alive_since is None:
+                self._agent_alive_since = alive
+            elif alive != self._agent_alive_since:
+                # agent restarted: wipe assumptions, full re-sync
+                log.warning("%s: fib agent restarted; re-syncing", self.name)
+                self._agent_alive_since = alive
+                if self.route_state.state != FibState.AWAITING_UPDATE:
+                    self.route_state.state = FibState.SYNCING
+                    self._retry_signal.set()
+
+    # -- module API (ref Fib.h:140-180) ------------------------------------
+
+    async def get_route_db(self) -> dict[str, RibUnicastEntry]:
+        return dict(self.route_state.unicast_routes)
+
+    async def get_mpls_route_db(self) -> dict[int, RibMplsEntry]:
+        return dict(self.route_state.mpls_routes)
+
+    async def get_perf_db(self) -> list[PerfEvents]:
+        return list(self.perf_db)
+
+    @property
+    def synced(self) -> bool:
+        return (
+            self.route_state.state == FibState.SYNCED
+            and not self.route_state.dirty_prefixes
+            and not self.route_state.dirty_labels
+        )

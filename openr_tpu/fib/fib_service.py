@@ -1,0 +1,180 @@
+"""FibService interface + in-memory mock with failure injection.
+
+Role of the reference's thrift FibService (openr/if/Platform.thrift:170)
+served by NetlinkFibHandler (openr/platform/NetlinkFibHandler.h:32), and of
+the test mock MockNetlinkFibHandler (openr/tests/mocks/MockNetlinkFibHandler.h)
+with programmable per-call failure injection that exercises Fib's
+dirty-route retry machinery.
+
+The real platform handler (platform/) serves this same interface over
+runtime/rpc.py and programs a kernel-facing backend; the Fib actor only
+sees this interface.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+from openr_tpu.decision.rib import RibMplsEntry, RibUnicastEntry
+
+
+class FibUpdateError(RuntimeError):
+    """Partial programming failure: carries what could not be programmed
+    (ref thrift PlatformFibUpdateError)."""
+
+    def __init__(
+        self,
+        failed_prefixes: Optional[list[str]] = None,
+        failed_labels: Optional[list[int]] = None,
+    ):
+        self.failed_prefixes = failed_prefixes or []
+        self.failed_labels = failed_labels or []
+        super().__init__(
+            f"fib update failed: prefixes={self.failed_prefixes} "
+            f"labels={self.failed_labels}"
+        )
+
+
+class FibServiceBase:
+    """Interface the Fib actor programs against (ref Platform.thrift)."""
+
+    async def add_unicast_routes(
+        self, client_id: int, routes: list[RibUnicastEntry]
+    ) -> None:
+        raise NotImplementedError
+
+    async def delete_unicast_routes(
+        self, client_id: int, prefixes: list[str]
+    ) -> None:
+        raise NotImplementedError
+
+    async def add_mpls_routes(
+        self, client_id: int, routes: list[RibMplsEntry]
+    ) -> None:
+        raise NotImplementedError
+
+    async def delete_mpls_routes(
+        self, client_id: int, labels: list[int]
+    ) -> None:
+        raise NotImplementedError
+
+    async def sync_fib(
+        self, client_id: int, routes: list[RibUnicastEntry]
+    ) -> None:
+        raise NotImplementedError
+
+    async def sync_mpls_fib(
+        self, client_id: int, routes: list[RibMplsEntry]
+    ) -> None:
+        raise NotImplementedError
+
+    async def alive_since(self) -> float:
+        raise NotImplementedError
+
+
+class MockFibService(FibServiceBase):
+    """In-memory FibService with per-op failure injection
+    (ref MockNetlinkFibHandler)."""
+
+    def __init__(self) -> None:
+        self.unicast: dict[str, RibUnicastEntry] = {}
+        self.mpls: dict[int, RibMplsEntry] = {}
+        self._alive_since = time.monotonic()
+        # op name -> remaining number of calls to fail entirely
+        self.fail_ops: dict[str, int] = {}
+        # prefixes/labels that fail individually (partial failure)
+        self.fail_prefixes: set[str] = set()
+        self.fail_labels: set[int] = set()
+        self.call_log: list[tuple[str, int]] = []  # (op, item count)
+        self.sync_count = 0
+        self._event = asyncio.Event()
+
+    # -- failure injection controls ---------------------------------------
+
+    def fail_next(self, op: str, times: int = 1) -> None:
+        self.fail_ops[op] = self.fail_ops.get(op, 0) + times
+
+    def restart(self) -> None:
+        """Simulate agent restart: state wiped, aliveSince moves."""
+        self.unicast.clear()
+        self.mpls.clear()
+        self._alive_since = time.monotonic()
+
+    def _maybe_fail(self, op: str) -> None:
+        left = self.fail_ops.get(op, 0)
+        if left > 0:
+            self.fail_ops[op] = left - 1
+            raise ConnectionError(f"injected failure: {op}")
+
+    def _note(self, op: str, n: int) -> None:
+        self.call_log.append((op, n))
+        self._event.set()
+
+    async def wait_for_calls(self, n: int, timeout_s: float = 5.0) -> None:
+        deadline = time.monotonic() + timeout_s
+        while len(self.call_log) < n:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise AssertionError(
+                    f"only {len(self.call_log)}/{n} calls: {self.call_log}"
+                )
+            self._event.clear()
+            try:
+                await asyncio.wait_for(self._event.wait(), remaining)
+            except asyncio.TimeoutError:
+                pass
+
+    # -- FibService --------------------------------------------------------
+
+    async def add_unicast_routes(self, client_id, routes) -> None:
+        self._note("add_unicast", len(routes))
+        self._maybe_fail("add_unicast")
+        failed = [r.prefix for r in routes if r.prefix in self.fail_prefixes]
+        for r in routes:
+            if r.prefix not in failed:
+                self.unicast[r.prefix] = r
+        if failed:
+            raise FibUpdateError(failed_prefixes=failed)
+
+    async def delete_unicast_routes(self, client_id, prefixes) -> None:
+        self._note("del_unicast", len(prefixes))
+        self._maybe_fail("del_unicast")
+        for p in prefixes:
+            self.unicast.pop(p, None)
+
+    async def add_mpls_routes(self, client_id, routes) -> None:
+        self._note("add_mpls", len(routes))
+        self._maybe_fail("add_mpls")
+        failed = [r.label for r in routes if r.label in self.fail_labels]
+        for r in routes:
+            if r.label not in failed:
+                self.mpls[r.label] = r
+        if failed:
+            raise FibUpdateError(failed_labels=failed)
+
+    async def delete_mpls_routes(self, client_id, labels) -> None:
+        self._note("del_mpls", len(labels))
+        self._maybe_fail("del_mpls")
+        for label in labels:
+            self.mpls.pop(label, None)
+
+    async def sync_fib(self, client_id, routes) -> None:
+        self._note("sync_fib", len(routes))
+        self._maybe_fail("sync_fib")
+        self.sync_count += 1
+        failed = [r.prefix for r in routes if r.prefix in self.fail_prefixes]
+        self.unicast = {
+            r.prefix: r for r in routes if r.prefix not in failed
+        }
+        if failed:
+            raise FibUpdateError(failed_prefixes=failed)
+
+    async def sync_mpls_fib(self, client_id, routes) -> None:
+        self._note("sync_mpls", len(routes))
+        self._maybe_fail("sync_mpls")
+        self.mpls = {r.label: r for r in routes}
+
+    async def alive_since(self) -> float:
+        return self._alive_since

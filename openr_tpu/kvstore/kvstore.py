@@ -139,6 +139,7 @@ class KvStore(Actor):
         self._parallel_sync_limit = _INITIAL_PARALLEL_SYNCS
         self._sync_wakeup = asyncio.Event()
         self._ttl_wakeup = asyncio.Event()
+        self._refresh_wakeup = asyncio.Event()
         self._flood_tokens = float(config.flood_rate_burst_size or 0)
         self._flood_tokens_ts = time.monotonic()
         self._initialized_signalled = False
@@ -603,6 +604,8 @@ class KvStore(Actor):
             ttl_version=0,
         )
         st.self_originated[key] = SelfOriginatedValue(new_val, persisted=True)
+        if ttl_ms != TTL_INFINITY:
+            self._refresh_wakeup.set()
         self._merge_and_flood(
             Publication(key_vals={key: new_val}, area=st.area)
         )
@@ -628,6 +631,8 @@ class KvStore(Actor):
             ttl_version=0,
         )
         st.self_originated[key] = SelfOriginatedValue(new_val, persisted=False)
+        if ttl_ms != TTL_INFINITY:
+            self._refresh_wakeup.set()
         self._merge_and_flood(
             Publication(key_vals={key: new_val}, area=st.area)
         )
@@ -665,7 +670,14 @@ class KvStore(Actor):
             ]
             base_ms = min(finite) if finite else self.cfg.key_ttl_ms
             interval = max(0.02, base_ms / 1e3 / 4)
-            await asyncio.sleep(interval)
+            # interruptible sleep: persisting a shorter-ttl key mid-sleep
+            # must shorten the current cycle, not just the next one
+            try:
+                await asyncio.wait_for(self._refresh_wakeup.wait(), interval)
+                self._refresh_wakeup.clear()
+                continue  # recompute the interval with the new key set
+            except asyncio.TimeoutError:
+                pass
             for st in self.areas.values():
                 refresh: dict[str, Value] = {}
                 for key, own in st.self_originated.items():

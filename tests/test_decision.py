@@ -384,3 +384,30 @@ class TestTpuBackendParity:
                 update = await h.next_route_update()
                 results[backend] = update.unicast_routes_to_update
         assert results["cpu"] == results["tpu"]
+
+
+class TestRibPolicyExpiry:
+    @run_async
+    async def test_policy_expiry_reverts_routes(self):
+        """A zero-weight (drop) policy with a short TTL must revert on
+        expiry without any unrelated LSDB churn."""
+        async with DecisionHarness() as h:
+            two_node_mesh(h)
+            h.synced()
+            await h.next_route_update()
+            policy = RibPolicy(
+                statements=(
+                    RibPolicyStatement(
+                        name="drop",
+                        prefixes=("10.0.0.2/32",),
+                        action=RibRouteActionWeight(default_weight=0),
+                    ),
+                ),
+                ttl_secs=1,
+            )
+            await h.decision.set_rib_policy(policy)
+            update = await h.next_route_update()
+            assert "10.0.0.2/32" in update.unicast_routes_to_delete
+            # expiry re-arms a rebuild with the policy inactive: route back
+            update = await h.next_route_update(timeout=5)
+            assert "10.0.0.2/32" in update.unicast_routes_to_update

@@ -1,0 +1,208 @@
+"""Fib actor tests against the mock FibService with failure injection
+(ref openr/fib/tests/FibTest.cpp + MockNetlinkFibHandler)."""
+
+import asyncio
+
+from openr_tpu.config import FibConfig
+from openr_tpu.decision.rib import (
+    DecisionRouteUpdate,
+    NextHop,
+    RibMplsEntry,
+    RibUnicastEntry,
+    RouteUpdateType,
+)
+from openr_tpu.fib import Fib, FibState, MockFibService
+from openr_tpu.kvstore.wrapper import wait_until
+from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.types import InitializationEvent, PerfEvents
+from tests.conftest import run_async
+
+
+def route(prefix: str, nh: str = "fe80::1") -> RibUnicastEntry:
+    return RibUnicastEntry(
+        prefix=prefix, nexthops=frozenset({NextHop(address=nh)})
+    )
+
+
+def full_sync(*routes: RibUnicastEntry) -> DecisionRouteUpdate:
+    return DecisionRouteUpdate(
+        type=RouteUpdateType.FULL_SYNC,
+        unicast_routes_to_update={r.prefix: r for r in routes},
+        perf_events=PerfEvents(),
+    )
+
+
+def incremental(
+    update: list[RibUnicastEntry] = (), delete: list[str] = ()
+) -> DecisionRouteUpdate:
+    return DecisionRouteUpdate(
+        type=RouteUpdateType.INCREMENTAL,
+        unicast_routes_to_update={r.prefix: r for r in update},
+        unicast_routes_to_delete=list(delete),
+    )
+
+
+class FibHarness:
+    def __init__(self, delete_delay_ms: int = 0):
+        self.service = MockFibService()
+        self.routes_q = ReplicateQueue("routeUpdates")
+        self.fib_q = ReplicateQueue("fibRouteUpdates")
+        self.fib_reader = self.fib_q.get_reader("test")
+        self.fib = Fib(
+            "node1",
+            FibConfig(route_delete_delay_ms=delete_delay_ms),
+            self.service,
+            self.routes_q.get_reader(),
+            self.fib_q,
+            retry_initial_backoff_s=0.02,
+            retry_max_backoff_s=0.1,
+        )
+
+    async def __aenter__(self):
+        await self.fib.start()
+        return self
+
+    async def __aexit__(self, *exc):
+        self.fib_q.close()
+        await self.fib.stop()
+
+
+class TestFibSync:
+    @run_async
+    async def test_initial_full_sync(self):
+        async with FibHarness() as h:
+            h.routes_q.push(full_sync(route("10.0.0.1/32"), route("10.0.0.2/32")))
+            await wait_until(lambda: h.fib.synced)
+            assert set(h.service.unicast) == {"10.0.0.1/32", "10.0.0.2/32"}
+            assert h.service.sync_count == 1
+            # FIB-ACK: programmed delta + FIB_SYNCED event published
+            seen = []
+            while h.fib_reader.size():
+                seen.append(await h.fib_reader.get())
+            assert InitializationEvent.FIB_SYNCED in seen
+            programmed = [
+                s for s in seen if isinstance(s, DecisionRouteUpdate)
+            ]
+            assert programmed and set(
+                programmed[0].unicast_routes_to_update
+            ) == {"10.0.0.1/32", "10.0.0.2/32"}
+
+    @run_async
+    async def test_incremental_ignored_before_full_sync(self):
+        async with FibHarness() as h:
+            h.routes_q.push(incremental([route("10.0.0.9/32")]))
+            await asyncio.sleep(0.1)
+            assert h.fib.route_state.state == FibState.AWAITING_UPDATE
+            assert not h.service.unicast
+            # the route is retained in desired state and programmed by the
+            # eventual full sync
+            h.routes_q.push(full_sync(route("10.0.0.1/32")))
+            await wait_until(lambda: h.fib.synced)
+            assert set(h.service.unicast) == {"10.0.0.1/32", "10.0.0.9/32"}
+
+    @run_async
+    async def test_incremental_add_and_delete(self):
+        async with FibHarness() as h:
+            h.routes_q.push(full_sync(route("10.0.0.1/32")))
+            await wait_until(lambda: h.fib.synced)
+            h.routes_q.push(
+                incremental([route("10.0.0.2/32")], ["10.0.0.1/32"])
+            )
+            await wait_until(
+                lambda: set(h.service.unicast) == {"10.0.0.2/32"}
+            )
+
+    @run_async
+    async def test_mpls_routes(self):
+        async with FibHarness() as h:
+            upd = full_sync(route("10.0.0.1/32"))
+            upd.mpls_routes_to_update = {
+                100: RibMplsEntry(
+                    100, frozenset({NextHop(address="fe80::2")})
+                )
+            }
+            h.routes_q.push(upd)
+            await wait_until(lambda: h.fib.synced)
+            assert 100 in h.service.mpls
+
+
+class TestFibRetry:
+    @run_async
+    async def test_sync_failure_retries(self):
+        async with FibHarness() as h:
+            h.service.fail_next("sync_fib", 2)
+            h.routes_q.push(full_sync(route("10.0.0.1/32")))
+            await wait_until(lambda: h.fib.synced, timeout_s=5)
+            assert h.service.sync_count == 1  # third attempt succeeded
+            assert "10.0.0.1/32" in h.service.unicast
+
+    @run_async
+    async def test_partial_failure_marks_dirty_and_retries(self):
+        async with FibHarness() as h:
+            h.routes_q.push(full_sync(route("10.0.0.1/32")))
+            await wait_until(lambda: h.fib.synced)
+            # 10.0.0.2/32 fails individually twice, then recovers
+            h.service.fail_prefixes.add("10.0.0.2/32")
+            h.routes_q.push(
+                incremental([route("10.0.0.2/32"), route("10.0.0.3/32")])
+            )
+            # the healthy route lands even while the other is dirty
+            await wait_until(lambda: "10.0.0.3/32" in h.service.unicast)
+            assert "10.0.0.2/32" not in h.service.unicast
+            assert not h.fib.synced  # dirty route outstanding
+            h.service.fail_prefixes.clear()
+            await wait_until(lambda: "10.0.0.2/32" in h.service.unicast)
+            await wait_until(lambda: h.fib.synced)
+
+    @run_async
+    async def test_agent_restart_triggers_resync(self):
+        async with FibHarness() as h:
+            h.routes_q.push(full_sync(route("10.0.0.1/32")))
+            await wait_until(lambda: h.fib.synced)
+            assert h.service.sync_count == 1
+            h.service.restart()  # wipes programmed state
+            await wait_until(
+                lambda: h.service.sync_count >= 2
+                and "10.0.0.1/32" in h.service.unicast,
+                timeout_s=5,
+            )
+
+
+class TestFibDelayedDelete:
+    @run_async
+    async def test_delete_is_delayed(self):
+        async with FibHarness(delete_delay_ms=200) as h:
+            h.routes_q.push(full_sync(route("10.0.0.1/32")))
+            await wait_until(lambda: h.fib.synced)
+            h.routes_q.push(incremental(delete=["10.0.0.1/32"]))
+            await asyncio.sleep(0.1)
+            assert "10.0.0.1/32" in h.service.unicast  # still installed
+            await wait_until(
+                lambda: "10.0.0.1/32" not in h.service.unicast, timeout_s=3
+            )
+
+    @run_async
+    async def test_readd_cancels_delayed_delete(self):
+        async with FibHarness(delete_delay_ms=150) as h:
+            h.routes_q.push(full_sync(route("10.0.0.1/32")))
+            await wait_until(lambda: h.fib.synced)
+            h.routes_q.push(incremental(delete=["10.0.0.1/32"]))
+            await asyncio.sleep(0.02)
+            h.routes_q.push(incremental([route("10.0.0.1/32", nh="fe80::9")]))
+            await asyncio.sleep(0.4)
+            assert "10.0.0.1/32" in h.service.unicast
+            (nh,) = h.service.unicast["10.0.0.1/32"].nexthops
+            assert nh.address == "fe80::9"
+
+
+class TestFibPerf:
+    @run_async
+    async def test_perf_events_recorded(self):
+        async with FibHarness() as h:
+            h.routes_q.push(full_sync(route("10.0.0.1/32")))
+            await wait_until(lambda: h.fib.synced)
+            perf_db = await h.fib.get_perf_db()
+            assert perf_db
+            descrs = [e.event_descr for e in perf_db[0].events]
+            assert "FIB_RECEIVED" in descrs
+            assert "FIB_PROGRAMMED" in descrs
