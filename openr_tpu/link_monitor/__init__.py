@@ -1,0 +1,6 @@
+from openr_tpu.link_monitor.link_monitor import (  # noqa: F401
+    AdjacencyValue,
+    LinkMonitor,
+    LinkMonitorState,
+    get_rtt_metric,
+)

@@ -1,0 +1,429 @@
+"""LinkMonitor actor — adjacency management.
+
+Role of the reference's openr/link-monitor/LinkMonitor.{h,cpp}:
+
+  - converts Spark neighbor events into adjacencies; RTT -> metric
+    (getRttMetric = max(rtt_us/100, 1), ref LinkMonitor.cpp:32) or
+    hop-count metric
+  - manages KvStore peer sessions via peerUpdatesQueue: NEIGHBOR_UP adds
+    the peer, NEIGHBOR_DOWN removes it (ref updateKvStorePeerNeighborUp,
+    LinkMonitor.cpp:580)
+  - advertises "adj:<node>" into KvStore via kvRequestQueue, throttled
+    (ref advertiseAdjacencies LinkMonitor.cpp:700, throttle :145-151);
+    adjacency announced only after the peer's initial KvStore sync
+    completes (kvStoreEventsQueue gating)
+  - graceful restart: NEIGHBOR_RESTARTING holds the adjacency up;
+    NEIGHBOR_RESTARTED refreshes it
+  - drain/overload state: node overload, per-link overload, link metric
+    overrides — persisted via PersistentStore (ref LinkMonitorState,
+    Types.thrift:686) and applied to the advertised AdjacencyDatabase
+  - interface tracking with link-flap exponential backoff
+    (ref LinkMonitor.cpp:112-114); up interfaces propagate to Spark via
+    interfaceUpdatesQueue; interface addresses redistribute as prefixes
+    via prefixUpdatesQueue (PrefixEvent)
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from openr_tpu.config import LinkMonitorConfig
+from openr_tpu.messaging import RQueue, ReplicateQueue
+from openr_tpu.runtime.actor import Actor
+from openr_tpu.runtime.counters import counters
+from openr_tpu.runtime.persistent_store import PersistentStore
+from openr_tpu.runtime.throttle import AsyncThrottle, ExponentialBackoff
+from openr_tpu.serde import deserialize, serialize
+from openr_tpu.types import (
+    Adjacency,
+    AdjacencyDatabase,
+    AreaPeerEvent,
+    InterfaceDatabase,
+    InterfaceInfo,
+    KeyValueRequest,
+    KeyValueRequestType,
+    KvStoreSyncEvent,
+    NeighborEvent,
+    NeighborEventType,
+    NeighborInitEvent,
+    PeerSpec,
+    PrefixEntry,
+    PrefixEvent,
+    PrefixEventType,
+    PrefixType,
+    adj_key,
+    replace,
+)
+
+log = logging.getLogger(__name__)
+
+_STATE_KEY = "link-monitor-config"  # ref kConfigKey LinkMonitor.cpp:25
+
+
+def get_rtt_metric(rtt_us: int) -> int:
+    """ref LinkMonitor.cpp:32."""
+    return max(int(rtt_us / 100), 1)
+
+
+@dataclass
+class AdjacencyValue:
+    """Tracked adjacency (ref KvStorePeerValue/AdjacencyValue,
+    LinkMonitor.h:68-96)."""
+
+    event: NeighborEvent
+    metric: int
+    kvstore_synced: bool = False  # announce only after peer's initial sync
+    restarting: bool = False  # GR hold: keep advertised
+
+
+@dataclass
+class LinkMonitorState:
+    """Persisted drain/override state (ref Types.thrift:686)."""
+
+    is_overloaded: bool = False
+    overloaded_links: list[str] = field(default_factory=list)
+    link_metric_overrides: dict[str, int] = field(default_factory=dict)
+    node_metric_increment: int = 0
+
+
+@dataclass
+class _InterfaceState:
+    info: InterfaceInfo
+    backoff: ExponentialBackoff
+    active: bool = False  # advertised up (past flap backoff)
+
+
+class LinkMonitor(Actor):
+    """ref LinkMonitor.h:107."""
+
+    def __init__(
+        self,
+        node_name: str,
+        config: LinkMonitorConfig,
+        neighbor_updates_queue: RQueue,
+        kvstore_events_queue: Optional[RQueue],
+        peer_updates_queue: ReplicateQueue,
+        kv_request_queue: ReplicateQueue,
+        interface_updates_queue: Optional[ReplicateQueue] = None,
+        prefix_updates_queue: Optional[ReplicateQueue] = None,
+        persistent_store: Optional[PersistentStore] = None,
+        node_label: int = 0,
+        kvstore_port_of=None,
+        advertise_throttle_s: float = 0.005,
+    ):
+        super().__init__(f"link-monitor:{node_name}")
+        self.node_name = node_name
+        self.cfg = config
+        self._neighbor_updates = neighbor_updates_queue
+        self._kvstore_events = kvstore_events_queue
+        self._peer_q = peer_updates_queue
+        self._kv_request_q = kv_request_queue
+        self._interface_q = interface_updates_queue
+        self._prefix_q = prefix_updates_queue
+        self._store = persistent_store
+        self.node_label = node_label
+        # hook: map a neighbor event to its kvstore (addr, port) — tests and
+        # the composition root wire this to the in-proc stores
+        self._kvstore_port_of = kvstore_port_of or (
+            lambda ev: ("127.0.0.1", ev.kvstore_port or ev.ctrl_port)
+        )
+
+        # (area, neighbor node, if_name) -> AdjacencyValue
+        self.adjacencies: dict[tuple[str, str, str], AdjacencyValue] = {}
+        # every area we ever advertised into — a vacated area still needs
+        # an empty-adjacency-db refresh so stale links don't linger
+        self._known_areas: set[str] = {"0"}
+        self.state = LinkMonitorState()
+        self.interfaces: dict[str, _InterfaceState] = {}
+        self._advertise_throttle: Optional[AsyncThrottle] = None
+        self._advertise_throttle_s = advertise_throttle_s
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def on_start(self) -> None:
+        self._load_state()
+        self._advertise_throttle = AsyncThrottle(
+            self._advertise_throttle_s, self.advertise_adjacencies
+        )
+        self.add_task(self._neighbor_loop(), name=f"{self.name}.neighbors")
+        if self._kvstore_events is not None:
+            self.add_task(
+                self._kvstore_events_loop(), name=f"{self.name}.kvstore-events"
+            )
+
+    def _load_state(self) -> None:
+        if self._store is None:
+            return
+        raw = self._store.load(_STATE_KEY)
+        if raw is not None:
+            try:
+                self.state = deserialize(raw, LinkMonitorState)
+            except Exception:
+                log.exception("%s: bad persisted state; using defaults", self.name)
+
+    def _save_state(self) -> None:
+        if self._store is not None:
+            self._store.store(_STATE_KEY, serialize(self.state))
+
+    # -- neighbor events (ref processNeighborEvents) -----------------------
+
+    async def _neighbor_loop(self) -> None:
+        while True:
+            item = await self._neighbor_updates.get()
+            if isinstance(item, NeighborInitEvent):
+                for ev in item.events:
+                    self._handle_neighbor_event(ev)
+                continue
+            self._handle_neighbor_event(item)
+
+    def _handle_neighbor_event(self, ev: NeighborEvent) -> None:
+        key = (ev.area, ev.node_name, ev.if_name)
+        if ev.event_type == NeighborEventType.NEIGHBOR_UP:
+            metric = (
+                get_rtt_metric(ev.rtt_us)
+                if self.cfg.use_rtt_metric and ev.rtt_us > 0
+                else 1
+            )
+            new_adj = AdjacencyValue(event=ev, metric=metric)
+            # a parallel adjacency to an already-synced peer inherits the
+            # sync state: KvStore dedups identical peer specs and will not
+            # emit another KvStoreSyncEvent
+            if any(
+                a == ev.area and n == ev.node_name and adj.kvstore_synced
+                for (a, n, _), adj in self.adjacencies.items()
+            ):
+                new_adj.kvstore_synced = True
+            self.adjacencies[key] = new_adj
+            self._known_areas.add(ev.area)
+            addr, port = self._kvstore_port_of(ev)
+            self._peer_q.push(
+                {
+                    ev.area: AreaPeerEvent(
+                        peers_to_add={
+                            ev.node_name: PeerSpec(
+                                peer_addr=addr, ctrl_port=port
+                            )
+                        }
+                    )
+                }
+            )
+            counters.increment("link_monitor.neighbor_up")
+            if self._kvstore_events is None:
+                # sync gating disabled (no events queue): announce now
+                new_adj.kvstore_synced = True
+            if new_adj.kvstore_synced:
+                self._advertise_throttled()
+        elif ev.event_type == NeighborEventType.NEIGHBOR_RESTARTED:
+            adj = self.adjacencies.get(key)
+            if adj is not None:
+                adj.restarting = False
+                adj.event = ev
+                if self.cfg.use_rtt_metric and ev.rtt_us > 0:
+                    adj.metric = get_rtt_metric(ev.rtt_us)
+            else:
+                self._handle_neighbor_event(
+                    replace(ev, event_type=NeighborEventType.NEIGHBOR_UP)
+                )
+                return
+            self._advertise_throttled()
+        elif ev.event_type == NeighborEventType.NEIGHBOR_RESTARTING:
+            adj = self.adjacencies.get(key)
+            if adj is not None:
+                adj.restarting = True  # GR: hold adjacency up
+            counters.increment("link_monitor.neighbor_restarting")
+        elif ev.event_type == NeighborEventType.NEIGHBOR_DOWN:
+            if self.adjacencies.pop(key, None) is not None:
+                # only drop the KvStore peer session when NO adjacency to
+                # this node remains in the area (parallel links)
+                if not any(
+                    a == ev.area and n == ev.node_name
+                    for a, n, _ in self.adjacencies
+                ):
+                    self._peer_q.push(
+                        {ev.area: AreaPeerEvent(peers_to_del=(ev.node_name,))}
+                    )
+                self._advertise_throttled()
+            counters.increment("link_monitor.neighbor_down")
+        elif ev.event_type == NeighborEventType.NEIGHBOR_RTT_CHANGE:
+            adj = self.adjacencies.get(key)
+            if adj is not None and self.cfg.use_rtt_metric:
+                new_metric = get_rtt_metric(ev.rtt_us)
+                if new_metric != adj.metric:
+                    adj.metric = new_metric
+                    self._advertise_throttled()
+
+    async def _kvstore_events_loop(self) -> None:
+        """Adjacency with a peer becomes announceable once the initial
+        full sync with that peer completes (ref kvStoreEventsQueue path)."""
+        while True:
+            ev: KvStoreSyncEvent = await self._kvstore_events.get()
+            changed = False
+            for (area, node, _), adj in self.adjacencies.items():
+                if node == ev.node_name and area == ev.area:
+                    if not adj.kvstore_synced:
+                        adj.kvstore_synced = True
+                        changed = True
+            if changed:
+                self._advertise_throttled()
+
+    # -- adjacency advertisement (ref buildAdjacencyDatabase :700) ---------
+
+    def _advertise_throttled(self) -> None:
+        if self._advertise_throttle is not None:
+            self._advertise_throttle()
+
+    def advertise_adjacencies(self) -> None:
+        for area in self._known_areas | {a for a, _, _ in self.adjacencies}:
+            db = self.build_adjacency_database(area)
+            self._kv_request_q.push(
+                KeyValueRequest(
+                    request_type=KeyValueRequestType.PERSIST,
+                    area=area,
+                    key=adj_key(self.node_name),
+                    value=serialize(db),
+                )
+            )
+        counters.increment("link_monitor.advertise_adjacencies")
+
+    def build_adjacency_database(self, area: str) -> AdjacencyDatabase:
+        adjs = []
+        for (a, node, if_name), adj in sorted(self.adjacencies.items()):
+            if a != area or not adj.kvstore_synced:
+                continue
+            ev = adj.event
+            metric = self.state.link_metric_overrides.get(if_name, adj.metric)
+            adjs.append(
+                Adjacency(
+                    other_node_name=node,
+                    if_name=if_name,
+                    other_if_name=ev.remote_if_name,
+                    metric=metric,
+                    is_overloaded=if_name in self.state.overloaded_links,
+                    rtt_us=ev.rtt_us,
+                    timestamp_s=int(time.time()),
+                    adj_only_used_by_other_node=ev.adj_only_used_by_other_node,
+                )
+            )
+        return AdjacencyDatabase(
+            this_node_name=self.node_name,
+            adjacencies=tuple(adjs),
+            is_overloaded=self.state.is_overloaded,
+            node_label=self.node_label,
+            area=area,
+            node_metric_increment=self.state.node_metric_increment,
+        )
+
+    # -- interface tracking with flap backoff ------------------------------
+
+    def update_interface(self, info: InterfaceInfo) -> None:
+        """System interface snapshot (netlink role). Link flaps back off
+        exponentially before re-advertising (ref LinkMonitor.cpp:112-114)."""
+        st = self.interfaces.get(info.if_name)
+        if st is None:
+            st = self.interfaces[info.if_name] = _InterfaceState(
+                info=info,
+                backoff=ExponentialBackoff(
+                    self.cfg.linkflap_initial_backoff_ms / 1e3,
+                    self.cfg.linkflap_max_backoff_ms / 1e3,
+                ),
+            )
+        was_active = st.active
+        if info.is_up and not st.info.is_up:
+            # coming up: penalize flapping
+            st.backoff.report_error()
+        st.info = info
+        if info.is_up:
+            delay = st.backoff.time_until_retry_s()
+            if delay <= 0:
+                st.active = True
+            else:
+                st.active = False
+                self.schedule(delay + 0.001, self._interface_retry)
+        else:
+            st.active = False
+        if st.active != was_active:
+            self._publish_interfaces()
+
+    def _interface_retry(self) -> None:
+        changed = False
+        for st in self.interfaces.values():
+            if (
+                st.info.is_up
+                and not st.active
+                and st.backoff.time_until_retry_s() <= 0
+            ):
+                st.active = True
+                changed = True
+        if changed:
+            self._publish_interfaces()
+
+    def _publish_interfaces(self) -> None:
+        if self._interface_q is not None:
+            self._interface_q.push(
+                InterfaceDatabase(
+                    interfaces=tuple(
+                        st.info
+                        for st in self.interfaces.values()
+                        if st.active
+                    )
+                )
+            )
+        if self._prefix_q is not None:
+            # redistribute iface addresses as LOOPBACK prefixes
+            entries = [
+                PrefixEntry(prefix=net, type=PrefixType.LOOPBACK)
+                for st in self.interfaces.values()
+                if st.active
+                for net in st.info.networks
+            ]
+            self._prefix_q.push(
+                PrefixEvent(
+                    event_type=PrefixEventType.SYNC_PREFIXES_BY_TYPE,
+                    type=PrefixType.LOOPBACK,
+                    prefixes=entries,
+                )
+            )
+
+    # -- drain / overload APIs (ref semifuture_setNodeOverload etc.) -------
+
+    async def set_node_overload(self, overloaded: bool) -> None:
+        if self.state.is_overloaded != overloaded:
+            self.state.is_overloaded = overloaded
+            self._save_state()
+            self._advertise_throttled()
+
+    async def set_link_overload(self, if_name: str, overloaded: bool) -> None:
+        links = set(self.state.overloaded_links)
+        before = set(links)
+        (links.add if overloaded else links.discard)(if_name)
+        if links != before:
+            self.state.overloaded_links = sorted(links)
+            self._save_state()
+            self._advertise_throttled()
+
+    async def set_link_metric(
+        self, if_name: str, metric: Optional[int]
+    ) -> None:
+        if metric is None:
+            self.state.link_metric_overrides.pop(if_name, None)
+        else:
+            self.state.link_metric_overrides[if_name] = metric
+        self._save_state()
+        self._advertise_throttled()
+
+    async def get_interfaces(self) -> dict[str, InterfaceInfo]:
+        return {name: st.info for name, st in self.interfaces.items()}
+
+    async def get_links(self) -> dict:
+        return {
+            f"{area}/{node}/{if_name}": {
+                "metric": adj.metric,
+                "rtt_us": adj.event.rtt_us,
+                "synced": adj.kvstore_synced,
+                "restarting": adj.restarting,
+            }
+            for (area, node, if_name), adj in self.adjacencies.items()
+        }

@@ -1,0 +1,204 @@
+"""OpenrWrapper — one node's complete module stack, in-process.
+
+Role of the reference's openr/tests/OpenrWrapper.h:38: instantiate the full
+module chain per "node" (kvstore, spark, link-monitor, decision, fib) with
+all queues wired exactly as the daemon does (ref Main.cpp:223-266), over a
+shared MockIoMesh — an emulated multi-node network in one process with
+sped-up timers (ref OpenrSystemTest.cpp:38-48). The daemon composition
+root (main.py) uses the same wiring with real I/O providers.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from openr_tpu.config import (
+    DecisionConfig,
+    FibConfig,
+    KvstoreConfig,
+    LinkMonitorConfig,
+    SparkConfig,
+)
+from openr_tpu.decision.decision import Decision
+from openr_tpu.fib import Fib, MockFibService
+from openr_tpu.fib.fib_service import FibServiceBase
+from openr_tpu.kvstore.kvstore import KvStore
+from openr_tpu.link_monitor import LinkMonitor
+from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.serde import serialize
+from openr_tpu.spark import IoProvider, Spark
+from openr_tpu.types import (
+    KeyValueRequest,
+    KeyValueRequestType,
+    PrefixDatabase,
+    PrefixEntry,
+    prefix_key,
+)
+
+# sped-up timers for in-process emulation (ref OpenrSystemTest.cpp:38-48)
+EMULATION_SPARK_CONFIG = SparkConfig(
+    hello_time_s=0.08,
+    fastinit_hello_time_ms=20,
+    keepalive_time_s=0.05,
+    hold_time_s=0.4,
+    graceful_restart_time_s=0.5,
+    handshake_time_ms=40,
+    min_packets_per_sec=0,
+)
+
+
+class OpenrWrapper:
+    """The whole-stack-per-node seam (SURVEY §4 item 4)."""
+
+    def __init__(
+        self,
+        node_name: str,
+        io_provider: IoProvider,
+        kv_ports: dict[str, int],
+        areas: Optional[list[str]] = None,
+        spark_config: Optional[SparkConfig] = None,
+        kvstore_config: Optional[KvstoreConfig] = None,
+        decision_config: Optional[DecisionConfig] = None,
+        fib_config: Optional[FibConfig] = None,
+        lm_config: Optional[LinkMonitorConfig] = None,
+        fib_service: Optional[FibServiceBase] = None,
+        solver_backend: str = "cpu",
+    ):
+        self.node_name = node_name
+        self.kv_ports = kv_ports  # shared node -> kvstore port registry
+        areas = areas or ["0"]
+
+        # queues (ref Main.cpp:223-239)
+        self.neighbor_updates_queue = ReplicateQueue(f"{node_name}.neighborUpdates")
+        self.peer_updates_queue = ReplicateQueue(f"{node_name}.peerUpdates")
+        self.kv_request_queue = ReplicateQueue(f"{node_name}.kvRequests")
+        self.kvstore_updates_queue = ReplicateQueue(f"{node_name}.kvStoreUpdates")
+        self.kvstore_events_queue = ReplicateQueue(f"{node_name}.kvStoreEvents")
+        self.interface_updates_queue = ReplicateQueue(f"{node_name}.interfaceUpdates")
+        self.static_routes_queue = ReplicateQueue(f"{node_name}.staticRoutes")
+        self.route_updates_queue = ReplicateQueue(f"{node_name}.routeUpdates")
+        self.fib_updates_queue = ReplicateQueue(f"{node_name}.fibRouteUpdates")
+        self.prefix_updates_queue = ReplicateQueue(f"{node_name}.prefixUpdates")
+        self.log_sample_queue = ReplicateQueue(f"{node_name}.logSamples")
+
+        self.kvstore = KvStore(
+            node_name,
+            kvstore_config or KvstoreConfig(),
+            areas,
+            self.peer_updates_queue.get_reader(),
+            self.kv_request_queue.get_reader(),
+            self.kvstore_updates_queue,
+            self.kvstore_events_queue,
+        )
+        self.spark = Spark(
+            node_name,
+            spark_config or EMULATION_SPARK_CONFIG,
+            io_provider,
+            self.neighbor_updates_queue,
+            interface_updates_queue=self.interface_updates_queue.get_reader(),
+        )
+        self.link_monitor = LinkMonitor(
+            node_name,
+            lm_config or LinkMonitorConfig(use_rtt_metric=False),
+            self.neighbor_updates_queue.get_reader(),
+            self.kvstore_events_queue.get_reader(),
+            self.peer_updates_queue,
+            self.kv_request_queue,
+            interface_updates_queue=self.interface_updates_queue,
+            prefix_updates_queue=self.prefix_updates_queue,
+            kvstore_port_of=lambda ev: ("127.0.0.1", self.kv_ports[ev.node_name]),
+            advertise_throttle_s=0.002,
+        )
+        self.decision = Decision(
+            node_name,
+            decision_config or DecisionConfig(debounce_min_ms=5, debounce_max_ms=25),
+            self.kvstore_updates_queue.get_reader(),
+            self.static_routes_queue.get_reader(),
+            self.route_updates_queue,
+            solver_backend=solver_backend,
+        )
+        self.fib_service = fib_service or MockFibService()
+        self.fib = Fib(
+            node_name,
+            fib_config or FibConfig(route_delete_delay_ms=0),
+            self.fib_service,
+            self.route_updates_queue.get_reader(),
+            self.fib_updates_queue,
+            retry_initial_backoff_s=0.02,
+            retry_max_backoff_s=0.2,
+        )
+
+    async def start(self, *interfaces: str) -> None:
+        """Reference start order (Main.cpp): kvstore -> link-monitor ->
+        decision -> fib -> spark (discovery last, once consumers exist)."""
+        await self.kvstore.start()
+        self.kv_ports[self.node_name] = self.kvstore.port
+        for iface in interfaces:
+            self.spark.add_interface(iface)
+        await self.link_monitor.start()
+        await self.decision.start()
+        await self.fib.start()
+        await self.spark.start()
+
+    async def stop(self) -> None:
+        """Reverse teardown (ref Main.cpp:592-599)."""
+        for q in (
+            self.kvstore_updates_queue,
+            self.kvstore_events_queue,
+            self.route_updates_queue,
+            self.fib_updates_queue,
+            self.interface_updates_queue,
+            self.prefix_updates_queue,
+        ):
+            q.close()
+        for actor in (
+            self.spark,
+            self.fib,
+            self.decision,
+            self.link_monitor,
+            self.kvstore,
+        ):
+            await actor.stop()
+
+    # -- convenience -------------------------------------------------------
+
+    def advertise_prefix(self, prefix: str, area: str = "0", **entry_kw) -> None:
+        """Originate a prefix (stand-in for PrefixManager origination)."""
+        self.kv_request_queue.push(
+            KeyValueRequest(
+                request_type=KeyValueRequestType.PERSIST,
+                area=area,
+                key=prefix_key(self.node_name, area, prefix),
+                value=serialize(
+                    PrefixDatabase(
+                        this_node_name=self.node_name,
+                        prefix_entries=(
+                            PrefixEntry(prefix=prefix, **entry_kw),
+                        ),
+                        area=area,
+                    )
+                ),
+            )
+        )
+
+    def withdraw_prefix(self, prefix: str, area: str = "0") -> None:
+        self.kv_request_queue.push(
+            KeyValueRequest(
+                request_type=KeyValueRequestType.PERSIST,
+                area=area,
+                key=prefix_key(self.node_name, area, prefix),
+                value=serialize(
+                    PrefixDatabase(
+                        this_node_name=self.node_name,
+                        prefix_entries=(PrefixEntry(prefix=prefix),),
+                        area=area,
+                        delete_prefix=True,
+                    )
+                ),
+            )
+        )
+
+    @property
+    def fib_routes(self) -> dict:
+        """Programmed routes in the (mock) FIB agent."""
+        return self.fib_service.unicast

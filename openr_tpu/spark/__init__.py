@@ -1,0 +1,7 @@
+from openr_tpu.spark.io_provider import (  # noqa: F401
+    IoProvider,
+    MockIoMesh,
+    MockIoProvider,
+    UdpIoProvider,
+)
+from openr_tpu.spark.spark import Spark, SparkNeighEvent, get_next_state  # noqa: F401

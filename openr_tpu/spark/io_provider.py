@@ -1,0 +1,218 @@
+"""IoProvider — the Spark datagram I/O seam.
+
+Role of the reference's openr/spark/IoProvider.{h,cpp} (raw UDP multicast
+socket shim) and openr/tests/mocks/MockIoProvider.h:41 (in-process fake with
+per-link latency and ConnectedIfPairs topology wiring). Spark is
+constructed against this interface, so tests run an emulated multi-node
+mesh in one process with controllable latency and partitions — the
+testability seam SURVEY §4 calls out.
+
+A real UDP provider (UdpIoProvider) binds the discovery port per interface;
+it exists for the daemon path. Datagrams carry serialized SparkPacket
+(serde.py); timestamps for RTT measurement are stamped by the provider
+(role of the 4 kernel timestamps, ref Spark.h:233).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from openr_tpu.serde import deserialize, serialize
+from openr_tpu.types import SparkPacket
+
+
+@dataclass
+class ReceivedPacket:
+    packet: SparkPacket
+    from_if_name: str  # OUR interface it arrived on
+    sender_addr: str  # opaque sender address (node@iface in the mock)
+    recv_ts_us: int  # provider receive timestamp (RTT measurement)
+    sent_ts_us: int  # sender's transmit timestamp
+
+
+class IoProvider:
+    """Interface: per-interface multicast-ish datagram send/receive."""
+
+    async def send(self, if_name: str, packet: SparkPacket) -> None:
+        raise NotImplementedError
+
+    async def recv(self) -> ReceivedPacket:
+        """Next packet on any of our interfaces; blocks."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class MockIoProvider(IoProvider):
+    """One endpoint of a MockIoMesh; created via mesh.provider(node)."""
+
+    def __init__(self, mesh: "MockIoMesh", node_name: str):
+        self._mesh = mesh
+        self.node_name = node_name
+        self._inbox: asyncio.Queue[ReceivedPacket] = asyncio.Queue()
+
+    async def send(self, if_name: str, packet: SparkPacket) -> None:
+        await self._mesh.deliver(self.node_name, if_name, packet)
+
+    async def recv(self) -> ReceivedPacket:
+        return await self._inbox.get()
+
+    def _push(self, pkt: ReceivedPacket) -> None:
+        self._inbox.put_nowait(pkt)
+
+
+class MockIoMesh:
+    """The wiring: (node, iface) <-> (node, iface) pipes with per-link
+    latency and partition control (ref MockIoProvider ConnectedIfPairs,
+    MockIoProvider.h:18-20)."""
+
+    def __init__(self) -> None:
+        self._providers: dict[str, MockIoProvider] = {}
+        # (node, iface) -> list of (peer_node, peer_iface, latency_s)
+        self._links: dict[tuple[str, str], list[tuple[str, str, float]]] = (
+            collections.defaultdict(list)
+        )
+        self._partitioned: set[frozenset] = set()
+        self.drop_count = 0
+
+    def provider(self, node_name: str) -> MockIoProvider:
+        p = self._providers.get(node_name)
+        if p is None:
+            p = self._providers[node_name] = MockIoProvider(self, node_name)
+        return p
+
+    def connect(
+        self,
+        node_a: str,
+        if_a: str,
+        node_b: str,
+        if_b: str,
+        latency_s: float = 0.0,
+    ) -> None:
+        """Bidirectional wire between two (node, iface) endpoints."""
+        self._links[(node_a, if_a)].append((node_b, if_b, latency_s))
+        self._links[(node_b, if_b)].append((node_a, if_a, latency_s))
+
+    def disconnect(self, node_a: str, if_a: str, node_b: str, if_b: str) -> None:
+        self._links[(node_a, if_a)] = [
+            (n, i, l)
+            for n, i, l in self._links[(node_a, if_a)]
+            if (n, i) != (node_b, if_b)
+        ]
+        self._links[(node_b, if_b)] = [
+            (n, i, l)
+            for n, i, l in self._links[(node_b, if_b)]
+            if (n, i) != (node_a, if_a)
+        ]
+
+    def partition(self, node_a: str, node_b: str) -> None:
+        """Drop all traffic between two nodes (both directions)."""
+        self._partitioned.add(frozenset((node_a, node_b)))
+
+    def heal(self, node_a: str, node_b: str) -> None:
+        self._partitioned.discard(frozenset((node_a, node_b)))
+
+    async def deliver(
+        self, from_node: str, from_if: str, packet: SparkPacket
+    ) -> None:
+        sent_ts_us = int(time.monotonic() * 1e6)
+        raw = serialize(packet)  # wire-realistic copy: no shared objects
+        for peer_node, peer_if, latency_s in self._links.get(
+            (from_node, from_if), ()
+        ):
+            if frozenset((from_node, peer_node)) in self._partitioned:
+                self.drop_count += 1
+                continue
+            peer = self._providers.get(peer_node)
+            if peer is None:
+                self.drop_count += 1
+                continue
+            pkt = ReceivedPacket(
+                packet=deserialize(raw, SparkPacket),
+                from_if_name=peer_if,
+                sender_addr=f"{from_node}@{from_if}",
+                recv_ts_us=0,  # stamped at delivery below
+                sent_ts_us=sent_ts_us,
+            )
+            if latency_s > 0:
+                asyncio.get_running_loop().call_later(
+                    latency_s, self._stamp_and_push, peer, pkt
+                )
+            else:
+                self._stamp_and_push(peer, pkt)
+
+    @staticmethod
+    def _stamp_and_push(peer: MockIoProvider, pkt: ReceivedPacket) -> None:
+        pkt.recv_ts_us = int(time.monotonic() * 1e6)
+        peer._push(pkt)
+
+
+class UdpIoProvider(IoProvider):
+    """Real-socket provider: one UDP socket per interface address on the
+    discovery port (role of the raw mcast socket, Spark.h mcastFd_). Used
+    by the daemon; tests use the mock mesh."""
+
+    def __init__(self, port: int):
+        self.port = port
+        self._transports: dict[str, asyncio.DatagramTransport] = {}
+        self._if_addrs: dict[str, tuple[str, int]] = {}
+        self._inbox: asyncio.Queue[ReceivedPacket] = asyncio.Queue()
+        self._peers: dict[str, list[tuple[str, int]]] = {}
+
+    async def add_interface(
+        self,
+        if_name: str,
+        bind_addr: str = "127.0.0.1",
+        bind_port: Optional[int] = None,
+    ) -> tuple[str, int]:
+        loop = asyncio.get_running_loop()
+        inbox = self._inbox
+
+        class Proto(asyncio.DatagramProtocol):
+            def datagram_received(self, data: bytes, addr) -> None:
+                try:
+                    packet = deserialize(data, SparkPacket)
+                except Exception:
+                    return
+                inbox.put_nowait(
+                    ReceivedPacket(
+                        packet=packet,
+                        from_if_name=if_name,
+                        sender_addr=f"{addr[0]}:{addr[1]}",
+                        recv_ts_us=int(time.monotonic() * 1e6),
+                        sent_ts_us=0,
+                    )
+                )
+
+        transport, _ = await loop.create_datagram_endpoint(
+            Proto, local_addr=(bind_addr, bind_port or 0)
+        )
+        self._transports[if_name] = transport
+        addr = transport.get_extra_info("sockname")[:2]
+        self._if_addrs[if_name] = addr
+        return addr
+
+    def set_peers(self, if_name: str, peers: list[tuple[str, int]]) -> None:
+        """Loopback stand-in for multicast membership: explicit peer list."""
+        self._peers[if_name] = peers
+
+    async def send(self, if_name: str, packet: SparkPacket) -> None:
+        transport = self._transports.get(if_name)
+        if transport is None:
+            return
+        raw = serialize(packet)
+        for addr in self._peers.get(if_name, ()):
+            transport.sendto(raw, addr)
+
+    async def recv(self) -> ReceivedPacket:
+        return await self._inbox.get()
+
+    def close(self) -> None:
+        for t in self._transports.values():
+            t.close()
+        self._transports.clear()

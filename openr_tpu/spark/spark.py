@@ -1,0 +1,588 @@
+"""Spark actor — neighbor discovery & liveness.
+
+Role of the reference's openr/spark/Spark.{h,cpp}: periodic multicast
+hellos carrying a seen-neighbors map (2-way check), unicast handshake
+(area/port negotiation), cheap heartbeats once established, and the
+5-state neighbor FSM (ref Types.thrift:29, transition table Spark.cpp:97-164):
+
+    IDLE        --hello(any)-->             WARM
+    WARM        --hello(sees us)-->         NEGOTIATE   (start handshaking)
+    NEGOTIATE   --handshake-->              ESTABLISHED (NEIGHBOR_UP)
+    NEGOTIATE   --negotiate timeout-->      WARM
+    ESTABLISHED --hello(forgot us)-->       IDLE        (NEIGHBOR_DOWN)
+    ESTABLISHED --hello(restarting)-->      RESTART     (NEIGHBOR_RESTARTING)
+    ESTABLISHED --heartbeat timeout-->      IDLE        (NEIGHBOR_DOWN)
+    RESTART     --hello(sees us)-->         NEGOTIATE   (NEIGHBOR_RESTARTED on est.)
+    RESTART     --GR timeout-->             IDLE        (NEIGHBOR_DOWN)
+
+RTT is measured from the 4 send/receive timestamps reflected through the
+hello exchange (ref Spark.h:233, updateNeighborRtt) and smoothed by a step
+detector (ref StepDetector.h); fast-init mode hellos at a faster cadence
+until initial discovery completes (ref Spark.h fastInit). Per-sender packet
+rate limiting guards against storms (ref Spark.h:511).
+
+I/O goes through the IoProvider seam (io_provider.py) so tests drive an
+in-process latency-aware mesh (MockIoMesh).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from openr_tpu.config import SparkConfig
+from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.runtime.actor import Actor, Timer
+from openr_tpu.runtime.counters import counters
+from openr_tpu.spark.io_provider import IoProvider, ReceivedPacket
+from openr_tpu.types import (
+    InterfaceDatabase,
+    NeighborEvent,
+    NeighborEventType,
+    NeighborInitEvent,
+    ReflectedNeighborInfo,
+    SparkHandshakeMsg,
+    SparkHelloMsg,
+    SparkHeartbeatMsg,
+    SparkNeighState,
+    SparkPacket,
+)
+
+log = logging.getLogger(__name__)
+
+
+class SparkNeighEvent:
+    """ref Types.thrift:37-47."""
+
+    HELLO_RCVD_INFO = 0
+    HELLO_RCVD_NO_INFO = 1
+    HELLO_RCVD_RESTART = 2
+    HEARTBEAT_RCVD = 3
+    HANDSHAKE_RCVD = 4
+    HEARTBEAT_TIMER_EXPIRE = 5
+    NEGOTIATE_TIMER_EXPIRE = 6
+    GR_TIMER_EXPIRE = 7
+    NEGOTIATION_FAILURE = 8
+
+
+# state x event -> next state; None = invalid transition
+# (exact mirror of the reference table, Spark.cpp:97-164)
+_S = SparkNeighState
+_STATE_MAP: dict[SparkNeighState, dict[int, SparkNeighState]] = {
+    _S.IDLE: {
+        SparkNeighEvent.HELLO_RCVD_INFO: _S.WARM,
+        SparkNeighEvent.HELLO_RCVD_NO_INFO: _S.WARM,
+    },
+    _S.WARM: {
+        SparkNeighEvent.HELLO_RCVD_INFO: _S.NEGOTIATE,
+    },
+    _S.NEGOTIATE: {
+        SparkNeighEvent.HANDSHAKE_RCVD: _S.ESTABLISHED,
+        SparkNeighEvent.NEGOTIATE_TIMER_EXPIRE: _S.WARM,
+        SparkNeighEvent.NEGOTIATION_FAILURE: _S.WARM,
+    },
+    _S.ESTABLISHED: {
+        SparkNeighEvent.HELLO_RCVD_NO_INFO: _S.IDLE,
+        SparkNeighEvent.HELLO_RCVD_RESTART: _S.RESTART,
+        SparkNeighEvent.HEARTBEAT_RCVD: _S.ESTABLISHED,
+        SparkNeighEvent.HEARTBEAT_TIMER_EXPIRE: _S.IDLE,
+    },
+    _S.RESTART: {
+        SparkNeighEvent.HELLO_RCVD_INFO: _S.NEGOTIATE,
+        SparkNeighEvent.GR_TIMER_EXPIRE: _S.IDLE,
+    },
+}
+
+
+def get_next_state(
+    state: SparkNeighState, event: int
+) -> Optional[SparkNeighState]:
+    """ref Spark::getNextState (Spark.h:400)."""
+    return _STATE_MAP[state].get(event)
+
+
+@dataclass
+class _NeighborInfo:
+    """Per-(iface, neighbor) session (ref SparkNeighbor, Spark.h:245-338)."""
+
+    node_name: str
+    if_name: str
+    state: SparkNeighState = SparkNeighState.IDLE
+    area: str = ""
+    their_if_name: str = ""  # from their hellos; advertised as other_if_name
+    their_seq_num: int = 0
+    # reflection data for their RTT computation
+    their_last_sent_ts_us: int = 0
+    my_last_rcvd_ts_us: int = 0
+    rtt_us: int = 0
+    reported_rtt_us: int = 0
+    hold_time_ms: int = 0
+    gr_active: bool = False
+    restarted: bool = False  # came back through RESTART
+    ctrl_port: int = 0
+    addr_v6: str = ""
+    addr_v4: str = ""
+    hold_timer: Optional[Timer] = None
+    negotiate_timer: Optional[Timer] = None
+    gr_timer: Optional[Timer] = None
+    handshake_sent: bool = False
+
+
+class Spark(Actor):
+    """ref Spark.h:46."""
+
+    def __init__(
+        self,
+        node_name: str,
+        config: SparkConfig,
+        io_provider: IoProvider,
+        neighbor_updates_queue: ReplicateQueue,
+        resolve_area: Optional[Callable[[str, str], Optional[str]]] = None,
+        ctrl_port: int = 0,
+        interface_updates_queue=None,
+    ):
+        super().__init__(f"spark:{node_name}")
+        self.node_name = node_name
+        self.cfg = config
+        self.io = io_provider
+        self._neighbor_q = neighbor_updates_queue
+        self._interface_updates = interface_updates_queue
+        # area negotiation hook (role of config AreaConfiguration matchers)
+        self._resolve_area = resolve_area or (lambda node, iface: "0")
+        self.ctrl_port = ctrl_port
+
+        self.interfaces: set[str] = set()
+        # (if_name, neighbor_node) -> session
+        self.neighbors: dict[tuple[str, str], _NeighborInfo] = {}
+        self.my_seq_num = 1
+        self._fast_init_until = 0.0
+        self._init_event_sent = False
+        # per-sender token buckets for rate limiting
+        self._rate: dict[str, tuple[float, float]] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def on_start(self) -> None:
+        self._fast_init_until = (
+            time.monotonic() + 4 * self.cfg.fastinit_hello_time_ms / 1e3
+        )
+        self.add_task(self._recv_loop(), name=f"{self.name}.recv")
+        self.add_task(self._hello_loop(), name=f"{self.name}.hello")
+        self.add_task(self._heartbeat_loop_task(), name=f"{self.name}.heartbeat")
+        if self._interface_updates is not None:
+            self.add_task(self._interface_loop(), name=f"{self.name}.ifaces")
+
+    async def on_stop(self) -> None:
+        self.io.close()
+
+    def add_interface(self, if_name: str) -> None:
+        self.interfaces.add(if_name)
+
+    def remove_interface(self, if_name: str) -> None:
+        self.interfaces.discard(if_name)
+        for key, nb in list(self.neighbors.items()):
+            if nb.if_name == if_name:
+                # RESTART sessions are still advertised by LinkMonitor (GR
+                # hold) — they need an explicit DOWN too
+                if nb.state in (
+                    SparkNeighState.ESTABLISHED,
+                    SparkNeighState.RESTART,
+                ):
+                    self._emit(nb, NeighborEventType.NEIGHBOR_DOWN)
+                self._drop_neighbor(key)
+
+    async def _interface_loop(self) -> None:
+        while True:
+            db: InterfaceDatabase = await self._interface_updates.get()
+            new_ifs = {i.if_name for i in db.interfaces if i.is_up}
+            for gone in self.interfaces - new_ifs:
+                self.remove_interface(gone)
+            for added in new_ifs - self.interfaces:
+                self.add_interface(added)
+                # fast-init on new interfaces (ref fastInit semantics)
+                self._fast_init_until = max(
+                    self._fast_init_until,
+                    time.monotonic()
+                    + 2 * self.cfg.fastinit_hello_time_ms / 1e3,
+                )
+
+    # -- send paths --------------------------------------------------------
+
+    async def _hello_loop(self) -> None:
+        while True:
+            now = time.monotonic()
+            fast = now < self._fast_init_until
+            await self._send_hellos(solicit=fast)
+            if not fast and not self._init_event_sent:
+                self._init_event_sent = True
+                self._neighbor_q.push(NeighborInitEvent(init_complete=True))
+            await asyncio.sleep(
+                self.cfg.fastinit_hello_time_ms / 1e3
+                if fast
+                else self.cfg.hello_time_s
+            )
+
+    async def _send_hellos(self, solicit: bool = False) -> None:
+        for if_name in list(self.interfaces):
+            infos = {}
+            for (iface, node), nb in self.neighbors.items():
+                if iface != if_name or nb.their_seq_num == 0:
+                    continue
+                infos[node] = ReflectedNeighborInfo(
+                    seq_num=nb.their_seq_num,
+                    last_nbr_msg_sent_ts_us=nb.their_last_sent_ts_us,
+                    last_my_msg_rcvd_ts_us=nb.my_last_rcvd_ts_us,
+                )
+            hello = SparkHelloMsg(
+                domain_name="",
+                node_name=self.node_name,
+                if_name=if_name,
+                seq_num=self.my_seq_num,
+                neighbor_infos=infos,
+                solicit_response=solicit,
+                sent_ts_us=int(time.monotonic() * 1e6),
+            )
+            self.my_seq_num += 1
+            await self.io.send(if_name, SparkPacket(hello=hello))
+            counters.increment("spark.hello.packets_sent")
+
+    async def _send_handshake(
+        self, nb: _NeighborInfo, is_adj_established: bool
+    ) -> None:
+        msg = SparkHandshakeMsg(
+            node_name=self.node_name,
+            is_adj_established=is_adj_established,
+            hold_time_ms=int(self.cfg.hold_time_s * 1e3),
+            gr_hold_time_ms=int(self.cfg.graceful_restart_time_s * 1e3),
+            openr_ctrl_port=self.ctrl_port,
+            area=nb.area,
+            neighbor_node_name=nb.node_name,
+            transport_address_v6=f"fe80::{self.node_name}",
+        )
+        await self.io.send(nb.if_name, SparkPacket(handshake=msg))
+        counters.increment("spark.handshake.packets_sent")
+
+    async def _heartbeat_loop_task(self) -> None:
+        while True:
+            await asyncio.sleep(self.cfg.keepalive_time_s)
+            sent_ifaces = set()
+            for nb in self.neighbors.values():
+                if (
+                    nb.state == SparkNeighState.ESTABLISHED
+                    and nb.if_name not in sent_ifaces
+                ):
+                    sent_ifaces.add(nb.if_name)
+                    await self.io.send(
+                        nb.if_name,
+                        SparkPacket(
+                            heartbeat=SparkHeartbeatMsg(
+                                node_name=self.node_name,
+                                seq_num=self.my_seq_num,
+                            )
+                        ),
+                    )
+                    counters.increment("spark.heartbeat.packets_sent")
+
+    async def send_restarting_hellos(self) -> None:
+        """Graceful-restart announcement on shutdown (ref Spark GR)."""
+        for if_name in list(self.interfaces):
+            hello = SparkHelloMsg(
+                domain_name="",
+                node_name=self.node_name,
+                if_name=if_name,
+                seq_num=self.my_seq_num,
+                restarting=True,
+                sent_ts_us=int(time.monotonic() * 1e6),
+            )
+            self.my_seq_num += 1
+            await self.io.send(if_name, SparkPacket(hello=hello))
+
+    # -- receive path ------------------------------------------------------
+
+    async def _recv_loop(self) -> None:
+        while True:
+            pkt = await self.io.recv()
+            if pkt.from_if_name not in self.interfaces:
+                continue
+            if not self._rate_limit_ok(pkt.sender_addr):
+                counters.increment("spark.packets_rate_limited")
+                continue
+            try:
+                if pkt.packet.hello is not None:
+                    self._process_hello(pkt)
+                elif pkt.packet.handshake is not None:
+                    await self._process_handshake(pkt)
+                elif pkt.packet.heartbeat is not None:
+                    self._process_heartbeat(pkt)
+            except Exception:
+                log.exception("%s: error processing packet", self.name)
+
+    def _rate_limit_ok(self, sender: str) -> bool:
+        """Token bucket per sender addr (ref Spark.h:511)."""
+        rate = float(self.cfg.min_packets_per_sec)
+        if rate <= 0:
+            return True
+        tokens, ts = self._rate.get(sender, (rate, time.monotonic()))
+        now = time.monotonic()
+        tokens = min(rate, tokens + (now - ts) * rate)
+        if tokens < 1.0:
+            self._rate[sender] = (tokens, now)
+            return False
+        self._rate[sender] = (tokens - 1.0, now)
+        return True
+
+    def _get_neighbor(self, if_name: str, node: str) -> _NeighborInfo:
+        key = (if_name, node)
+        nb = self.neighbors.get(key)
+        if nb is None:
+            nb = self.neighbors[key] = _NeighborInfo(
+                node_name=node, if_name=if_name
+            )
+            area = self._resolve_area(node, if_name)
+            nb.area = area if area is not None else ""
+        return nb
+
+    def _drop_neighbor(self, key: tuple[str, str]) -> None:
+        nb = self.neighbors.pop(key, None)
+        if nb is None:
+            return
+        for t in (nb.hold_timer, nb.negotiate_timer, nb.gr_timer):
+            if t is not None:
+                t.cancel()
+
+    # -- hello processing (ref processHelloMsg Spark.h:135) ----------------
+
+    def _process_hello(self, pkt: ReceivedPacket) -> None:
+        hello = pkt.packet.hello
+        if hello.node_name == self.node_name:
+            return  # our own multicast echo
+        counters.increment("spark.hello.packets_recv")
+        # real-socket providers can't stamp the sender's clock; fall back
+        # to the in-packet timestamp for both reflection and RTT math
+        if not pkt.sent_ts_us:
+            pkt.sent_ts_us = hello.sent_ts_us
+        nb = self._get_neighbor(pkt.from_if_name, hello.node_name)
+        nb.their_if_name = hello.if_name
+        nb.their_seq_num = hello.seq_num
+        nb.their_last_sent_ts_us = pkt.sent_ts_us or hello.sent_ts_us
+        nb.my_last_rcvd_ts_us = pkt.recv_ts_us
+
+        sees_us = self.node_name in hello.neighbor_infos
+        if sees_us:
+            self._update_rtt(nb, pkt, hello.neighbor_infos[self.node_name])
+
+        if hello.restarting:
+            if nb.state == SparkNeighState.ESTABLISHED:
+                self._transition(nb, SparkNeighEvent.HELLO_RCVD_RESTART)
+                nb.gr_active = True
+                self._emit(nb, NeighborEventType.NEIGHBOR_RESTARTING)
+                self._arm_gr_timer(nb)
+            return
+
+        if nb.state == SparkNeighState.IDLE:
+            self._transition(
+                nb,
+                SparkNeighEvent.HELLO_RCVD_INFO
+                if sees_us
+                else SparkNeighEvent.HELLO_RCVD_NO_INFO,
+            )
+            if hello.solicit_response:
+                self.add_task(
+                    self._send_hellos(solicit=False),
+                    name=f"{self.name}.solicited-hello",
+                )
+            return
+        if nb.state == SparkNeighState.WARM:
+            if sees_us:
+                self._transition(nb, SparkNeighEvent.HELLO_RCVD_INFO)
+                self._start_negotiation(nb)
+            return
+        if nb.state == SparkNeighState.ESTABLISHED:
+            if not sees_us:
+                # neighbor forgot us (restarted without GR)
+                self._transition(nb, SparkNeighEvent.HELLO_RCVD_NO_INFO)
+                self._emit(nb, NeighborEventType.NEIGHBOR_DOWN)
+                self._cancel_hold(nb)
+            return
+        if nb.state == SparkNeighState.RESTART:
+            if sees_us:
+                nb.restarted = True
+                self._transition(nb, SparkNeighEvent.HELLO_RCVD_INFO)
+                self._start_negotiation(nb)
+            return
+
+    def _update_rtt(
+        self, nb: _NeighborInfo, pkt: ReceivedPacket, info: ReflectedNeighborInfo
+    ) -> None:
+        """4-timestamp RTT (ref Spark.h:233): (t4-t1) - (t3-t2)."""
+        t1 = info.last_nbr_msg_sent_ts_us  # when WE sent the reflected msg
+        t2 = info.last_my_msg_rcvd_ts_us  # when THEY received it
+        t3 = pkt.sent_ts_us  # when they sent this hello
+        t4 = pkt.recv_ts_us  # when we received it
+        if not (t1 and t2 and t3 and t4):
+            return
+        rtt = (t4 - t1) - (t3 - t2)
+        if rtt <= 0:
+            return
+        nb.rtt_us = rtt
+        # step detection: report only significant moves (ref StepDetector)
+        if nb.reported_rtt_us == 0:
+            nb.reported_rtt_us = rtt
+            return
+        change = abs(rtt - nb.reported_rtt_us) / nb.reported_rtt_us
+        if change > 0.1 and nb.state == SparkNeighState.ESTABLISHED:
+            nb.reported_rtt_us = rtt
+            self._emit(nb, NeighborEventType.NEIGHBOR_RTT_CHANGE)
+
+    # -- handshake (ref processHandshakeMsg Spark.h:145) -------------------
+
+    def _start_negotiation(self, nb: _NeighborInfo) -> None:
+        nb.handshake_sent = True
+        self.add_task(
+            self._send_handshake(nb, is_adj_established=False),
+            name=f"{self.name}.handshake",
+        )
+        if nb.negotiate_timer is None:
+            nb.negotiate_timer = self.make_timer(
+                lambda nb=nb: self._on_negotiate_timeout(nb)
+            )
+        nb.negotiate_timer.schedule(self.cfg.handshake_time_ms / 1e3 * 5)
+
+    def _on_negotiate_timeout(self, nb: _NeighborInfo) -> None:
+        if nb.state == SparkNeighState.NEGOTIATE:
+            self._transition(nb, SparkNeighEvent.NEGOTIATE_TIMER_EXPIRE)
+
+    async def _process_handshake(self, pkt: ReceivedPacket) -> None:
+        msg = pkt.packet.handshake
+        if msg.node_name == self.node_name:
+            return
+        if msg.neighbor_node_name and msg.neighbor_node_name != self.node_name:
+            return  # directed at someone else
+        counters.increment("spark.handshake.packets_recv")
+        nb = self._get_neighbor(pkt.from_if_name, msg.node_name)
+
+        # area validation: both sides must agree (ref area negotiation)
+        if msg.area and nb.area and msg.area != nb.area:
+            log.warning(
+                "%s: area mismatch with %s (%s != %s)",
+                self.name,
+                nb.node_name,
+                msg.area,
+                nb.area,
+            )
+            if nb.state == SparkNeighState.NEGOTIATE:
+                self._transition(nb, SparkNeighEvent.NEGOTIATION_FAILURE)
+            return
+
+        if not msg.is_adj_established:
+            # reply so the peer can also establish (ref handshake reply)
+            await self._send_handshake(nb, is_adj_established=True)
+
+        if nb.state != SparkNeighState.NEGOTIATE:
+            return
+        nb.hold_time_ms = msg.hold_time_ms or int(self.cfg.hold_time_s * 1e3)
+        nb.ctrl_port = msg.openr_ctrl_port
+        nb.addr_v6 = msg.transport_address_v6
+        nb.addr_v4 = msg.transport_address_v4
+        self._transition(nb, SparkNeighEvent.HANDSHAKE_RCVD)
+        if nb.negotiate_timer is not None:
+            nb.negotiate_timer.cancel()
+        if nb.gr_timer is not None:
+            nb.gr_timer.cancel()
+        self._arm_hold_timer(nb)
+        self._emit(
+            nb,
+            NeighborEventType.NEIGHBOR_RESTARTED
+            if nb.restarted
+            else NeighborEventType.NEIGHBOR_UP,
+        )
+        nb.restarted = False
+        nb.gr_active = False
+
+    # -- heartbeat / hold (ref processHeartbeatMsg Spark.h:146) ------------
+
+    def _process_heartbeat(self, pkt: ReceivedPacket) -> None:
+        msg = pkt.packet.heartbeat
+        if msg.node_name == self.node_name:
+            return
+        nb = self.neighbors.get((pkt.from_if_name, msg.node_name))
+        if nb is None or nb.state != SparkNeighState.ESTABLISHED:
+            return
+        self._transition(nb, SparkNeighEvent.HEARTBEAT_RCVD)
+        self._arm_hold_timer(nb)
+
+    def _arm_hold_timer(self, nb: _NeighborInfo) -> None:
+        if nb.hold_timer is None:
+            nb.hold_timer = self.make_timer(
+                lambda nb=nb: self._on_hold_timeout(nb)
+            )
+        hold_s = (nb.hold_time_ms or int(self.cfg.hold_time_s * 1e3)) / 1e3
+        nb.hold_timer.schedule(hold_s)
+
+    def _cancel_hold(self, nb: _NeighborInfo) -> None:
+        if nb.hold_timer is not None:
+            nb.hold_timer.cancel()
+
+    def _on_hold_timeout(self, nb: _NeighborInfo) -> None:
+        if nb.state != SparkNeighState.ESTABLISHED:
+            return
+        self._transition(nb, SparkNeighEvent.HEARTBEAT_TIMER_EXPIRE)
+        self._emit(nb, NeighborEventType.NEIGHBOR_DOWN)
+        counters.increment("spark.neighbor.hold_expired")
+
+    def _arm_gr_timer(self, nb: _NeighborInfo) -> None:
+        if nb.gr_timer is None:
+            nb.gr_timer = self.make_timer(lambda nb=nb: self._on_gr_timeout(nb))
+        self._cancel_hold(nb)
+        nb.gr_timer.schedule(self.cfg.graceful_restart_time_s)
+
+    def _on_gr_timeout(self, nb: _NeighborInfo) -> None:
+        if nb.state != SparkNeighState.RESTART:
+            return
+        self._transition(nb, SparkNeighEvent.GR_TIMER_EXPIRE)
+        self._emit(nb, NeighborEventType.NEIGHBOR_DOWN)
+        counters.increment("spark.neighbor.gr_expired")
+
+    # -- FSM + event emission ----------------------------------------------
+
+    def _transition(self, nb: _NeighborInfo, event: int) -> None:
+        next_state = get_next_state(nb.state, event)
+        if next_state is None:
+            log.debug(
+                "%s: invalid transition %s x %s", self.name, nb.state, event
+            )
+            return
+        if next_state != nb.state:
+            log.debug(
+                "%s: %s/%s %s -> %s",
+                self.name,
+                nb.if_name,
+                nb.node_name,
+                nb.state.name,
+                next_state.name,
+            )
+        nb.state = next_state
+
+    def _emit(self, nb: _NeighborInfo, event_type: NeighborEventType) -> None:
+        self._neighbor_q.push(
+            NeighborEvent(
+                event_type=event_type,
+                node_name=nb.node_name,
+                if_name=nb.if_name,
+                area=nb.area,
+                remote_if_name=nb.their_if_name,
+                neighbor_addr_v6=nb.addr_v6,
+                neighbor_addr_v4=nb.addr_v4,
+                ctrl_port=nb.ctrl_port,
+                rtt_us=nb.reported_rtt_us or nb.rtt_us,
+            )
+        )
+        counters.increment(f"spark.neighbor.{event_type.name.lower()}")
+
+    # -- introspection API (ref getNeighbors) ------------------------------
+
+    async def get_neighbors(self) -> list[_NeighborInfo]:
+        return list(self.neighbors.values())

@@ -349,6 +349,9 @@ class NeighborEvent:
     node_name: str
     if_name: str
     area: str
+    # the NEIGHBOR's interface name (from its hellos) — required for the
+    # bidirectional link verification in LinkState (other_if_name matching)
+    remote_if_name: str = ""
     neighbor_addr_v6: str = ""
     neighbor_addr_v4: str = ""
     ctrl_port: int = 0

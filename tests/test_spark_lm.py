@@ -1,0 +1,412 @@
+"""Spark + LinkMonitor tests over the MockIoMesh seam
+(ref openr/spark/tests/SparkTest.cpp with MockIoProvider, and
+openr/link-monitor/tests/LinkMonitorTest.cpp)."""
+
+import asyncio
+
+from openr_tpu.config import LinkMonitorConfig, SparkConfig
+from openr_tpu.kvstore.wrapper import wait_until
+from openr_tpu.link_monitor import LinkMonitor, get_rtt_metric
+from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.serde import deserialize
+from openr_tpu.spark import MockIoMesh, Spark
+from openr_tpu.types import (
+    AdjacencyDatabase,
+    InterfaceInfo,
+    KeyValueRequestType,
+    KvStoreSyncEvent,
+    NeighborEvent,
+    NeighborEventType,
+    SparkNeighState,
+    adj_key,
+)
+from tests.conftest import run_async
+
+FAST = SparkConfig(
+    hello_time_s=0.08,
+    fastinit_hello_time_ms=20,
+    keepalive_time_s=0.05,
+    hold_time_s=0.3,
+    graceful_restart_time_s=0.5,
+    handshake_time_ms=40,
+    min_packets_per_sec=0,  # no rate limiting in fast tests
+)
+
+
+class SparkNode:
+    def __init__(self, mesh: MockIoMesh, name: str, config=FAST):
+        self.name = name
+        self.neighbor_q = ReplicateQueue(f"{name}.neighborUpdates")
+        self.events = self.neighbor_q.get_reader("test")
+        self.spark = Spark(
+            name, config, mesh.provider(name), self.neighbor_q
+        )
+
+    async def start(self, *ifaces: str):
+        for i in ifaces:
+            self.spark.add_interface(i)
+        await self.spark.start()
+
+    async def stop(self):
+        self.neighbor_q.close()
+        await self.spark.stop()
+
+    async def next_event(self, timeout=5.0) -> NeighborEvent:
+        async def get():
+            while True:
+                item = await self.events.get()
+                if isinstance(item, NeighborEvent):
+                    return item
+
+        return await asyncio.wait_for(get(), timeout)
+
+    async def expect(self, event_type, node=None, timeout=5.0) -> NeighborEvent:
+        async def hunt():
+            while True:
+                ev = await self.next_event()
+                if ev.event_type == event_type and (
+                    node is None or ev.node_name == node
+                ):
+                    return ev
+
+        return await asyncio.wait_for(hunt(), timeout)
+
+
+class TestSparkTwoNode:
+    @run_async
+    async def test_neighbor_up_both_sides(self):
+        mesh = MockIoMesh()
+        a, b = SparkNode(mesh, "a"), SparkNode(mesh, "b")
+        mesh.connect("a", "if-ab", "b", "if-ba")
+        await a.start("if-ab")
+        await b.start("if-ba")
+        try:
+            ev_a = await a.expect(NeighborEventType.NEIGHBOR_UP, "b")
+            ev_b = await b.expect(NeighborEventType.NEIGHBOR_UP, "a")
+            assert ev_a.if_name == "if-ab"
+            assert ev_b.if_name == "if-ba"
+            nbs = await a.spark.get_neighbors()
+            assert nbs[0].state == SparkNeighState.ESTABLISHED
+        finally:
+            await a.stop()
+            await b.stop()
+
+    @run_async
+    async def test_neighbor_down_on_partition(self):
+        mesh = MockIoMesh()
+        a, b = SparkNode(mesh, "a"), SparkNode(mesh, "b")
+        mesh.connect("a", "if-ab", "b", "if-ba")
+        await a.start("if-ab")
+        await b.start("if-ba")
+        try:
+            await a.expect(NeighborEventType.NEIGHBOR_UP, "b")
+            mesh.partition("a", "b")
+            ev = await a.expect(NeighborEventType.NEIGHBOR_DOWN, "b", timeout=5)
+            assert ev.node_name == "b"
+        finally:
+            await a.stop()
+            await b.stop()
+
+    @run_async
+    async def test_reestablish_after_heal(self):
+        mesh = MockIoMesh()
+        a, b = SparkNode(mesh, "a"), SparkNode(mesh, "b")
+        mesh.connect("a", "if-ab", "b", "if-ba")
+        await a.start("if-ab")
+        await b.start("if-ba")
+        try:
+            await a.expect(NeighborEventType.NEIGHBOR_UP, "b")
+            mesh.partition("a", "b")
+            await a.expect(NeighborEventType.NEIGHBOR_DOWN, "b")
+            await b.expect(NeighborEventType.NEIGHBOR_DOWN, "a")
+            mesh.heal("a", "b")
+            await a.expect(NeighborEventType.NEIGHBOR_UP, "b", timeout=8)
+        finally:
+            await a.stop()
+            await b.stop()
+
+    @run_async
+    async def test_graceful_restart_holds_adjacency(self):
+        mesh = MockIoMesh()
+        a, b = SparkNode(mesh, "a"), SparkNode(mesh, "b")
+        mesh.connect("a", "if-ab", "b", "if-ba")
+        await a.start("if-ab")
+        await b.start("if-ba")
+        try:
+            await a.expect(NeighborEventType.NEIGHBOR_UP, "b")
+            # b announces restart, then comes back
+            await b.spark.send_restarting_hellos()
+            await a.expect(NeighborEventType.NEIGHBOR_RESTARTING, "b")
+            # b's fresh hellos (it kept running) re-negotiate
+            await a.expect(NeighborEventType.NEIGHBOR_RESTARTED, "b", timeout=8)
+        finally:
+            await a.stop()
+            await b.stop()
+
+    @run_async
+    async def test_gr_timeout_downs_neighbor(self):
+        mesh = MockIoMesh()
+        cfg = SparkConfig(
+            hello_time_s=0.08,
+            fastinit_hello_time_ms=20,
+            keepalive_time_s=0.05,
+            hold_time_s=0.3,
+            graceful_restart_time_s=0.3,
+            handshake_time_ms=40,
+            min_packets_per_sec=0,
+        )
+        a, b = SparkNode(mesh, "a", cfg), SparkNode(mesh, "b", cfg)
+        mesh.connect("a", "if-ab", "b", "if-ba")
+        await a.start("if-ab")
+        await b.start("if-ba")
+        try:
+            await a.expect(NeighborEventType.NEIGHBOR_UP, "b")
+            await b.spark.send_restarting_hellos()
+            await b.stop()  # b really goes away
+            await a.expect(NeighborEventType.NEIGHBOR_RESTARTING, "b")
+            mesh.partition("a", "b")
+            await a.expect(NeighborEventType.NEIGHBOR_DOWN, "b", timeout=5)
+        finally:
+            await a.stop()
+
+    @run_async
+    async def test_rtt_measured(self):
+        mesh = MockIoMesh()
+        a, b = SparkNode(mesh, "a"), SparkNode(mesh, "b")
+        mesh.connect("a", "if-ab", "b", "if-ba", latency_s=0.02)
+        await a.start("if-ab")
+        await b.start("if-ba")
+        try:
+            await a.expect(NeighborEventType.NEIGHBOR_UP, "b", timeout=8)
+            await wait_until(
+                lambda: a.spark.neighbors[("if-ab", "b")].rtt_us > 0,
+                timeout_s=5,
+            )
+            rtt = a.spark.neighbors[("if-ab", "b")].rtt_us
+            # one-way 20ms -> rtt ~40ms
+            assert 20_000 < rtt < 200_000, rtt
+        finally:
+            await a.stop()
+            await b.stop()
+
+
+class TestSparkHubSpoke:
+    @run_async
+    async def test_three_node_star(self):
+        """hub h with two spokes s1, s2 on separate interfaces."""
+        mesh = MockIoMesh()
+        h = SparkNode(mesh, "h")
+        s1, s2 = SparkNode(mesh, "s1"), SparkNode(mesh, "s2")
+        mesh.connect("h", "if-1", "s1", "if-h")
+        mesh.connect("h", "if-2", "s2", "if-h")
+        await h.start("if-1", "if-2")
+        await s1.start("if-h")
+        await s2.start("if-h")
+        try:
+            up = set()
+            while up != {"s1", "s2"}:
+                ev = await h.expect(NeighborEventType.NEIGHBOR_UP)
+                up.add(ev.node_name)
+            assert {
+                (nb.if_name, nb.node_name) for nb in await h.spark.get_neighbors()
+            } == {("if-1", "s1"), ("if-2", "s2")}
+            # spokes do NOT see each other (separate segments)
+            assert all(
+                nb.node_name == "h" for nb in await s1.spark.get_neighbors()
+            )
+        finally:
+            await h.stop()
+            await s1.stop()
+            await s2.stop()
+
+
+class TestLinkMonitor:
+    def _make(self, kvstore_events=True):
+        neighbor_q = ReplicateQueue("neighborUpdates")
+        kvstore_ev_q = ReplicateQueue("kvStoreEvents")
+        peer_q = ReplicateQueue("peerUpdates")
+        kv_req_q = ReplicateQueue("kvRequests")
+        lm = LinkMonitor(
+            "node1",
+            LinkMonitorConfig(
+                linkflap_initial_backoff_ms=1, linkflap_max_backoff_ms=8
+            ),
+            neighbor_q.get_reader(),
+            kvstore_ev_q.get_reader() if kvstore_events else None,
+            peer_q,
+            kv_req_q,
+            advertise_throttle_s=0.001,
+        )
+        return lm, neighbor_q, kvstore_ev_q, peer_q.get_reader("t"), kv_req_q.get_reader("t")
+
+    @staticmethod
+    def neighbor_up(node="nbr", rtt_us=500, area="0"):
+        return NeighborEvent(
+            event_type=NeighborEventType.NEIGHBOR_UP,
+            node_name=node,
+            if_name=f"if-{node}",
+            area=area,
+            ctrl_port=1234,
+            rtt_us=rtt_us,
+        )
+
+    @run_async
+    async def test_neighbor_up_adds_peer_and_advertises_after_sync(self):
+        lm, nq, kvq, peers, reqs = self._make()
+        await lm.start()
+        try:
+            nq.push(self.neighbor_up())
+            peer_ev = await asyncio.wait_for(peers.get(), 2)
+            assert "nbr" in peer_ev["0"].peers_to_add
+            # not announced yet: initial sync with peer pending
+            await asyncio.sleep(0.05)
+            assert reqs.size() == 0
+            kvq.push(KvStoreSyncEvent("nbr", "0"))
+            req = await asyncio.wait_for(reqs.get(), 2)
+            assert req.request_type == KeyValueRequestType.PERSIST
+            assert req.key == adj_key("node1")
+            db = deserialize(req.value, AdjacencyDatabase)
+            assert db.adjacencies[0].other_node_name == "nbr"
+            assert db.adjacencies[0].metric == get_rtt_metric(500)
+        finally:
+            await lm.stop()
+
+    @run_async
+    async def test_neighbor_down_removes_peer_and_readvertises(self):
+        lm, nq, kvq, peers, reqs = self._make()
+        await lm.start()
+        try:
+            nq.push(self.neighbor_up())
+            await asyncio.wait_for(peers.get(), 2)
+            kvq.push(KvStoreSyncEvent("nbr", "0"))
+            await asyncio.wait_for(reqs.get(), 2)
+            nq.push(
+                NeighborEvent(
+                    event_type=NeighborEventType.NEIGHBOR_DOWN,
+                    node_name="nbr",
+                    if_name="if-nbr",
+                    area="0",
+                )
+            )
+            peer_ev = await asyncio.wait_for(peers.get(), 2)
+            assert "nbr" in peer_ev["0"].peers_to_del
+            req = await asyncio.wait_for(reqs.get(), 2)
+            db = deserialize(req.value, AdjacencyDatabase)
+            assert db.adjacencies == ()
+        finally:
+            await lm.stop()
+
+    @run_async
+    async def test_rtt_change_updates_metric(self):
+        lm, nq, kvq, peers, reqs = self._make()
+        await lm.start()
+        try:
+            nq.push(self.neighbor_up(rtt_us=500))
+            await asyncio.wait_for(peers.get(), 2)
+            kvq.push(KvStoreSyncEvent("nbr", "0"))
+            await asyncio.wait_for(reqs.get(), 2)
+            nq.push(
+                NeighborEvent(
+                    event_type=NeighborEventType.NEIGHBOR_RTT_CHANGE,
+                    node_name="nbr",
+                    if_name="if-nbr",
+                    area="0",
+                    rtt_us=5000,
+                )
+            )
+            req = await asyncio.wait_for(reqs.get(), 2)
+            db = deserialize(req.value, AdjacencyDatabase)
+            assert db.adjacencies[0].metric == get_rtt_metric(5000)
+        finally:
+            await lm.stop()
+
+    @run_async
+    async def test_node_overload_advertised(self):
+        lm, nq, kvq, peers, reqs = self._make()
+        await lm.start()
+        try:
+            nq.push(self.neighbor_up())
+            await asyncio.wait_for(peers.get(), 2)
+            kvq.push(KvStoreSyncEvent("nbr", "0"))
+            await asyncio.wait_for(reqs.get(), 2)
+            await lm.set_node_overload(True)
+            req = await asyncio.wait_for(reqs.get(), 2)
+            db = deserialize(req.value, AdjacencyDatabase)
+            assert db.is_overloaded
+        finally:
+            await lm.stop()
+
+    @run_async
+    async def test_link_metric_override(self):
+        lm, nq, kvq, peers, reqs = self._make()
+        await lm.start()
+        try:
+            nq.push(self.neighbor_up())
+            await asyncio.wait_for(peers.get(), 2)
+            kvq.push(KvStoreSyncEvent("nbr", "0"))
+            await asyncio.wait_for(reqs.get(), 2)
+            await lm.set_link_metric("if-nbr", 777)
+            req = await asyncio.wait_for(reqs.get(), 2)
+            db = deserialize(req.value, AdjacencyDatabase)
+            assert db.adjacencies[0].metric == 777
+        finally:
+            await lm.stop()
+
+    @run_async
+    async def test_state_persistence(self, tmp_path=None):
+        import tempfile
+
+        from openr_tpu.runtime.persistent_store import PersistentStore
+
+        with tempfile.TemporaryDirectory() as d:
+            store = PersistentStore(f"{d}/state.bin")
+            lm, nq, kvq, peers, reqs = self._make()
+            lm._store = store
+            await lm.start()
+            await lm.set_node_overload(True)
+            await lm.stop()
+            store.close()
+
+            store2 = PersistentStore(f"{d}/state.bin")
+            lm2, *_ = self._make()
+            lm2._store = store2
+            await lm2.start()
+            try:
+                assert lm2.state.is_overloaded
+            finally:
+                await lm2.stop()
+                store2.close()
+
+    @run_async
+    async def test_interface_flap_backoff(self):
+        lm, nq, kvq, peers, reqs = self._make()
+        iface_q = ReplicateQueue("interfaceUpdates")
+        iface_reader = iface_q.get_reader("t")
+        lm._interface_q = iface_q
+        await lm.start()
+        try:
+            up = InterfaceInfo(if_name="eth0", is_up=True, networks=("10.0.0.1/32",))
+            down = InterfaceInfo(if_name="eth0", is_up=False)
+            lm.update_interface(down)
+            lm.update_interface(up)  # first flap: 1ms backoff
+            await wait_until(
+                lambda: any(
+                    i.if_name == "eth0"
+                    for db in self._drain(iface_reader)
+                    for i in db.interfaces
+                )
+                or lm.interfaces["eth0"].active,
+                timeout_s=2,
+            )
+            assert lm.interfaces["eth0"].active
+        finally:
+            await lm.stop()
+
+    @staticmethod
+    def _drain(reader):
+        out = []
+        while reader.size():
+            ok, item = reader.try_get()
+            if ok:
+                out.append(item)
+        return out

@@ -1,0 +1,173 @@
+"""N-node single-process system tests — the end-to-end slice.
+
+Role of the reference's openr/tests/OpenrSystemTest.cpp: multiple complete
+node stacks (OpenrWrapper) share a MockIoMesh, forming an emulated network
+in one process with sped-up timers; tests assert end-to-end route
+convergence (ref RingTopologyMultiPathTest :243; 4-node mesh = BASELINE
+config #1's example_openr.conf topology).
+"""
+
+import asyncio
+import itertools
+
+from openr_tpu.kvstore.wrapper import wait_until
+from openr_tpu.runtime.openr_wrapper import OpenrWrapper
+from openr_tpu.spark import MockIoMesh
+from tests.conftest import run_async
+
+CONVERGENCE_S = 20.0  # generous bound; typ. < 3s (ref kMaxOpenrSyncTime)
+
+
+async def start_mesh(names, links):
+    """links: list of (node_a, if_a, node_b, if_b)."""
+    mesh = MockIoMesh()
+    kv_ports: dict[str, int] = {}
+    nodes = {n: OpenrWrapper(n, mesh.provider(n), kv_ports) for n in names}
+    for a, if_a, b, if_b in links:
+        mesh.connect(a, if_a, b, if_b)
+    ifaces = {n: [] for n in names}
+    for a, if_a, b, if_b in links:
+        ifaces[a].append(if_a)
+        ifaces[b].append(if_b)
+    for n, w in nodes.items():
+        await w.start(*ifaces[n])
+    return mesh, nodes
+
+
+async def stop_all(nodes):
+    for w in nodes.values():
+        await w.stop()
+
+
+def loopback(i: int) -> str:
+    return f"10.0.0.{i + 1}/32"
+
+
+class TestFourNodeMesh:
+    """BASELINE config #1: 4-node full mesh, every node originates its
+    loopback; every node must program routes to the other three."""
+
+    @run_async
+    async def test_full_mesh_converges(self):
+        names = [f"node-{i}" for i in range(4)]
+        links = [
+            (a, f"if-{a}-{b}", b, f"if-{b}-{a}")
+            for a, b in itertools.combinations(names, 2)
+        ]
+        mesh, nodes = await start_mesh(names, links)
+        try:
+            for i, n in enumerate(names):
+                nodes[n].advertise_prefix(loopback(i))
+
+            def converged():
+                for i, n in enumerate(names):
+                    expect = {loopback(j) for j in range(4) if j != i}
+                    if set(nodes[n].fib_routes) != expect:
+                        return False
+                return True
+
+            await wait_until(converged, timeout_s=CONVERGENCE_S)
+            # direct single-hop next hops in a full mesh
+            for i, n in enumerate(names):
+                for j, m in enumerate(names):
+                    if i == j:
+                        continue
+                    entry = nodes[n].fib_routes[loopback(j)]
+                    assert {nh.neighbor_node_name for nh in entry.nexthops} == {m}
+        finally:
+            await stop_all(nodes)
+
+    @run_async
+    async def test_node_failure_reroutes(self):
+        """Ring 0-1-2-3-0: kill the 0-1 link; 0 must reach 1's loopback
+        the long way (via 3)."""
+        names = [f"node-{i}" for i in range(4)]
+        links = [
+            ("node-0", "if-01", "node-1", "if-10"),
+            ("node-1", "if-12", "node-2", "if-21"),
+            ("node-2", "if-23", "node-3", "if-32"),
+            ("node-3", "if-30", "node-0", "if-03"),
+        ]
+        mesh, nodes = await start_mesh(names, links)
+        try:
+            for i, n in enumerate(names):
+                nodes[n].advertise_prefix(loopback(i))
+            await wait_until(
+                lambda: loopback(1) in nodes["node-0"].fib_routes,
+                timeout_s=CONVERGENCE_S,
+            )
+            entry = nodes["node-0"].fib_routes[loopback(1)]
+            assert {nh.neighbor_node_name for nh in entry.nexthops} == {
+                "node-1"
+            }
+            # cut the direct link (both the wire and the hellos)
+            mesh.disconnect("node-0", "if-01", "node-1", "if-10")
+
+            def rerouted():
+                entry = nodes["node-0"].fib_routes.get(loopback(1))
+                if entry is None:
+                    return False
+                return {nh.neighbor_node_name for nh in entry.nexthops} == {
+                    "node-3"
+                }
+
+            await wait_until(rerouted, timeout_s=CONVERGENCE_S)
+        finally:
+            await stop_all(nodes)
+
+    @run_async
+    async def test_prefix_withdrawal_propagates(self):
+        names = ["node-0", "node-1", "node-2"]
+        links = [
+            ("node-0", "if-01", "node-1", "if-10"),
+            ("node-1", "if-12", "node-2", "if-21"),
+        ]
+        mesh, nodes = await start_mesh(names, links)
+        try:
+            nodes["node-2"].advertise_prefix("10.9.0.0/24")
+            await wait_until(
+                lambda: "10.9.0.0/24" in nodes["node-0"].fib_routes,
+                timeout_s=CONVERGENCE_S,
+            )
+            # multihop: node-0 reaches it via node-1
+            entry = nodes["node-0"].fib_routes["10.9.0.0/24"]
+            assert {nh.neighbor_node_name for nh in entry.nexthops} == {
+                "node-1"
+            }
+            nodes["node-2"].withdraw_prefix("10.9.0.0/24")
+            await wait_until(
+                lambda: "10.9.0.0/24" not in nodes["node-0"].fib_routes,
+                timeout_s=CONVERGENCE_S,
+            )
+        finally:
+            await stop_all(nodes)
+
+
+class TestEcmpSystem:
+    @run_async
+    async def test_diamond_ecmp_end_to_end(self):
+        """0-1-3 / 0-2-3 diamond: 0's route to 3's loopback carries both
+        next hops all the way into the programmed FIB."""
+        names = [f"node-{i}" for i in range(4)]
+        links = [
+            ("node-0", "if-01", "node-1", "if-10"),
+            ("node-0", "if-02", "node-2", "if-20"),
+            ("node-1", "if-13", "node-3", "if-31"),
+            ("node-2", "if-23", "node-3", "if-32"),
+        ]
+        mesh, nodes = await start_mesh(names, links)
+        try:
+            nodes["node-3"].advertise_prefix(loopback(3))
+
+            def has_ecmp():
+                entry = nodes["node-0"].fib_routes.get(loopback(3))
+                if entry is None:
+                    return False
+                return {nh.neighbor_node_name for nh in entry.nexthops} == {
+                    "node-1",
+                    "node-2",
+                }
+
+            await wait_until(has_ecmp, timeout_s=CONVERGENCE_S)
+        finally:
+            await stop_all(nodes)
