@@ -1,0 +1,5 @@
+from openr_tpu.allocators.range_allocator import (  # noqa: F401
+    ALLOC_PREFIX_MARKER,
+    PrefixAllocator,
+    RangeAllocator,
+)

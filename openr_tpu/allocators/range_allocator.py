@@ -1,0 +1,237 @@
+"""Distributed unique-index allocation over KvStore.
+
+Role of the reference's openr/allocators/RangeAllocator{-inl.h,.h} (:22)
+and PrefixAllocator.{h,cpp} (:35): claim a unique index from a range by
+proposing a KvStore key `<prefix><idx>` valued with our node name; the
+CRDT merge picks a single winner per key network-wide. Losing the merge
+(another node's value survives) triggers a re-roll with backoff; holding
+the key uncontested for a settle period confirms the claim. PrefixAllocator
+derives the node's prefix from a seed prefix + the allocated index and
+advertises it via a PrefixEvent (ref SEEDED mode).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+from typing import Callable, Optional
+
+from openr_tpu.kvstore.kvstore import KvStore
+from openr_tpu.messaging import RQueue, ReplicateQueue
+from openr_tpu.runtime.actor import Actor
+from openr_tpu.runtime.counters import counters
+from openr_tpu.runtime.throttle import ExponentialBackoff
+from openr_tpu.types import (
+    KeyValueRequest,
+    KeyValueRequestType,
+    PrefixEntry,
+    PrefixEvent,
+    PrefixEventType,
+    PrefixType,
+    Publication,
+    parse_prefix,
+)
+
+log = logging.getLogger(__name__)
+
+ALLOC_PREFIX_MARKER = "allocprefix:"  # ref Constants::kPrefixAllocMarker
+
+
+class RangeAllocator(Actor):
+    """ref RangeAllocator.h:22."""
+
+    def __init__(
+        self,
+        node_name: str,
+        kvstore: KvStore,
+        kvstore_updates_reader: RQueue,
+        callback: Callable[[int], None],
+        range_start: int,
+        range_end: int,  # inclusive
+        area: str = "0",
+        key_marker: str = ALLOC_PREFIX_MARKER,
+        settle_s: float = 0.1,
+        backoff_initial_s: float = 0.02,
+        backoff_max_s: float = 1.0,
+    ):
+        super().__init__(f"range-allocator:{node_name}")
+        assert range_end >= range_start
+        self.node_name = node_name
+        self.kvstore = kvstore
+        self._updates = kvstore_updates_reader
+        self._callback = callback
+        self.range_start = range_start
+        self.range_end = range_end
+        self.area = area
+        self.key_marker = key_marker
+        self.settle_s = settle_s
+        self.my_value = node_name.encode()
+        self.current_index: Optional[int] = None
+        self.allocated_index: Optional[int] = None
+        self._attempt = 0
+        self._backoff = ExponentialBackoff(backoff_initial_s, backoff_max_s)
+        self._settle_timer = None
+
+    async def on_start(self) -> None:
+        self._settle_timer = self.make_timer(self._on_settled)
+        self.add_task(self._watch_loop(), name=f"{self.name}.watch")
+        self._try_allocate()
+
+    def _key(self, idx: int) -> str:
+        return f"{self.key_marker}{idx}"
+
+    def _pick_index(self) -> int:
+        """Deterministic pseudo-random probe sequence per node
+        (ref initial value hash of node name)."""
+        span = self.range_end - self.range_start + 1
+        h = hashlib.blake2b(
+            f"{self.node_name}:{self._attempt}".encode(), digest_size=8
+        )
+        return self.range_start + int.from_bytes(h.digest(), "little") % span
+
+    def _try_allocate(self) -> None:
+        span = self.range_end - self.range_start + 1
+        st = self.kvstore.areas[self.area]
+        # probe from the hash position for a key not owned by someone else
+        for probe in range(span):
+            self._attempt += 1
+            idx = self._pick_index()
+            key = self._key(idx)
+            existing = st.kv.get(key)
+            if existing is not None and existing.value != self.my_value:
+                continue  # taken by another node
+            self.current_index = idx
+            self.kvstore.process_key_value_request(
+                KeyValueRequest(
+                    request_type=KeyValueRequestType.PERSIST,
+                    area=self.area,
+                    key=key,
+                    value=self.my_value,
+                )
+            )
+            counters.increment("range_allocator.proposals")
+            self._settle_timer.schedule(self.settle_s)
+            return
+        log.warning("%s: range exhausted; retrying with backoff", self.name)
+        self._backoff.report_error()
+        self.schedule(
+            max(0.01, self._backoff.time_until_retry_s()), self._try_allocate
+        )
+
+    def _on_settled(self) -> None:
+        """Held the key uncontested for settle_s: claim confirmed."""
+        if self.current_index is None:
+            return
+        st = self.kvstore.areas[self.area]
+        live = st.kv.get(self._key(self.current_index))
+        if live is None or live.value != self.my_value:
+            self._lost()
+            return
+        if self.allocated_index != self.current_index:
+            self.allocated_index = self.current_index
+            counters.increment("range_allocator.allocations")
+            self._callback(self.allocated_index)
+
+    def _lost(self) -> None:
+        """Our claim was beaten — drop it and re-roll elsewhere
+        (ref collision detection on merge)."""
+        if self.current_index is not None:
+            st = self.kvstore.areas[self.area]
+            st.self_originated.pop(self._key(self.current_index), None)
+        self.current_index = None
+        if self.allocated_index is not None:
+            self.allocated_index = None
+        counters.increment("range_allocator.collisions")
+        self._backoff.report_error()
+        self.schedule(
+            max(0.01, self._backoff.time_until_retry_s()), self._try_allocate
+        )
+
+    async def _watch_loop(self) -> None:
+        while True:
+            item = await self._updates.get()
+            if not isinstance(item, Publication):
+                continue
+            if self.current_index is None:
+                continue
+            key = self._key(self.current_index)
+            if key in item.expired_keys:
+                continue  # our refresh defends it
+            val = item.key_vals.get(key)
+            if val is None or val.value is None:
+                continue
+            if val.value != self.my_value:
+                self._lost()
+
+
+class PrefixAllocator(Actor):
+    """Derive the node's prefix from (seed prefix, allocated index) and
+    advertise it (ref PrefixAllocator.h:35, SEEDED mode)."""
+
+    def __init__(
+        self,
+        node_name: str,
+        kvstore: KvStore,
+        kvstore_updates_reader: RQueue,
+        prefix_updates_queue: ReplicateQueue,
+        seed_prefix: str,
+        allocate_prefix_len: int,
+        area: str = "0",
+        **allocator_kwargs,
+    ):
+        super().__init__(f"prefix-allocator:{node_name}")
+        self.node_name = node_name
+        self.seed = parse_prefix(seed_prefix)
+        self.alloc_len = allocate_prefix_len
+        assert self.alloc_len > self.seed.prefixlen, (
+            "allocation length must exceed seed prefix length"
+        )
+        n_subnets = 1 << (self.alloc_len - self.seed.prefixlen)
+        self._prefix_q = prefix_updates_queue
+        self.allocated_prefix: Optional[str] = None
+        self.range_allocator = RangeAllocator(
+            node_name,
+            kvstore,
+            kvstore_updates_reader,
+            self._on_allocated,
+            range_start=0,
+            range_end=n_subnets - 1,
+            area=area,
+            **allocator_kwargs,
+        )
+
+    async def on_start(self) -> None:
+        await self.range_allocator.start()
+
+    async def on_stop(self) -> None:
+        await self.range_allocator.stop()
+
+    def _on_allocated(self, index: int) -> None:
+        subnet_bits = self.alloc_len - self.seed.prefixlen
+        host_bits = self.seed.max_prefixlen - self.alloc_len
+        base = int(self.seed.network_address)
+        addr = base + (index << host_bits)
+        net = parse_prefix(
+            f"{self.seed.network_address.__class__(addr)}/{self.alloc_len}"
+        )
+        self.allocated_prefix = str(net)
+        log.info(
+            "%s: allocated index %d -> %s (of %d subnets)",
+            self.name,
+            index,
+            self.allocated_prefix,
+            1 << subnet_bits,
+        )
+        self._prefix_q.push(
+            PrefixEvent(
+                event_type=PrefixEventType.SYNC_PREFIXES_BY_TYPE,
+                type=PrefixType.PREFIX_ALLOCATOR,
+                prefixes=[
+                    PrefixEntry(
+                        prefix=self.allocated_prefix,
+                        type=PrefixType.PREFIX_ALLOCATOR,
+                    )
+                ],
+            )
+        )
+        counters.increment("prefix_allocator.allocations")

@@ -1,0 +1,4 @@
+from openr_tpu.prefix_manager.prefix_manager import (  # noqa: F401
+    OriginatedPrefix,
+    PrefixManager,
+)

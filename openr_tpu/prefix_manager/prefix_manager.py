@@ -1,0 +1,368 @@
+"""PrefixManager actor — owns all prefix advertisement.
+
+Role of the reference's openr/prefix-manager/PrefixManager.{h,cpp} (:81):
+
+  - sources: PrefixEvent queue (plugins / LinkMonitor address
+    redistribution / allocator / CLI), originated-from-config prefixes,
+    and route redistribution from the Fib's PROGRAMMED delta
+    (fibRouteUpdatesQueue — the FIB-ACK path, ref Main.cpp:381-400)
+  - per-prefix, per-type ranked prefixMap_: when several sources advertise
+    the same prefix, the highest-ranked type wins (ref prefix-type ranking)
+  - syncs "prefix:<node>:[<area>]:<prefix>" keys into KvStore via
+    kvRequestQueue, throttled (ref syncKvStore)
+  - originated prefixes (config): supernode aggregation — advertise the
+    covering prefix only while >= minimum_supporting_routes programmed
+    subnets exist; install_to_fib emits a static route to Decision via
+    staticRouteUpdatesQueue (ref OriginatedPrefix, OpenrConfig.thrift:398)
+  - emits initialization event PREFIX_DB_SYNCED
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Optional
+
+from openr_tpu.decision.rib import (
+    DecisionRouteUpdate,
+    NextHop,
+    RibUnicastEntry,
+)
+from openr_tpu.messaging import RQueue, ReplicateQueue
+from openr_tpu.runtime.actor import Actor
+from openr_tpu.runtime.counters import counters
+from openr_tpu.runtime.throttle import AsyncThrottle
+from openr_tpu.serde import serialize
+from openr_tpu.types import (
+    InitializationEvent,
+    KeyValueRequest,
+    KeyValueRequestType,
+    PrefixDatabase,
+    PrefixEntry,
+    PrefixEvent,
+    PrefixEventType,
+    PrefixType,
+    parse_prefix,
+    prefix_key,
+    replace,
+)
+
+log = logging.getLogger(__name__)
+
+# higher rank wins when multiple types advertise one prefix
+# (ref PrefixManager prefix-type preference)
+_TYPE_RANK = {
+    PrefixType.LOOPBACK: 9,
+    PrefixType.CONFIG: 8,
+    PrefixType.VIP: 7,
+    PrefixType.BGP: 6,
+    PrefixType.DEFAULT: 5,
+    PrefixType.PREFIX_ALLOCATOR: 4,
+    PrefixType.BREEZE: 3,
+    PrefixType.RIB: 1,
+}
+
+
+@dataclass
+class OriginatedPrefix:
+    """Config-originated covering prefix (ref OpenrConfig.thrift:380-410)."""
+
+    prefix: str
+    minimum_supporting_routes: int = 0
+    install_to_fib: bool = False
+    forwarding_type: int = 0
+    tags: tuple[str, ...] = ()
+
+
+@dataclass
+class _OriginatedState:
+    conf: OriginatedPrefix
+    supporting: set[str] = field(default_factory=set)
+    advertised: bool = False
+
+
+class PrefixManager(Actor):
+    """ref PrefixManager.h:81."""
+
+    def __init__(
+        self,
+        node_name: str,
+        areas: list[str],
+        prefix_updates_queue: RQueue,
+        fib_route_updates_queue: Optional[RQueue],
+        kv_request_queue: ReplicateQueue,
+        static_routes_queue: Optional[ReplicateQueue] = None,
+        kvstore_updates_queue: Optional[ReplicateQueue] = None,
+        originated_prefixes: Optional[list[OriginatedPrefix]] = None,
+        sync_throttle_s: float = 0.005,
+    ):
+        super().__init__(f"prefix-manager:{node_name}")
+        self.node_name = node_name
+        self.areas = areas
+        self._prefix_updates = prefix_updates_queue
+        self._fib_updates = fib_route_updates_queue
+        self._kv_request_q = kv_request_queue
+        self._static_q = static_routes_queue
+        self._kvstore_updates_q = kvstore_updates_queue
+        # prefix -> {type -> PrefixEntry}
+        self.prefix_map: dict[str, dict[PrefixType, PrefixEntry]] = {}
+        # (prefix, type) -> restricted destination areas; absent = all
+        self._dest_areas: dict[tuple[str, PrefixType], tuple[str, ...]] = {}
+        self.originated: dict[str, _OriginatedState] = {
+        }
+        for op in originated_prefixes or []:
+            self.originated[op.prefix] = _OriginatedState(conf=op)
+        # what we currently advertise in kvstore: prefix -> (entry, areas)
+        self._advertised: dict[str, tuple[PrefixEntry, tuple[str, ...]]] = {}
+        self._sync_throttle: Optional[AsyncThrottle] = None
+        self._sync_throttle_s = sync_throttle_s
+        self._db_synced_signalled = False
+
+    async def on_start(self) -> None:
+        self._sync_throttle = AsyncThrottle(
+            self._sync_throttle_s, self.sync_kvstore
+        )
+        self.add_task(self._prefix_loop(), name=f"{self.name}.prefixes")
+        if self._fib_updates is not None:
+            self.add_task(self._fib_loop(), name=f"{self.name}.fib-acks")
+        # originated prefixes with no support requirement advertise at once
+        self._evaluate_originated()
+        self._sync_throttled()
+
+    # -- prefix event sources (ref PrefixEvent LsdbTypes.h:275) ------------
+
+    async def _prefix_loop(self) -> None:
+        while True:
+            ev: PrefixEvent = await self._prefix_updates.get()
+            self.process_prefix_event(ev)
+
+    def process_prefix_event(self, ev: PrefixEvent) -> None:
+        if ev.event_type == PrefixEventType.ADD_PREFIXES:
+            self.advertise_prefixes(ev.prefixes, ev.type, ev.dest_areas)
+        elif ev.event_type == PrefixEventType.WITHDRAW_PREFIXES:
+            self.withdraw_prefixes(ev.prefixes, ev.type)
+        elif ev.event_type == PrefixEventType.WITHDRAW_PREFIXES_BY_TYPE:
+            self.withdraw_prefixes_by_type(ev.type)
+        elif ev.event_type == PrefixEventType.SYNC_PREFIXES_BY_TYPE:
+            self.sync_prefixes_by_type(ev.prefixes, ev.type)
+
+    def advertise_prefixes(
+        self,
+        prefixes: list[PrefixEntry],
+        ptype: PrefixType,
+        dest_areas: tuple[str, ...] = (),
+    ) -> None:
+        for entry in prefixes:
+            if entry.type != ptype:
+                entry = replace(entry, type=ptype)
+            self.prefix_map.setdefault(entry.prefix, {})[ptype] = entry
+            if dest_areas:
+                self._dest_areas[(entry.prefix, ptype)] = tuple(dest_areas)
+            else:
+                self._dest_areas.pop((entry.prefix, ptype), None)
+        counters.increment("prefix_manager.advertised", len(prefixes))
+        self._sync_throttled()
+
+    def withdraw_prefixes(
+        self, prefixes: list[PrefixEntry], ptype: PrefixType
+    ) -> None:
+        for entry in prefixes:
+            types = self.prefix_map.get(entry.prefix)
+            if types is not None:
+                types.pop(ptype, None)
+                if not types:
+                    del self.prefix_map[entry.prefix]
+            self._dest_areas.pop((entry.prefix, ptype), None)
+        counters.increment("prefix_manager.withdrawn", len(prefixes))
+        self._sync_throttled()
+
+    def withdraw_prefixes_by_type(self, ptype: PrefixType) -> None:
+        for prefix in list(self.prefix_map):
+            types = self.prefix_map[prefix]
+            types.pop(ptype, None)
+            self._dest_areas.pop((prefix, ptype), None)
+            if not types:
+                del self.prefix_map[prefix]
+        self._sync_throttled()
+
+    def sync_prefixes_by_type(
+        self, prefixes: list[PrefixEntry], ptype: PrefixType
+    ) -> None:
+        """Replace the full set for a type (ref syncPrefixesByType)."""
+        keep = {p.prefix for p in prefixes}
+        for prefix in list(self.prefix_map):
+            types = self.prefix_map[prefix]
+            if ptype in types and prefix not in keep:
+                types.pop(ptype)
+                if not types:
+                    del self.prefix_map[prefix]
+        self.advertise_prefixes(prefixes, ptype)
+
+    # -- FIB-ACK redistribution + supernode aggregation --------------------
+
+    async def _fib_loop(self) -> None:
+        while True:
+            item = await self._fib_updates.get()
+            if isinstance(item, InitializationEvent):
+                continue
+            self._process_programmed_routes(item)
+
+    def _process_programmed_routes(self, upd: DecisionRouteUpdate) -> None:
+        """Track programmed routes as supporting evidence for originated
+        covering prefixes (ref aggregation, minimum_supporting_routes)."""
+        changed = False
+        for prefix in upd.unicast_routes_to_update:
+            for ostate in self.originated.values():
+                if self._supports(prefix, ostate.conf.prefix):
+                    if prefix not in ostate.supporting:
+                        ostate.supporting.add(prefix)
+                        changed = True
+        for prefix in upd.unicast_routes_to_delete:
+            for ostate in self.originated.values():
+                if prefix in ostate.supporting:
+                    ostate.supporting.discard(prefix)
+                    changed = True
+        if changed:
+            self._evaluate_originated()
+            self._sync_throttled()
+
+    @staticmethod
+    def _supports(route_prefix: str, covering: str) -> bool:
+        try:
+            route_net = parse_prefix(route_prefix)
+            cover_net = parse_prefix(covering)
+        except ValueError:
+            return False
+        return (
+            route_net.version == cover_net.version
+            and route_net != cover_net
+            and route_net.subnet_of(cover_net)
+        )
+
+    def _evaluate_originated(self) -> None:
+        for ostate in self.originated.values():
+            conf = ostate.conf
+            should = len(ostate.supporting) >= conf.minimum_supporting_routes
+            if should and not ostate.advertised:
+                ostate.advertised = True
+                entry = PrefixEntry(prefix=conf.prefix, type=PrefixType.CONFIG,
+                                    tags=conf.tags)
+                self.prefix_map.setdefault(conf.prefix, {})[
+                    PrefixType.CONFIG
+                ] = entry
+                if conf.install_to_fib and self._static_q is not None:
+                    self._static_q.push(
+                        DecisionRouteUpdate(
+                            unicast_routes_to_update={
+                                conf.prefix: RibUnicastEntry(
+                                    prefix=conf.prefix,
+                                    nexthops=frozenset(
+                                        {NextHop(address="::", if_name="lo")}
+                                    ),
+                                    best_prefix_entry=entry,
+                                )
+                            }
+                        )
+                    )
+                counters.increment("prefix_manager.originated_advertised")
+            elif not should and ostate.advertised:
+                ostate.advertised = False
+                types = self.prefix_map.get(conf.prefix)
+                if types is not None:
+                    types.pop(PrefixType.CONFIG, None)
+                    if not types:
+                        del self.prefix_map[conf.prefix]
+                if conf.install_to_fib and self._static_q is not None:
+                    self._static_q.push(
+                        DecisionRouteUpdate(
+                            unicast_routes_to_delete=[conf.prefix]
+                        )
+                    )
+                counters.increment("prefix_manager.originated_withdrawn")
+
+    # -- KvStore sync (ref syncKvStore) ------------------------------------
+
+    def _sync_throttled(self) -> None:
+        if self._sync_throttle is not None:
+            self._sync_throttle()
+
+    def best_entries(self) -> dict[str, PrefixEntry]:
+        """Per prefix, the entry of the highest-ranked type."""
+        out = {}
+        for prefix, types in self.prefix_map.items():
+            best_type = max(types, key=lambda t: _TYPE_RANK.get(t, 0))
+            out[prefix] = types[best_type]
+        return out
+
+    def _areas_for(self, prefix: str, entry: PrefixEntry) -> tuple[str, ...]:
+        restricted = self._dest_areas.get((prefix, entry.type))
+        return restricted if restricted else tuple(self.areas)
+
+    def sync_kvstore(self) -> None:
+        desired = self.best_entries()
+        # desired advertisement set per (prefix, area)
+        new_advertised: dict[str, tuple[PrefixEntry, tuple[str, ...]]] = {
+            prefix: (entry, self._areas_for(prefix, entry))
+            for prefix, entry in desired.items()
+        }
+        for prefix, (entry, areas) in new_advertised.items():
+            if self._advertised.get(prefix) == (entry, areas):
+                continue
+            for area in areas:
+                self._kv_request_q.push(
+                    KeyValueRequest(
+                        request_type=KeyValueRequestType.PERSIST,
+                        area=area,
+                        key=prefix_key(self.node_name, area, prefix),
+                        value=serialize(
+                            PrefixDatabase(
+                                this_node_name=self.node_name,
+                                prefix_entries=(entry,),
+                                area=area,
+                            )
+                        ),
+                    )
+                )
+        # withdrawals: one-shot delete_prefix tombstone (SET, not PERSIST —
+        # it must flood once and age out, not be defended); also tombstone
+        # areas a prefix was re-scoped away from
+        for prefix, (old_entry, old_areas) in self._advertised.items():
+            now = new_advertised.get(prefix)
+            gone_areas = (
+                old_areas
+                if now is None
+                else tuple(a for a in old_areas if a not in now[1])
+            )
+            for area in gone_areas:
+                self._kv_request_q.push(
+                    KeyValueRequest(
+                        request_type=KeyValueRequestType.SET,
+                        area=area,
+                        key=prefix_key(self.node_name, area, prefix),
+                        value=serialize(
+                            PrefixDatabase(
+                                this_node_name=self.node_name,
+                                prefix_entries=(PrefixEntry(prefix=prefix),),
+                                area=area,
+                                delete_prefix=True,
+                            )
+                        ),
+                        set_ttl=2_000,  # tombstone ages out quickly
+                    )
+                )
+        self._advertised = new_advertised
+        counters.increment("prefix_manager.kvstore_syncs")
+        if not self._db_synced_signalled:
+            self._db_synced_signalled = True
+            if self._kvstore_updates_q is not None:
+                self._kvstore_updates_q.push(
+                    InitializationEvent.PREFIX_DB_SYNCED
+                )
+
+    # -- module API (ref PrefixManager.h:121-135) --------------------------
+
+    async def get_prefixes(self) -> dict[str, PrefixEntry]:
+        return self.best_entries()
+
+    async def get_advertised_routes(self) -> dict[str, PrefixEntry]:
+        return {p: entry for p, (entry, _) in self._advertised.items()}

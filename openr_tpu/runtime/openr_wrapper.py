@@ -25,14 +25,13 @@ from openr_tpu.fib.fib_service import FibServiceBase
 from openr_tpu.kvstore.kvstore import KvStore
 from openr_tpu.link_monitor import LinkMonitor
 from openr_tpu.messaging import ReplicateQueue
-from openr_tpu.serde import serialize
+from openr_tpu.prefix_manager import OriginatedPrefix, PrefixManager
 from openr_tpu.spark import IoProvider, Spark
 from openr_tpu.types import (
-    KeyValueRequest,
-    KeyValueRequestType,
-    PrefixDatabase,
     PrefixEntry,
-    prefix_key,
+    PrefixEvent,
+    PrefixEventType,
+    PrefixType,
 )
 
 # sped-up timers for in-process emulation (ref OpenrSystemTest.cpp:38-48)
@@ -62,6 +61,7 @@ class OpenrWrapper:
         fib_config: Optional[FibConfig] = None,
         lm_config: Optional[LinkMonitorConfig] = None,
         fib_service: Optional[FibServiceBase] = None,
+        originated_prefixes: Optional[list[OriginatedPrefix]] = None,
         solver_backend: str = "cpu",
     ):
         self.node_name = node_name
@@ -117,6 +117,16 @@ class OpenrWrapper:
             self.route_updates_queue,
             solver_backend=solver_backend,
         )
+        self.prefix_manager = PrefixManager(
+            node_name,
+            areas,
+            self.prefix_updates_queue.get_reader(),
+            self.fib_updates_queue.get_reader(),
+            self.kv_request_queue,
+            static_routes_queue=self.static_routes_queue,
+            originated_prefixes=originated_prefixes or [],
+            sync_throttle_s=0.002,
+        )
         self.fib_service = fib_service or MockFibService()
         self.fib = Fib(
             node_name,
@@ -135,6 +145,7 @@ class OpenrWrapper:
         self.kv_ports[self.node_name] = self.kvstore.port
         for iface in interfaces:
             self.spark.add_interface(iface)
+        await self.prefix_manager.start()
         await self.link_monitor.start()
         await self.decision.start()
         await self.fib.start()
@@ -156,45 +167,43 @@ class OpenrWrapper:
             self.fib,
             self.decision,
             self.link_monitor,
+            self.prefix_manager,
             self.kvstore,
         ):
             await actor.stop()
 
     # -- convenience -------------------------------------------------------
 
-    def advertise_prefix(self, prefix: str, area: str = "0", **entry_kw) -> None:
-        """Originate a prefix (stand-in for PrefixManager origination)."""
-        self.kv_request_queue.push(
-            KeyValueRequest(
-                request_type=KeyValueRequestType.PERSIST,
-                area=area,
-                key=prefix_key(self.node_name, area, prefix),
-                value=serialize(
-                    PrefixDatabase(
-                        this_node_name=self.node_name,
-                        prefix_entries=(
-                            PrefixEntry(prefix=prefix, **entry_kw),
-                        ),
-                        area=area,
-                    )
-                ),
+    def advertise_prefix(
+        self,
+        prefix: str,
+        ptype: PrefixType = PrefixType.BREEZE,
+        dest_areas: tuple[str, ...] = (),
+        **entry_kw,
+    ) -> None:
+        """Originate a prefix through PrefixManager (the real path).
+
+        Default type is BREEZE (operator injection) — NOT LOOPBACK, which
+        LinkMonitor owns via full-set syncs and would silently withdraw.
+        """
+        ptype = entry_kw.pop("type", ptype)
+        self.prefix_updates_queue.push(
+            PrefixEvent(
+                event_type=PrefixEventType.ADD_PREFIXES,
+                type=ptype,
+                prefixes=[PrefixEntry(prefix=prefix, type=ptype, **entry_kw)],
+                dest_areas=dest_areas,
             )
         )
 
-    def withdraw_prefix(self, prefix: str, area: str = "0") -> None:
-        self.kv_request_queue.push(
-            KeyValueRequest(
-                request_type=KeyValueRequestType.PERSIST,
-                area=area,
-                key=prefix_key(self.node_name, area, prefix),
-                value=serialize(
-                    PrefixDatabase(
-                        this_node_name=self.node_name,
-                        prefix_entries=(PrefixEntry(prefix=prefix),),
-                        area=area,
-                        delete_prefix=True,
-                    )
-                ),
+    def withdraw_prefix(
+        self, prefix: str, ptype: PrefixType = PrefixType.BREEZE
+    ) -> None:
+        self.prefix_updates_queue.push(
+            PrefixEvent(
+                event_type=PrefixEventType.WITHDRAW_PREFIXES,
+                type=ptype,
+                prefixes=[PrefixEntry(prefix=prefix, type=ptype)],
             )
         )
 

@@ -1,0 +1,277 @@
+"""PrefixManager + allocator tests (ref openr/prefix-manager/tests/
+PrefixManagerTest.cpp, openr/allocators tests)."""
+
+import asyncio
+
+from openr_tpu.allocators import ALLOC_PREFIX_MARKER, PrefixAllocator, RangeAllocator
+from openr_tpu.decision.rib import DecisionRouteUpdate, NextHop, RibUnicastEntry
+from openr_tpu.kvstore.wrapper import KvStoreWrapper, wait_until
+from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.prefix_manager import OriginatedPrefix, PrefixManager
+from openr_tpu.serde import deserialize
+from openr_tpu.types import (
+    KeyValueRequestType,
+    PrefixDatabase,
+    PrefixEntry,
+    PrefixEvent,
+    PrefixEventType,
+    PrefixType,
+    prefix_key,
+)
+from tests.conftest import run_async
+
+
+class PmHarness:
+    def __init__(self, originated=None):
+        self.prefix_q = ReplicateQueue("prefixUpdates")
+        self.fib_q = ReplicateQueue("fibRouteUpdates")
+        self.kv_req_q = ReplicateQueue("kvRequests")
+        self.static_q = ReplicateQueue("staticRoutes")
+        self.kv_reqs = self.kv_req_q.get_reader("test")
+        self.statics = self.static_q.get_reader("test")
+        self.pm = PrefixManager(
+            "node1",
+            ["0"],
+            self.prefix_q.get_reader(),
+            self.fib_q.get_reader(),
+            self.kv_req_q,
+            static_routes_queue=self.static_q,
+            originated_prefixes=originated or [],
+            sync_throttle_s=0.001,
+        )
+
+    async def __aenter__(self):
+        await self.pm.start()
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.pm.stop()
+
+    async def next_req(self, timeout=3.0):
+        return await asyncio.wait_for(self.kv_reqs.get(), timeout)
+
+
+def entry(prefix, ptype=PrefixType.LOOPBACK):
+    return PrefixEntry(prefix=prefix, type=ptype)
+
+
+class TestPrefixManager:
+    @run_async
+    async def test_advertise_persists_prefix_key(self):
+        async with PmHarness() as h:
+            h.prefix_q.push(
+                PrefixEvent(
+                    event_type=PrefixEventType.ADD_PREFIXES,
+                    type=PrefixType.LOOPBACK,
+                    prefixes=[entry("10.0.0.1/32")],
+                )
+            )
+            req = await h.next_req()
+            assert req.request_type == KeyValueRequestType.PERSIST
+            assert req.key == prefix_key("node1", "0", "10.0.0.1/32")
+            db = deserialize(req.value, PrefixDatabase)
+            assert db.prefix_entries[0].prefix == "10.0.0.1/32"
+
+    @run_async
+    async def test_withdraw_sends_tombstone(self):
+        async with PmHarness() as h:
+            h.prefix_q.push(
+                PrefixEvent(
+                    event_type=PrefixEventType.ADD_PREFIXES,
+                    type=PrefixType.LOOPBACK,
+                    prefixes=[entry("10.0.0.1/32")],
+                )
+            )
+            await h.next_req()
+            h.prefix_q.push(
+                PrefixEvent(
+                    event_type=PrefixEventType.WITHDRAW_PREFIXES,
+                    type=PrefixType.LOOPBACK,
+                    prefixes=[entry("10.0.0.1/32")],
+                )
+            )
+            req = await h.next_req()
+            assert req.request_type == KeyValueRequestType.SET
+            db = deserialize(req.value, PrefixDatabase)
+            assert db.delete_prefix
+
+    @run_async
+    async def test_type_ranking(self):
+        """LOOPBACK outranks PREFIX_ALLOCATOR for the same prefix."""
+        async with PmHarness() as h:
+            h.prefix_q.push(
+                PrefixEvent(
+                    event_type=PrefixEventType.ADD_PREFIXES,
+                    type=PrefixType.PREFIX_ALLOCATOR,
+                    prefixes=[entry("10.0.0.0/24", PrefixType.PREFIX_ALLOCATOR)],
+                )
+            )
+            await h.next_req()
+            h.prefix_q.push(
+                PrefixEvent(
+                    event_type=PrefixEventType.ADD_PREFIXES,
+                    type=PrefixType.LOOPBACK,
+                    prefixes=[entry("10.0.0.0/24")],
+                )
+            )
+            req = await h.next_req()
+            db = deserialize(req.value, PrefixDatabase)
+            assert db.prefix_entries[0].type == PrefixType.LOOPBACK
+            # withdrawing the winner falls back to the allocator entry
+            h.prefix_q.push(
+                PrefixEvent(
+                    event_type=PrefixEventType.WITHDRAW_PREFIXES,
+                    type=PrefixType.LOOPBACK,
+                    prefixes=[entry("10.0.0.0/24")],
+                )
+            )
+            req = await h.next_req()
+            db = deserialize(req.value, PrefixDatabase)
+            assert db.prefix_entries[0].type == PrefixType.PREFIX_ALLOCATOR
+
+    @run_async
+    async def test_sync_by_type_replaces_set(self):
+        async with PmHarness() as h:
+            h.prefix_q.push(
+                PrefixEvent(
+                    event_type=PrefixEventType.ADD_PREFIXES,
+                    type=PrefixType.LOOPBACK,
+                    prefixes=[entry("10.0.0.1/32"), entry("10.0.0.2/32")],
+                )
+            )
+            await wait_until(lambda: len(h.pm.prefix_map) == 2)
+            h.prefix_q.push(
+                PrefixEvent(
+                    event_type=PrefixEventType.SYNC_PREFIXES_BY_TYPE,
+                    type=PrefixType.LOOPBACK,
+                    prefixes=[entry("10.0.0.3/32")],
+                )
+            )
+            await wait_until(
+                lambda: set(h.pm.prefix_map) == {"10.0.0.3/32"}
+            )
+
+    @run_async
+    async def test_originated_prefix_aggregation(self):
+        """Covering prefix advertised only with >= 2 supporting programmed
+        routes; withdrawn when support drops (supernode aggregation)."""
+        originated = [
+            OriginatedPrefix(
+                prefix="10.1.0.0/16",
+                minimum_supporting_routes=2,
+                install_to_fib=True,
+            )
+        ]
+        async with PmHarness(originated=originated) as h:
+
+            def programmed(*prefixes, delete=()):
+                return DecisionRouteUpdate(
+                    unicast_routes_to_update={
+                        p: RibUnicastEntry(
+                            prefix=p,
+                            nexthops=frozenset({NextHop(address="fe80::1")}),
+                        )
+                        for p in prefixes
+                    },
+                    unicast_routes_to_delete=list(delete),
+                )
+
+            h.fib_q.push(programmed("10.1.1.0/24"))
+            await asyncio.sleep(0.05)
+            assert "10.1.0.0/16" not in h.pm.prefix_map  # only 1 support
+            h.fib_q.push(programmed("10.1.2.0/24"))
+            await wait_until(lambda: "10.1.0.0/16" in h.pm.prefix_map)
+            # static route emitted for install_to_fib
+            static = await asyncio.wait_for(h.statics.get(), 2)
+            assert "10.1.0.0/16" in static.unicast_routes_to_update
+            # support drops below threshold -> withdrawn
+            h.fib_q.push(programmed(delete=["10.1.1.0/24"]))
+            await wait_until(lambda: "10.1.0.0/16" not in h.pm.prefix_map)
+            static = await asyncio.wait_for(h.statics.get(), 2)
+            assert "10.1.0.0/16" in static.unicast_routes_to_delete
+
+
+class TestRangeAllocator:
+    @run_async
+    async def test_single_node_allocates(self):
+        w = KvStoreWrapper("node1")
+        await w.start()
+        got = []
+        alloc = RangeAllocator(
+            "node1",
+            w.store,
+            w.updates_queue.get_reader("alloc"),
+            got.append,
+            range_start=0,
+            range_end=7,
+            settle_s=0.03,
+        )
+        await alloc.start()
+        try:
+            await wait_until(lambda: got, timeout_s=5)
+            idx = got[0]
+            assert 0 <= idx <= 7
+            assert w.get_key(f"{ALLOC_PREFIX_MARKER}{idx}").value == b"node1"
+        finally:
+            await alloc.stop()
+            await w.stop()
+
+    @run_async
+    async def test_two_nodes_unique_indexes(self):
+        """Two peered stores: allocations must not collide."""
+        a, b = KvStoreWrapper("nodeA"), KvStoreWrapper("nodeB")
+        await a.start()
+        await b.start()
+        a.add_peer(b)
+        b.add_peer(a)
+        got_a, got_b = [], []
+        alloc_a = RangeAllocator(
+            "nodeA", a.store, a.updates_queue.get_reader("alloc"),
+            got_a.append, range_start=0, range_end=3, settle_s=0.05,
+        )
+        alloc_b = RangeAllocator(
+            "nodeB", b.store, b.updates_queue.get_reader("alloc"),
+            got_b.append, range_start=0, range_end=3, settle_s=0.05,
+        )
+        await alloc_a.start()
+        await alloc_b.start()
+        try:
+            await wait_until(lambda: got_a and got_b, timeout_s=10)
+            # settle: allow any collision re-rolls to finish
+            await asyncio.sleep(0.5)
+            assert alloc_a.allocated_index != alloc_b.allocated_index
+        finally:
+            await alloc_a.stop()
+            await alloc_b.stop()
+            await a.stop()
+            await b.stop()
+
+
+class TestPrefixAllocator:
+    @run_async
+    async def test_prefix_derived_from_seed(self):
+        w = KvStoreWrapper("node1")
+        await w.start()
+        prefix_q = ReplicateQueue("prefixUpdates")
+        events = prefix_q.get_reader("test")
+        alloc = PrefixAllocator(
+            "node1",
+            w.store,
+            w.updates_queue.get_reader("alloc"),
+            prefix_q,
+            seed_prefix="10.128.0.0/16",
+            allocate_prefix_len=24,
+            settle_s=0.03,
+        )
+        await alloc.start()
+        try:
+            ev = await asyncio.wait_for(events.get(), 5)
+            assert ev.type == PrefixType.PREFIX_ALLOCATOR
+            (entry,) = ev.prefixes
+            net = entry.prefix
+            assert net.endswith("/24")
+            assert net.startswith("10.128.")
+            assert alloc.allocated_prefix == net
+        finally:
+            await alloc.stop()
+            await w.stop()
