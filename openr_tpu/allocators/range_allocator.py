@@ -133,11 +133,19 @@ class RangeAllocator(Actor):
             self._callback(self.allocated_index)
 
     def _lost(self) -> None:
-        """Our claim was beaten — drop it and re-roll elsewhere
-        (ref collision detection on merge)."""
+        """Our claim was beaten — withdraw it and re-roll elsewhere
+        (ref collision detection on merge). A proper CLEAR is required:
+        KvStore's override protection has likely already re-persisted our
+        value at a bumped version, and only a tombstone stops that ghost
+        claim from winning network-wide and blocking the index."""
         if self.current_index is not None:
-            st = self.kvstore.areas[self.area]
-            st.self_originated.pop(self._key(self.current_index), None)
+            self.kvstore.process_key_value_request(
+                KeyValueRequest(
+                    request_type=KeyValueRequestType.CLEAR,
+                    area=self.area,
+                    key=self._key(self.current_index),
+                )
+            )
         self.current_index = None
         if self.allocated_index is not None:
             self.allocated_index = None
@@ -183,8 +191,9 @@ class PrefixAllocator(Actor):
         self.node_name = node_name
         self.seed = parse_prefix(seed_prefix)
         self.alloc_len = allocate_prefix_len
-        assert self.alloc_len > self.seed.prefixlen, (
-            "allocation length must exceed seed prefix length"
+        assert self.seed.prefixlen < self.alloc_len <= self.seed.max_prefixlen, (
+            f"allocation length must be in ({self.seed.prefixlen}, "
+            f"{self.seed.max_prefixlen}]"
         )
         n_subnets = 1 << (self.alloc_len - self.seed.prefixlen)
         self._prefix_q = prefix_updates_queue

@@ -1,0 +1,305 @@
+"""breeze — the operator CLI.
+
+Role of the reference's openr/py/openr/cli/breeze.py (:32) click CLI:
+subcommand groups per module (kvstore, decision, fib, lm, spark,
+prefixmgr, monitor, openr, perf, tech-support) talking to the ctrl server
+(ref get_openr_ctrl_client, openr/py/openr/clients/openr_client.py:94).
+
+Usage:  python -m openr_tpu.cli.breeze --port <ctrl-port> <group> <cmd>
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Optional
+
+import click
+
+from openr_tpu.runtime.rpc import RpcClient
+
+
+def _call(ctx, method: str, params: Optional[dict] = None) -> Any:
+    """One-shot RPC against the ctrl server."""
+
+    async def run():
+        client = RpcClient(ctx.obj["host"], ctx.obj["port"], name="breeze")
+        try:
+            return await client.request(method, params or {})
+        finally:
+            await client.close()
+
+    return asyncio.run(run())
+
+
+def _print(obj: Any) -> None:
+    click.echo(json.dumps(obj, indent=2, sort_keys=True, default=str))
+
+
+@click.group()
+@click.option("--host", default="127.0.0.1", help="ctrl server host")
+@click.option("--port", default=2018, type=int, help="ctrl server port")
+@click.pass_context
+def cli(ctx, host: str, port: int) -> None:
+    """breeze — operate an openr_tpu node (ref breeze.py:32)."""
+    ctx.ensure_object(dict)
+    ctx.obj["host"] = host
+    ctx.obj["port"] = port
+
+
+# -- openr ------------------------------------------------------------------
+
+@cli.group()
+def openr() -> None:
+    """Node-level info."""
+
+
+@openr.command()
+@click.pass_context
+def version(ctx) -> None:
+    _print(_call(ctx, "openr.version"))
+
+
+@openr.command("initialization")
+@click.pass_context
+def initialization(ctx) -> None:
+    """Cold-boot convergence milestones (ref getInitializationEvents)."""
+    _print(_call(ctx, "openr.initialization_events"))
+
+
+# -- kvstore ----------------------------------------------------------------
+
+@cli.group()
+def kvstore() -> None:
+    """Replicated key-value store."""
+
+
+@kvstore.command()
+@click.argument("keys", nargs=-1)
+@click.option("--area", default="0")
+@click.pass_context
+def keys(ctx, keys, area) -> None:
+    """Fetch specific keys."""
+    _print(_call(ctx, "ctrl.kvstore.keyvals", {"area": area, "keys": list(keys)}))
+
+
+@kvstore.command()
+@click.option("--prefix", default="", help="key prefix filter")
+@click.option("--area", default="0")
+@click.pass_context
+def dump(ctx, prefix, area) -> None:
+    """Dump all key/values."""
+    _print(_call(ctx, "ctrl.kvstore.dump", {"area": area, "prefix": prefix}))
+
+
+@kvstore.command()
+@click.option("--area", default="0")
+@click.pass_context
+def peers(ctx, area) -> None:
+    """Peer sessions and sync states."""
+    _print(_call(ctx, "ctrl.kvstore.peers", {"area": area}))
+
+
+# -- decision ---------------------------------------------------------------
+
+@cli.group()
+def decision() -> None:
+    """Route computation."""
+
+
+@decision.command()
+@click.option("--from-node", default=None, help="compute from another node's view")
+@click.pass_context
+def routes(ctx, from_node) -> None:
+    _print(_call(ctx, "ctrl.decision.routes", {"from_node": from_node}))
+
+
+@decision.command()
+@click.pass_context
+def adjacencies(ctx) -> None:
+    _print(_call(ctx, "ctrl.decision.adj_dbs"))
+
+
+@decision.command("received-routes")
+@click.pass_context
+def received_routes(ctx) -> None:
+    _print(_call(ctx, "ctrl.decision.received_routes"))
+
+
+@decision.command("rib-policy")
+@click.option("--clear", is_flag=True, help="remove the active policy")
+@click.pass_context
+def rib_policy(ctx, clear) -> None:
+    if clear:
+        _print(_call(ctx, "ctrl.decision.clear_rib_policy"))
+    else:
+        _print(_call(ctx, "ctrl.decision.get_rib_policy"))
+
+
+# -- fib --------------------------------------------------------------------
+
+@cli.group()
+def fib() -> None:
+    """Programmed routes."""
+
+
+@fib.command("routes")
+@click.pass_context
+def fib_routes(ctx) -> None:
+    _print(_call(ctx, "ctrl.fib.routes"))
+
+
+@fib.command("mpls-routes")
+@click.pass_context
+def fib_mpls(ctx) -> None:
+    _print(_call(ctx, "ctrl.fib.mpls_routes"))
+
+
+# -- perf -------------------------------------------------------------------
+
+@cli.group()
+def perf() -> None:
+    """Convergence tracing."""
+
+
+@perf.command("fib")
+@click.pass_context
+def perf_fib(ctx) -> None:
+    """Per-event hop timing through the pipeline (ref commands/perf.py)."""
+    for sample in _call(ctx, "ctrl.fib.perf"):
+        events = sample.get("events", [])
+        if not events:
+            continue
+        base = events[0]["unix_ts_ms"]
+        click.echo("--")
+        for ev in events:
+            click.echo(
+                f"  {ev['event_descr']:<24} {ev['node_name']:<12} "
+                f"+{ev['unix_ts_ms'] - base} ms"
+            )
+
+
+# -- lm ---------------------------------------------------------------------
+
+@cli.group()
+def lm() -> None:
+    """Link monitor."""
+
+
+@lm.command()
+@click.pass_context
+def links(ctx) -> None:
+    _print(_call(ctx, "ctrl.lm.links"))
+
+
+@lm.command()
+@click.pass_context
+def interfaces(ctx) -> None:
+    _print(_call(ctx, "ctrl.lm.interfaces"))
+
+
+@lm.command("set-node-overload")
+@click.pass_context
+def set_node_overload(ctx) -> None:
+    """Drain: stop transit traffic through this node."""
+    _print(_call(ctx, "ctrl.lm.set_node_overload", {"overloaded": True}))
+
+
+@lm.command("unset-node-overload")
+@click.pass_context
+def unset_node_overload(ctx) -> None:
+    _print(_call(ctx, "ctrl.lm.set_node_overload", {"overloaded": False}))
+
+
+@lm.command("set-link-metric")
+@click.argument("if_name")
+@click.argument("metric", type=int)
+@click.pass_context
+def set_link_metric(ctx, if_name, metric) -> None:
+    _print(
+        _call(
+            ctx,
+            "ctrl.lm.set_link_metric",
+            {"if_name": if_name, "metric": metric},
+        )
+    )
+
+
+# -- spark ------------------------------------------------------------------
+
+@cli.group()
+def spark() -> None:
+    """Neighbor discovery."""
+
+
+@spark.command()
+@click.pass_context
+def neighbors(ctx) -> None:
+    _print(_call(ctx, "ctrl.spark.neighbors"))
+
+
+# -- prefixmgr --------------------------------------------------------------
+
+@cli.group()
+def prefixmgr() -> None:
+    """Prefix advertisement."""
+
+
+@prefixmgr.command()
+@click.pass_context
+def advertised(ctx) -> None:
+    _print(_call(ctx, "ctrl.prefixmgr.advertised"))
+
+
+@prefixmgr.command("view")
+@click.pass_context
+def view(ctx) -> None:
+    _print(_call(ctx, "ctrl.prefixmgr.prefixes"))
+
+
+# -- monitor ----------------------------------------------------------------
+
+@cli.group()
+def monitor() -> None:
+    """Counters and stats."""
+
+
+@monitor.command()
+@click.option("--prefix", default="")
+@click.pass_context
+def counters(ctx, prefix) -> None:
+    _print(_call(ctx, "monitor.counters", {"prefix": prefix}))
+
+
+# -- tech-support -----------------------------------------------------------
+
+@cli.command("tech-support")
+@click.pass_context
+def tech_support(ctx) -> None:
+    """Dump everything (ref breeze tech-support)."""
+    for title, method, params in [
+        ("VERSION", "openr.version", {}),
+        ("INITIALIZATION", "openr.initialization_events", {}),
+        ("KVSTORE PEERS", "ctrl.kvstore.peers", {}),
+        ("KVSTORE DUMP", "ctrl.kvstore.dump", {}),
+        ("ADJACENCIES", "ctrl.decision.adj_dbs", {}),
+        ("COMPUTED ROUTES", "ctrl.decision.routes", {}),
+        ("PROGRAMMED ROUTES", "ctrl.fib.routes", {}),
+        ("LINKS", "ctrl.lm.links", {}),
+        ("NEIGHBORS", "ctrl.spark.neighbors", {}),
+        ("ADVERTISED PREFIXES", "ctrl.prefixmgr.advertised", {}),
+        ("COUNTERS", "monitor.counters", {}),
+    ]:
+        click.echo(f"\n==== {title} ====")
+        try:
+            _print(_call(ctx, method, params))
+        except Exception as e:  # noqa: BLE001 — report and continue dumping
+            click.echo(f"  <error: {e}>")
+
+
+def main() -> None:
+    cli(obj={})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1 @@
+from openr_tpu.ctrl.ctrl_server import CtrlServer  # noqa: F401

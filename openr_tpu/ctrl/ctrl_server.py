@@ -1,0 +1,319 @@
+"""Ctrl server — the operator/control API.
+
+Role of the reference's openr/ctrl-server/OpenrCtrlHandler.{h,cpp} +
+OpenrThriftCtrlServer (service OpenrCtrl, OpenrCtrl.thrift:246-713): one
+server fanning out to every module's async API, plus server-streaming
+subscriptions for KvStore and Fib deltas with an initial snapshot
+(ref OpenrCtrlHandler.h:351-389). Served over runtime/rpc.py (role of the
+thrift server on :2018); the breeze CLI (cli/breeze.py) is the client.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+from openr_tpu.messaging import QueueClosedError, ReplicateQueue
+from openr_tpu.runtime.actor import Actor
+from openr_tpu.runtime.counters import counters
+from openr_tpu.runtime.rpc import RpcServer, Stream
+from openr_tpu.serde import from_plain, to_plain
+from openr_tpu.types import InitializationEvent, Publication
+
+log = logging.getLogger(__name__)
+
+
+class CtrlServer(Actor):
+    """ref OpenrCtrlHandler.h — fans out to module semifuture APIs."""
+
+    def __init__(
+        self,
+        node_name: str,
+        kvstore=None,
+        decision=None,
+        fib=None,
+        link_monitor=None,
+        prefix_manager=None,
+        spark=None,
+        kvstore_updates_queue: Optional[ReplicateQueue] = None,
+        fib_updates_queue: Optional[ReplicateQueue] = None,
+        listen_port: int = 0,
+    ):
+        super().__init__(f"ctrl:{node_name}")
+        self.node_name = node_name
+        self.kvstore = kvstore
+        self.decision = decision
+        self.fib = fib
+        self.link_monitor = link_monitor
+        self.prefix_manager = prefix_manager
+        self.spark = spark
+        self._kvstore_updates_q = kvstore_updates_queue
+        self._fib_updates_q = fib_updates_queue
+        self._listen_port = listen_port
+        self.server = RpcServer(self.name)
+        self.port: int = 0
+        self.start_time = time.time()
+        # initialization-event introspection (ref getInitializationEvents)
+        self.initialization_events: dict[str, float] = {}
+
+    async def on_start(self) -> None:
+        s = self.server
+        s.register("openr.version", self._version)
+        s.register("openr.initialization_events", self._get_init_events)
+        s.register("monitor.counters", self._counters)
+        if self.kvstore is not None:
+            s.register("ctrl.kvstore.keyvals", self._kv_get)
+            s.register("ctrl.kvstore.dump", self._kv_dump)
+            s.register("ctrl.kvstore.peers", self._kv_peers)
+            s.register("ctrl.kvstore.set", self._kv_set)
+        if self.decision is not None:
+            s.register("ctrl.decision.routes", self._decision_routes)
+            s.register("ctrl.decision.adj_dbs", self._decision_adj_dbs)
+            s.register(
+                "ctrl.decision.received_routes", self._decision_received
+            )
+            s.register("ctrl.decision.set_rib_policy", self._set_rib_policy)
+            s.register("ctrl.decision.get_rib_policy", self._get_rib_policy)
+            s.register(
+                "ctrl.decision.clear_rib_policy", self._clear_rib_policy
+            )
+        if self.fib is not None:
+            s.register("ctrl.fib.routes", self._fib_routes)
+            s.register("ctrl.fib.mpls_routes", self._fib_mpls)
+            s.register("ctrl.fib.perf", self._fib_perf)
+        if self.link_monitor is not None:
+            s.register("ctrl.lm.links", self._lm_links)
+            s.register("ctrl.lm.interfaces", self._lm_interfaces)
+            s.register("ctrl.lm.set_node_overload", self._lm_set_overload)
+            s.register("ctrl.lm.set_link_overload", self._lm_set_link_overload)
+            s.register("ctrl.lm.set_link_metric", self._lm_set_link_metric)
+        if self.spark is not None:
+            s.register("ctrl.spark.neighbors", self._spark_neighbors)
+        if self.prefix_manager is not None:
+            s.register("ctrl.prefixmgr.advertised", self._pm_advertised)
+            s.register("ctrl.prefixmgr.prefixes", self._pm_prefixes)
+        if self._kvstore_updates_q is not None:
+            s.register("ctrl.kvstore.subscribe", self._subscribe_kvstore)
+            self.add_task(
+                self._watch_initialization(self._kvstore_updates_q),
+                name=f"{self.name}.init-watch-kv",
+            )
+        if self._fib_updates_q is not None:
+            s.register("ctrl.fib.subscribe", self._subscribe_fib)
+            self.add_task(
+                self._watch_initialization(self._fib_updates_q),
+                name=f"{self.name}.init-watch-fib",
+            )
+        self.port = await s.start(port=self._listen_port)
+
+    async def on_stop(self) -> None:
+        await self.server.stop()
+
+    # -- misc --------------------------------------------------------------
+
+    async def _version(self) -> dict:
+        return {
+            "node": self.node_name,
+            "version": 1,
+            "uptime_s": time.time() - self.start_time,
+        }
+
+    async def _counters(self, prefix: str = "") -> dict:
+        return counters.get_counters(prefix)
+
+    async def _watch_initialization(self, queue: ReplicateQueue) -> None:
+        reader = queue.get_reader(f"{self.name}.init")
+        try:
+            while True:
+                item = await reader.get()
+                if isinstance(item, InitializationEvent):
+                    self.initialization_events[item.name] = time.time()
+        except QueueClosedError:
+            pass
+
+    async def _get_init_events(self) -> dict:
+        return dict(self.initialization_events)
+
+    # -- kvstore -----------------------------------------------------------
+
+    async def _kv_get(self, area: str = "0", keys: Optional[list] = None) -> dict:
+        vals = await self.kvstore.get_key_vals(area, keys or [])
+        return {k: to_plain(v) for k, v in vals.items()}
+
+    async def _kv_dump(self, area: str = "0", prefix: str = "") -> dict:
+        vals = await self.kvstore.dump_all(area, prefix)
+        return {k: to_plain(v) for k, v in vals.items()}
+
+    async def _kv_peers(self, area: str = "0") -> dict:
+        return {
+            name: to_plain(spec)
+            for name, spec in self.kvstore.get_peers(area).items()
+        }
+
+    async def _kv_set(self, area: str, key: str, value: dict) -> dict:
+        from openr_tpu.types import Value
+
+        await self.kvstore.set_key_vals(area, {key: from_plain(value, Value)})
+        return {"ok": True}
+
+    # -- decision ----------------------------------------------------------
+
+    async def _decision_routes(self, from_node: Optional[str] = None) -> dict:
+        db = await self.decision.get_decision_route_db(from_node)
+        if db is None:
+            return {"unicast": {}, "mpls": {}}
+        return {
+            "unicast": {p: to_plain(e) for p, e in db.unicast_routes.items()},
+            "mpls": {str(l): to_plain(e) for l, e in db.mpls_routes.items()},
+        }
+
+    async def _decision_adj_dbs(self) -> dict:
+        dbs = await self.decision.get_adj_dbs()
+        return {
+            area: {node: to_plain(db) for node, db in nodes.items()}
+            for area, nodes in dbs.items()
+        }
+
+    async def _decision_received(self) -> list:
+        return [
+            [pfx, list(node_area), to_plain(entry)]
+            for pfx, node_area, entry in await self.decision.get_received_routes()
+        ]
+
+    async def _set_rib_policy(self, policy: dict) -> dict:
+        from openr_tpu.decision.rib_policy import RibPolicy
+
+        await self.decision.set_rib_policy(from_plain(policy, RibPolicy))
+        return {"ok": True}
+
+    async def _get_rib_policy(self) -> Optional[dict]:
+        policy = await self.decision.get_rib_policy()
+        if policy is None:
+            return None
+        out = to_plain(policy)
+        out["remaining_ttl_secs"] = policy.remaining_ttl_secs()
+        return out
+
+    async def _clear_rib_policy(self) -> dict:
+        await self.decision.clear_rib_policy()
+        return {"ok": True}
+
+    # -- fib ---------------------------------------------------------------
+
+    async def _fib_routes(self) -> dict:
+        routes = await self.fib.get_route_db()
+        return {p: to_plain(e) for p, e in routes.items()}
+
+    async def _fib_mpls(self) -> dict:
+        routes = await self.fib.get_mpls_route_db()
+        return {str(l): to_plain(e) for l, e in routes.items()}
+
+    async def _fib_perf(self) -> list:
+        return [to_plain(p) for p in await self.fib.get_perf_db()]
+
+    # -- link monitor ------------------------------------------------------
+
+    async def _lm_links(self) -> dict:
+        return await self.link_monitor.get_links()
+
+    async def _lm_interfaces(self) -> dict:
+        return {
+            name: to_plain(info)
+            for name, info in (await self.link_monitor.get_interfaces()).items()
+        }
+
+    async def _lm_set_overload(self, overloaded: bool) -> dict:
+        await self.link_monitor.set_node_overload(overloaded)
+        return {"ok": True}
+
+    async def _lm_set_link_overload(self, if_name: str, overloaded: bool) -> dict:
+        await self.link_monitor.set_link_overload(if_name, overloaded)
+        return {"ok": True}
+
+    async def _lm_set_link_metric(
+        self, if_name: str, metric: Optional[int] = None
+    ) -> dict:
+        await self.link_monitor.set_link_metric(if_name, metric)
+        return {"ok": True}
+
+    # -- spark / prefix manager --------------------------------------------
+
+    async def _spark_neighbors(self) -> list:
+        return [
+            {
+                "node": nb.node_name,
+                "if_name": nb.if_name,
+                "state": nb.state.name,
+                "area": nb.area,
+                "rtt_us": nb.rtt_us,
+            }
+            for nb in await self.spark.get_neighbors()
+        ]
+
+    async def _pm_advertised(self) -> dict:
+        return {
+            p: to_plain(e)
+            for p, e in (await self.prefix_manager.get_advertised_routes()).items()
+        }
+
+    async def _pm_prefixes(self) -> dict:
+        return {
+            p: to_plain(e)
+            for p, e in (await self.prefix_manager.get_prefixes()).items()
+        }
+
+    # -- streaming subscriptions (ref OpenrCtrlHandler.h:351-389) ----------
+
+    async def _subscribe_kvstore(self, area: str = "0") -> Stream:
+        """Snapshot + live deltas (ref subscribeAndGetKvStoreFiltered)."""
+        stream = Stream()
+        snapshot = await self.kvstore.dump_all(area)
+        stream.push(
+            {
+                "snapshot": {k: to_plain(v) for k, v in snapshot.items()},
+                "area": area,
+            }
+        )
+        reader = self._kvstore_updates_q.get_reader(f"{self.name}.sub")
+
+        async def pump():
+            try:
+                while not stream.closed:
+                    item = await reader.get()
+                    if isinstance(item, Publication) and item.area == area:
+                        stream.push({"delta": to_plain(item)})
+            except QueueClosedError:
+                pass
+            finally:
+                stream.close()
+                self._kvstore_updates_q.remove_reader(reader)
+
+        self.add_task(pump(), name=f"{self.name}.kvstore-sub")
+        return stream
+
+    async def _subscribe_fib(self) -> Stream:
+        """Snapshot + programmed-route deltas (ref subscribeAndGetFib)."""
+        stream = Stream()
+        if self.fib is not None:
+            routes = await self.fib.get_route_db()
+            stream.push(
+                {"snapshot": {p: to_plain(e) for p, e in routes.items()}}
+            )
+        reader = self._fib_updates_q.get_reader(f"{self.name}.sub")
+
+        async def pump():
+            try:
+                while not stream.closed:
+                    item = await reader.get()
+                    if isinstance(item, InitializationEvent):
+                        continue
+                    stream.push({"delta": to_plain(item)})
+            except QueueClosedError:
+                pass
+            finally:
+                stream.close()
+                self._fib_updates_q.remove_reader(reader)
+
+        self.add_task(pump(), name=f"{self.name}.fib-sub")
+        return stream

@@ -94,6 +94,16 @@ class ReplicateQueue(Generic[T]):
             r._push(item)
         return len(self._readers)
 
+    def remove_reader(self, reader: RQueue[T]) -> None:
+        """Unregister a reader (closes it): transient consumers — e.g.
+        per-subscription ctrl streams — must not accumulate unread buffers
+        for the queue's lifetime."""
+        try:
+            self._readers.remove(reader)
+        except ValueError:
+            return
+        reader._close()
+
     def close(self) -> None:
         self._closed = True
         for r in self._readers:

@@ -63,6 +63,8 @@ class OpenrWrapper:
         fib_service: Optional[FibServiceBase] = None,
         originated_prefixes: Optional[list[OriginatedPrefix]] = None,
         solver_backend: str = "cpu",
+        enable_ctrl: bool = False,
+        ctrl_port: int = 0,
     ):
         self.node_name = node_name
         self.kv_ports = kv_ports  # shared node -> kvstore port registry
@@ -117,6 +119,9 @@ class OpenrWrapper:
             self.route_updates_queue,
             solver_backend=solver_backend,
         )
+        self.ctrl: "CtrlServer | None" = None
+        self._enable_ctrl = enable_ctrl
+        self._ctrl_port = ctrl_port
         self.prefix_manager = PrefixManager(
             node_name,
             areas,
@@ -124,6 +129,7 @@ class OpenrWrapper:
             self.fib_updates_queue.get_reader(),
             self.kv_request_queue,
             static_routes_queue=self.static_routes_queue,
+            kvstore_updates_queue=self.kvstore_updates_queue,
             originated_prefixes=originated_prefixes or [],
             sync_throttle_s=0.002,
         )
@@ -150,9 +156,27 @@ class OpenrWrapper:
         await self.decision.start()
         await self.fib.start()
         await self.spark.start()
+        if self._enable_ctrl:
+            from openr_tpu.ctrl import CtrlServer
+
+            self.ctrl = CtrlServer(
+                self.node_name,
+                kvstore=self.kvstore,
+                decision=self.decision,
+                fib=self.fib,
+                link_monitor=self.link_monitor,
+                prefix_manager=self.prefix_manager,
+                spark=self.spark,
+                kvstore_updates_queue=self.kvstore_updates_queue,
+                fib_updates_queue=self.fib_updates_queue,
+                listen_port=self._ctrl_port,
+            )
+            await self.ctrl.start()
 
     async def stop(self) -> None:
         """Reverse teardown (ref Main.cpp:592-599)."""
+        if self.ctrl is not None:
+            await self.ctrl.stop()
         for q in (
             self.kvstore_updates_queue,
             self.kvstore_events_queue,

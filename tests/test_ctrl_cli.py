@@ -1,0 +1,222 @@
+"""Ctrl API + streaming + breeze CLI tests
+(ref openr/ctrl-server/tests/OpenrCtrlHandlerTest.cpp and the CliRunner
+tests in openr/py/openr/cli/tests)."""
+
+import asyncio
+import threading
+
+from click.testing import CliRunner
+
+from openr_tpu.kvstore.wrapper import wait_until
+from openr_tpu.runtime.openr_wrapper import OpenrWrapper
+from openr_tpu.runtime.rpc import RpcClient
+from openr_tpu.spark import MockIoMesh
+from tests.conftest import run_async
+
+
+async def start_two_node(enable_ctrl=True):
+    mesh = MockIoMesh()
+    kv_ports = {}
+    a = OpenrWrapper("node-a", mesh.provider("node-a"), kv_ports,
+                     enable_ctrl=enable_ctrl)
+    b = OpenrWrapper("node-b", mesh.provider("node-b"), kv_ports)
+    mesh.connect("node-a", "if-ab", "node-b", "if-ba")
+    await a.start("if-ab")
+    await b.start("if-ba")
+    a.advertise_prefix("10.0.0.1/32")
+    b.advertise_prefix("10.0.0.2/32")
+    await wait_until(lambda: "10.0.0.2/32" in a.fib_routes, timeout_s=20)
+    return mesh, a, b
+
+
+class TestCtrlServer:
+    @run_async
+    async def test_api_surface(self):
+        mesh, a, b = await start_two_node()
+        client = RpcClient("127.0.0.1", a.ctrl.port)
+        try:
+            version = await client.request("openr.version")
+            assert version["node"] == "node-a"
+
+            dump = await client.request("ctrl.kvstore.dump", {"area": "0"})
+            assert f"adj:node-a" in dump
+            assert "prefix:node-b:[0]:10.0.0.2/32" in dump
+
+            peers = await client.request("ctrl.kvstore.peers", {"area": "0"})
+            assert "node-b" in peers
+
+            routes = await client.request("ctrl.decision.routes", {})
+            assert "10.0.0.2/32" in routes["unicast"]
+
+            # pure-function route computation from the OTHER node's view
+            routes_b = await client.request(
+                "ctrl.decision.routes", {"from_node": "node-b"}
+            )
+            assert "10.0.0.1/32" in routes_b["unicast"]
+
+            adj = await client.request("ctrl.decision.adj_dbs")
+            assert set(adj["0"]) == {"node-a", "node-b"}
+
+            fib = await client.request("ctrl.fib.routes")
+            assert "10.0.0.2/32" in fib
+
+            links = await client.request("ctrl.lm.links")
+            assert any("node-b" in k for k in links)
+
+            nbrs = await client.request("ctrl.spark.neighbors")
+            assert nbrs[0]["node"] == "node-b"
+            assert nbrs[0]["state"] == "ESTABLISHED"
+
+            advertised = await client.request("ctrl.prefixmgr.advertised")
+            assert "10.0.0.1/32" in advertised
+
+            counts = await client.request("monitor.counters", {"prefix": "spark"})
+            assert counts
+
+            init = await client.request("openr.initialization_events")
+            assert "KVSTORE_SYNCED" in init
+            assert "FIB_SYNCED" in init
+        finally:
+            await client.close()
+            await a.stop()
+            await b.stop()
+
+    @run_async
+    async def test_drain_via_ctrl(self):
+        mesh, a, b = await start_two_node()
+        client = RpcClient("127.0.0.1", a.ctrl.port)
+        try:
+            await client.request(
+                "ctrl.lm.set_node_overload", {"overloaded": True}
+            )
+            assert a.link_monitor.state.is_overloaded
+        finally:
+            await client.close()
+            await a.stop()
+            await b.stop()
+
+    @run_async
+    async def test_kvstore_streaming_subscription(self):
+        mesh, a, b = await start_two_node()
+        client = RpcClient("127.0.0.1", a.ctrl.port)
+        try:
+            q = await client.subscribe("ctrl.kvstore.subscribe", {"area": "0"})
+            first = await asyncio.wait_for(q.get(), 5)
+            assert "snapshot" in first
+            assert "prefix:node-b:[0]:10.0.0.2/32" in first["snapshot"]
+            # a new advertisement must arrive as a delta
+            b.advertise_prefix("10.77.0.0/24")
+
+            async def next_delta_with_key():
+                while True:
+                    item = await q.get()
+                    if isinstance(item, Exception):
+                        raise item
+                    if item and "delta" in item:
+                        if any(
+                            "10.77.0.0/24" in k
+                            for k in item["delta"]["key_vals"]
+                        ):
+                            return item
+
+            await asyncio.wait_for(next_delta_with_key(), 10)
+        finally:
+            await client.close()
+            await a.stop()
+            await b.stop()
+
+    @run_async
+    async def test_fib_streaming_subscription(self):
+        mesh, a, b = await start_two_node()
+        client = RpcClient("127.0.0.1", a.ctrl.port)
+        try:
+            q = await client.subscribe("ctrl.fib.subscribe", {})
+            first = await asyncio.wait_for(q.get(), 5)
+            assert "10.0.0.2/32" in first["snapshot"]
+            b.advertise_prefix("10.88.0.0/24")
+
+            async def hunt():
+                while True:
+                    item = await q.get()
+                    if isinstance(item, Exception):
+                        raise item
+                    if (
+                        item
+                        and "delta" in item
+                        and "10.88.0.0/24"
+                        in item["delta"]["unicast_routes_to_update"]
+                    ):
+                        return item
+
+            await asyncio.wait_for(hunt(), 10)
+        finally:
+            await client.close()
+            await a.stop()
+            await b.stop()
+
+
+class TestBreezeCli:
+    """Drive the real CLI against a live node running in a background
+    event loop (the CLI owns its own loop via asyncio.run)."""
+
+    def test_cli_commands(self):
+        started = threading.Event()
+        stop = None
+        ctrl_port = {}
+        loop_holder = {}
+
+        async def node_main():
+            nonlocal stop
+            stop = asyncio.Event()
+            mesh, a, b = await start_two_node()
+            ctrl_port["port"] = a.ctrl.port
+            loop_holder["loop"] = asyncio.get_running_loop()
+            started.set()
+            await stop.wait()
+            await a.stop()
+            await b.stop()
+
+        t = threading.Thread(
+            target=lambda: asyncio.run(asyncio.wait_for(node_main(), 120)),
+            daemon=True,
+        )
+        t.start()
+        assert started.wait(60), "node did not start"
+        try:
+            from openr_tpu.cli.breeze import cli
+
+            runner = CliRunner()
+            base = ["--port", str(ctrl_port["port"])]
+
+            res = runner.invoke(cli, base + ["openr", "version"], obj={})
+            assert res.exit_code == 0, res.output
+            assert "node-a" in res.output
+
+            res = runner.invoke(cli, base + ["kvstore", "dump"], obj={})
+            assert res.exit_code == 0, res.output
+            assert "adj:node-a" in res.output
+
+            res = runner.invoke(cli, base + ["decision", "routes"], obj={})
+            assert res.exit_code == 0, res.output
+            assert "10.0.0.2/32" in res.output
+
+            res = runner.invoke(cli, base + ["fib", "routes"], obj={})
+            assert res.exit_code == 0, res.output
+            assert "10.0.0.2/32" in res.output
+
+            res = runner.invoke(cli, base + ["spark", "neighbors"], obj={})
+            assert res.exit_code == 0, res.output
+            assert "ESTABLISHED" in res.output
+
+            res = runner.invoke(cli, base + ["lm", "links"], obj={})
+            assert res.exit_code == 0, res.output
+
+            res = runner.invoke(cli, base + ["perf", "fib"], obj={})
+            assert res.exit_code == 0, res.output
+
+            res = runner.invoke(cli, base + ["tech-support"], obj={})
+            assert res.exit_code == 0, res.output
+            assert "PROGRAMMED ROUTES" in res.output
+        finally:
+            loop_holder["loop"].call_soon_threadsafe(stop.set)
+            t.join(timeout=30)
