@@ -367,9 +367,23 @@ class Fib(Actor):
             add_perf_event(perf, self.node_name, "FIB_PROGRAMMED")
             programmed.perf_events = perf
             self.perf_db.append(perf)
-            counters.add_stat_value(
-                "fib.convergence_time_ms", total_perf_duration_ms(perf)
-            )
+            duration_ms = total_perf_duration_ms(perf)
+            counters.add_stat_value("fib.convergence_time_ms", duration_ms)
+            if self._log_sample_q is not None:
+                from openr_tpu.runtime.monitor import LogSample
+
+                self._log_sample_q.push(
+                    LogSample(
+                        event="ROUTE_CONVERGENCE",
+                        node_name=self.node_name,
+                        values={
+                            "duration_ms": duration_ms,
+                            "unicast_routes": len(
+                                programmed.unicast_routes_to_update
+                            ),
+                        },
+                    )
+                )
         counters.increment("fib.routes_programmed")
         self._fib_updates_q.push(programmed)
 

@@ -1,0 +1,216 @@
+"""Daemon composition root.
+
+Role of the reference's openr/Main.cpp:161-636: parse+validate the config,
+create the replicated queues, start every module in order (watchdog ->
+config-store -> monitor -> kvstore -> prefix-manager -> prefix-allocator ->
+spark -> link-monitor -> decision -> fib -> ctrl server, ref Main.cpp
+start order), run until a stop signal, then tear down in reverse
+(ref Main.cpp:592-599).
+
+Interface provisioning: the reference discovers system interfaces over
+netlink (a kernel boundary). This daemon takes static interface
+declarations — `--interface name[=bind_addr:port]` — served by
+UdpIoProvider on loopback/UDP; the netlink-backed provider slots in behind
+the same IoProvider seam when running with kernel access.
+
+Run:  python -m openr_tpu.main --config node1.conf --interface if0
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+import sys
+
+from openr_tpu.config import Config
+from openr_tpu.ctrl import CtrlServer
+from openr_tpu.prefix_manager import OriginatedPrefix
+from openr_tpu.runtime.monitor import Monitor, Watchdog
+from openr_tpu.runtime.openr_wrapper import OpenrWrapper
+from openr_tpu.runtime.persistent_store import PersistentStore
+from openr_tpu.spark.io_provider import UdpIoProvider
+from openr_tpu.types import InterfaceInfo
+
+log = logging.getLogger("openr_tpu.main")
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(description="openr_tpu daemon")
+    p.add_argument("--config", required=True, help="JSON config file path")
+    p.add_argument(
+        "--interface",
+        action="append",
+        default=[],
+        metavar="NAME[=ADDR:PORT]",
+        help="static interface declaration (repeatable)",
+    )
+    p.add_argument(
+        "--peer",
+        action="append",
+        default=[],
+        metavar="IFACE=ADDR:PORT",
+        help="discovery peer endpoint for an interface (repeatable; "
+        "loopback stand-in for multicast membership)",
+    )
+    p.add_argument("--ctrl-port", type=int, default=None)
+    p.add_argument(
+        "--override_drain_state",
+        choices=["drained", "undrained"],
+        default=None,
+    )
+    p.add_argument("--verbose", action="store_true")
+    return p.parse_args(argv)
+
+
+async def run_daemon(args) -> None:
+    cfg = Config.from_file(args.config)
+    oc = cfg.raw
+    node_name = oc.node_name
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    log.info("starting openr_tpu node %s", node_name)
+
+    # -- persistent store (ref config-store start, Main.cpp:340) ----------
+    store = (
+        PersistentStore(oc.persistent_store_path)
+        if oc.persistent_store_path
+        else None
+    )
+
+    # -- spark I/O: UDP provider with static interfaces -------------------
+    io = UdpIoProvider(oc.spark_config.neighbor_discovery_port)
+    iface_specs = []
+    for spec in args.interface:
+        name, _, addr = spec.partition("=")
+        bind_addr, bind_port = "127.0.0.1", None
+        if addr:
+            bind_addr, _, port_s = addr.rpartition(":")
+            bind_port = int(port_s)
+        iface_specs.append((name, bind_addr, bind_port))
+
+    kv_ports: dict[str, int] = {}
+    originated = [
+        OriginatedPrefix(**op) if isinstance(op, dict) else op
+        for op in oc.originated_prefixes
+    ]
+    node = OpenrWrapper(
+        node_name,
+        io,
+        kv_ports,
+        areas=[a.area_id for a in oc.areas],
+        spark_config=oc.spark_config,
+        kvstore_config=oc.kvstore_config,
+        decision_config=oc.decision_config,
+        fib_config=oc.fib_config,
+        lm_config=oc.link_monitor_config,
+        originated_prefixes=originated,
+        solver_backend=oc.decision_config.solver_backend,
+        enable_ctrl=True,
+        ctrl_port=(
+            args.ctrl_port if args.ctrl_port is not None else oc.openr_ctrl_port
+        ),
+        persistent_store=store,
+        # neighbors publish their kvstore endpoint in the spark handshake's
+        # dedicated kvstore_port field
+        kvstore_port_of=lambda ev: ("127.0.0.1", ev.kvstore_port),
+    )
+
+    # -- bring up interfaces ----------------------------------------------
+    iface_infos = []
+    for name, bind_addr, bind_port in iface_specs:
+        addr = await io.add_interface(name, bind_addr, bind_port)
+        log.info("interface %s bound at %s:%d", name, *addr)
+        iface_infos.append(InterfaceInfo(if_name=name, is_up=True))
+    peers_by_iface: dict[str, list[tuple[str, int]]] = {}
+    for spec in args.peer:
+        iface, _, endpoint = spec.partition("=")
+        host, _, port_s = endpoint.rpartition(":")
+        peers_by_iface.setdefault(iface, []).append((host, int(port_s)))
+    for iface, peers in peers_by_iface.items():
+        io.set_peers(iface, peers)
+
+    # -- watchdog + monitor (ref Main.cpp:274-281, :352) ------------------
+    watchdog = (
+        Watchdog(node_name, oc.watchdog_config) if oc.enable_watchdog else None
+    )
+    monitor = Monitor(
+        node_name,
+        oc.monitor_config,
+        node.log_sample_queue.get_reader("monitor"),
+    )
+
+    # -- start (ref start order Main.cpp) ---------------------------------
+    if watchdog is not None:
+        await watchdog.start()
+    await monitor.start()
+    await node.start(*[name for name, _, _ in iface_specs])
+    for info in iface_infos:
+        node.link_monitor.update_interface(info)
+    if args.override_drain_state is not None:
+        await node.link_monitor.set_node_overload(
+            args.override_drain_state == "drained"
+        )
+    elif oc.assume_drained:
+        await node.link_monitor.set_node_overload(True)
+
+    if watchdog is not None:
+        for actor in (
+            node.kvstore,
+            node.spark,
+            node.link_monitor,
+            node.decision,
+            node.fib,
+            node.prefix_manager,
+            monitor,
+        ):
+            watchdog.watch_actor(actor)
+        for q in (
+            node.kvstore_updates_queue,
+            node.route_updates_queue,
+            node.fib_updates_queue,
+            node.neighbor_updates_queue,
+        ):
+            watchdog.watch_queue(q)
+
+    log.info(
+        "node %s up: ctrl port %d, kvstore port %d",
+        node_name,
+        node.ctrl.port,
+        node.kvstore.port,
+    )
+    print(f"READY ctrl={node.ctrl.port} kvstore={node.kvstore.port}", flush=True)
+
+    # -- run until signal (ref mainEvb loop + EventBaseStopSignalHandler) -
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+
+    # graceful restart announcement, then reverse teardown
+    log.info("stopping node %s", node_name)
+    await node.spark.send_restarting_hellos()
+    await node.stop()
+    await monitor.stop()
+    if watchdog is not None:
+        await watchdog.stop()
+    if store is not None:
+        store.close()
+    io.close()
+    log.info("node %s stopped", node_name)
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+    try:
+        asyncio.run(run_daemon(args))
+    except KeyboardInterrupt:
+        sys.exit(130)
+
+
+if __name__ == "__main__":
+    main()

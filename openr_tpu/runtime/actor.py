@@ -122,10 +122,18 @@ class Actor:
         )
         self._tasks.append(task)
         # Prune on completion: short-lived tasks (per-publication floods,
-        # client closes) must not accumulate for the actor's lifetime.
-        task.add_done_callback(
-            lambda t: self._tasks.remove(t) if t in self._tasks else None
-        )
+        # client closes) must not accumulate for the actor's lifetime. Also
+        # close the wrapped coroutine if the task was cancelled before its
+        # first step (it would otherwise warn 'never awaited' at GC).
+        def _done(t):
+            if t in self._tasks:
+                self._tasks.remove(t)
+            try:
+                coro.close()
+            except RuntimeError:
+                pass  # still running (normal completion path)
+
+        task.add_done_callback(_done)
         return task
 
     def make_timer(self, callback: Callable[[], Any]) -> Timer:

@@ -1,0 +1,192 @@
+"""Monitor + Watchdog actors — observability and self-healing.
+
+Monitor (role of openr/monitor/MonitorBase.{h,cpp} :32-80, Monitor,
+LogSample, SystemMetrics): consumes the log-sample queue of structured
+JSON event logs, retains the last N, and exports process CPU/memory/uptime
+counters into the counter fabric every interval.
+
+Watchdog (role of openr/watchdog/Watchdog.{h,cpp} :20): every interval it
+checks each registered actor's health timestamp — staleness beyond
+thread_timeout fires the crash handler (the reference aborts the whole
+process for supervisor restart, ref fireCrash) — enforces the memory
+ceiling, and exports per-queue depth counters (ref Watchdog.h:28-51).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import json
+import logging
+import os
+import resource
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from openr_tpu.config import MonitorConfig, WatchdogConfig
+from openr_tpu.messaging import ReplicateQueue, RQueue
+from openr_tpu.runtime.actor import Actor
+from openr_tpu.runtime.counters import counters
+
+log = logging.getLogger(__name__)
+
+# ru_maxrss units differ by platform: Linux reports KB, macOS bytes
+_RSS_DIVISOR = 1024.0 * 1024.0 if sys.platform == "darwin" else 1024.0
+
+
+def rss_mb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / _RSS_DIVISOR
+
+
+@dataclass
+class LogSample:
+    """Structured event log (ref openr/monitor/LogSample.{h,cpp})."""
+
+    event: str
+    node_name: str = ""
+    ts_ms: int = field(default_factory=lambda: int(time.time() * 1000))
+    values: dict = field(default_factory=dict)
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "event": self.event,
+                "node_name": self.node_name,
+                "ts_ms": self.ts_ms,
+                **self.values,
+            },
+            sort_keys=True,
+        )
+
+
+class Monitor(Actor):
+    """ref MonitorBase.h:32."""
+
+    def __init__(
+        self,
+        node_name: str,
+        config: MonitorConfig,
+        log_sample_queue: RQueue,
+        interval_s: float = 1.0,
+    ):
+        super().__init__(f"monitor:{node_name}")
+        self.node_name = node_name
+        self.cfg = config
+        self._log_samples = log_sample_queue
+        self._interval_s = interval_s
+        self.event_logs: collections.deque[LogSample] = collections.deque(
+            maxlen=config.max_event_log_entries
+        )
+        self._process_start = time.monotonic()
+
+    async def on_start(self) -> None:
+        self.add_task(self._log_loop(), name=f"{self.name}.logs")
+        self.add_task(self._metrics_loop(), name=f"{self.name}.metrics")
+
+    async def _log_loop(self) -> None:
+        while True:
+            sample = await self._log_samples.get()
+            if isinstance(sample, LogSample):
+                self.event_logs.append(sample)
+                counters.increment("monitor.event_logs")
+
+    async def _metrics_loop(self) -> None:
+        """Process gauges (role of SystemMetrics.{h,cpp})."""
+        while True:
+            usage = resource.getrusage(resource.RUSAGE_SELF)
+            counters.set_counter("process.memory.rss_mb", rss_mb())
+            counters.set_counter(
+                "process.cpu.total_s", usage.ru_utime + usage.ru_stime
+            )
+            counters.set_counter(
+                "process.uptime_s", time.monotonic() - self._process_start
+            )
+            await asyncio.sleep(self._interval_s)
+
+    # -- API (ref getEventLogs) --------------------------------------------
+
+    async def get_event_logs(self) -> list[str]:
+        return [s.to_json() for s in self.event_logs]
+
+
+def _default_crash_handler(reason: str) -> None:
+    """ref Watchdog::fireCrash — kill the process so the supervisor
+    (systemd) restarts it with fresh state."""
+    log.critical("watchdog: %s — aborting process", reason)
+    sys.stderr.flush()
+    os._exit(70)  # EX_SOFTWARE
+
+
+class Watchdog(Actor):
+    """ref Watchdog.h:20."""
+
+    def __init__(
+        self,
+        node_name: str,
+        config: WatchdogConfig,
+        crash_handler: Optional[Callable[[str], None]] = None,
+    ):
+        super().__init__(f"watchdog:{node_name}")
+        self.cfg = config
+        self._watched_actors: list[Actor] = []
+        self._watched_queues: list[ReplicateQueue] = []
+        self._crash = crash_handler or _default_crash_handler
+        self.fired: Optional[str] = None  # reason, for tests
+
+    def watch_actor(self, actor: Actor) -> None:
+        """ref addEvb — actors stamp last_alive_ts (actor.py heartbeat)."""
+        self._watched_actors.append(actor)
+
+    def watch_queue(self, queue: ReplicateQueue) -> None:
+        """ref addQueue — depth counters (Watchdog.h:45-48)."""
+        self._watched_queues.append(queue)
+
+    async def on_start(self) -> None:
+        self.add_task(self._watch_loop(), name=f"{self.name}.watch")
+
+    async def _watch_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.cfg.interval_s)
+            self._check_threads()
+            self._check_memory()
+            self._export_queue_stats()
+
+    def _check_threads(self) -> None:
+        """ref monitorThreadStatus."""
+        for actor in self._watched_actors:
+            stale_s = actor.seconds_since_alive()
+            if stale_s > self.cfg.thread_timeout_s:
+                self._fire(
+                    f"actor {actor.name} stalled for {stale_s:.1f}s "
+                    f"(> {self.cfg.thread_timeout_s}s)"
+                )
+                return
+
+    def _check_memory(self) -> None:
+        """ref monitorMemory."""
+        rss = rss_mb()
+        counters.set_counter("watchdog.rss_mb", rss)
+        if rss > self.cfg.max_memory_mb:
+            self._fire(
+                f"memory {rss:.0f}MB exceeds ceiling "
+                f"{self.cfg.max_memory_mb}MB"
+            )
+
+    def _export_queue_stats(self) -> None:
+        for q in self._watched_queues:
+            stats = q.stats()
+            counters.set_counter(
+                f"messaging.queue.{stats['name']}.max_depth",
+                stats["max_depth"],
+            )
+            counters.set_counter(
+                f"messaging.queue.{stats['name']}.writes", stats["writes"]
+            )
+
+    def _fire(self, reason: str) -> None:
+        if self.fired is None:
+            self.fired = reason
+            counters.increment("watchdog.crashes_fired")
+            self._crash(reason)

@@ -65,6 +65,8 @@ class OpenrWrapper:
         solver_backend: str = "cpu",
         enable_ctrl: bool = False,
         ctrl_port: int = 0,
+        persistent_store=None,
+        kvstore_port_of=None,
     ):
         self.node_name = node_name
         self.kv_ports = kv_ports  # shared node -> kvstore port registry
@@ -108,7 +110,11 @@ class OpenrWrapper:
             self.kv_request_queue,
             interface_updates_queue=self.interface_updates_queue,
             prefix_updates_queue=self.prefix_updates_queue,
-            kvstore_port_of=lambda ev: ("127.0.0.1", self.kv_ports[ev.node_name]),
+            persistent_store=persistent_store,
+            # default: in-process port registry; the daemon passes a hook
+            # that reads the kvstore_port learned via the spark handshake
+            kvstore_port_of=kvstore_port_of
+            or (lambda ev: ("127.0.0.1", self.kv_ports[ev.node_name])),
             advertise_throttle_s=0.002,
         )
         self.decision = Decision(
@@ -140,6 +146,7 @@ class OpenrWrapper:
             self.fib_service,
             self.route_updates_queue.get_reader(),
             self.fib_updates_queue,
+            log_sample_queue=self.log_sample_queue,
             retry_initial_backoff_s=0.02,
             retry_max_backoff_s=0.2,
         )
@@ -149,6 +156,8 @@ class OpenrWrapper:
         decision -> fib -> spark (discovery last, once consumers exist)."""
         await self.kvstore.start()
         self.kv_ports[self.node_name] = self.kvstore.port
+        # peers learn our kvstore endpoint through the spark handshake
+        self.spark.kvstore_port = self.kvstore.port
         for iface in interfaces:
             self.spark.add_interface(iface)
         await self.prefix_manager.start()

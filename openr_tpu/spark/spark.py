@@ -123,6 +123,7 @@ class _NeighborInfo:
     gr_active: bool = False
     restarted: bool = False  # came back through RESTART
     ctrl_port: int = 0
+    kvstore_port: int = 0
     addr_v6: str = ""
     addr_v4: str = ""
     hold_timer: Optional[Timer] = None
@@ -142,6 +143,7 @@ class Spark(Actor):
         neighbor_updates_queue: ReplicateQueue,
         resolve_area: Optional[Callable[[str, str], Optional[str]]] = None,
         ctrl_port: int = 0,
+        kvstore_port: int = 0,
         interface_updates_queue=None,
     ):
         super().__init__(f"spark:{node_name}")
@@ -153,6 +155,7 @@ class Spark(Actor):
         # area negotiation hook (role of config AreaConfiguration matchers)
         self._resolve_area = resolve_area or (lambda node, iface: "0")
         self.ctrl_port = ctrl_port
+        self.kvstore_port = kvstore_port
 
         self.interfaces: set[str] = set()
         # (if_name, neighbor_node) -> session
@@ -258,6 +261,7 @@ class Spark(Actor):
             hold_time_ms=int(self.cfg.hold_time_s * 1e3),
             gr_hold_time_ms=int(self.cfg.graceful_restart_time_s * 1e3),
             openr_ctrl_port=self.ctrl_port,
+            kvstore_port=self.kvstore_port,
             area=nb.area,
             neighbor_node_name=nb.node_name,
             transport_address_v6=f"fe80::{self.node_name}",
@@ -485,6 +489,7 @@ class Spark(Actor):
             return
         nb.hold_time_ms = msg.hold_time_ms or int(self.cfg.hold_time_s * 1e3)
         nb.ctrl_port = msg.openr_ctrl_port
+        nb.kvstore_port = msg.kvstore_port
         nb.addr_v6 = msg.transport_address_v6
         nb.addr_v4 = msg.transport_address_v4
         self._transition(nb, SparkNeighEvent.HANDSHAKE_RCVD)
@@ -577,6 +582,7 @@ class Spark(Actor):
                 neighbor_addr_v6=nb.addr_v6,
                 neighbor_addr_v4=nb.addr_v4,
                 ctrl_port=nb.ctrl_port,
+                kvstore_port=nb.kvstore_port,
                 rtt_us=nb.reported_rtt_us or nb.rtt_us,
             )
         )

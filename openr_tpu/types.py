@@ -304,6 +304,7 @@ class SparkHandshakeMsg:
     transport_address_v6: str = ""
     transport_address_v4: str = ""
     openr_ctrl_port: int = 0
+    kvstore_port: int = 0  # peer's kvstore RPC endpoint for LinkMonitor
     area: str = ""  # negotiated area
     neighbor_node_name: str = ""  # directed handshake target
 

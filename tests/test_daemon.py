@@ -1,0 +1,143 @@
+"""Daemon smoke test: two REAL processes over UDP loopback.
+
+Role of the reference's netns emulation labs (openr/orie/labs/001_*): run
+two complete daemons as separate OS processes, wired via explicit UDP peer
+endpoints, and assert cross-process convergence through the real ctrl API.
+"""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FAST_TIMERS = {
+    "hello_time_s": 0.1,
+    "fastinit_hello_time_ms": 30,
+    "keepalive_time_s": 0.1,
+    "hold_time_s": 1.0,
+    "graceful_restart_time_s": 2.0,
+    "handshake_time_ms": 50,
+    "min_packets_per_sec": 0,
+}
+
+
+def write_config(tmp_path, name, udp_port):
+    cfg = {
+        "node_name": name,
+        "openr_ctrl_port": 0,  # ephemeral
+        "spark_config": {
+            **FAST_TIMERS,
+            "neighbor_discovery_port": udp_port,
+        },
+        "decision_config": {"debounce_min_ms": 10, "debounce_max_ms": 50},
+        "kvstore_config": {},
+        "enable_watchdog": False,
+    }
+    path = tmp_path / f"{name}.conf"
+    path.write_text(json.dumps(cfg))
+    return str(path)
+
+
+def spawn(config, iface_port, peer_port):
+    return subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "openr_tpu.main",
+            "--config",
+            config,
+            "--interface",
+            f"if0=127.0.0.1:{iface_port}",
+            "--peer",
+            f"if0=127.0.0.1:{peer_port}",
+            "--ctrl-port",
+            "0",
+        ],
+        cwd=REPO,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+
+
+def wait_ready(proc, timeout_s=30) -> dict:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            time.sleep(0.05)
+            continue
+        m = re.match(r"READY ctrl=(\d+) kvstore=(\d+)", line)
+        if m:
+            return {"ctrl": int(m.group(1)), "kvstore": int(m.group(2))}
+    raise AssertionError("daemon did not report READY")
+
+
+def breeze(ctrl_port, *args) -> str:
+    out = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "openr_tpu.cli.breeze",
+            "--port",
+            str(ctrl_port),
+            *args,
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=30,
+    )
+    assert out.returncode == 0, out.stderr
+    return out.stdout
+
+
+def test_two_process_convergence(tmp_path):
+    port_a, port_b = 16661, 16662  # static UDP ports for the pair
+    cfg_a = write_config(tmp_path, "proc-a", port_a)
+    cfg_b = write_config(tmp_path, "proc-b", port_b)
+    pa = spawn(cfg_a, port_a, port_b)
+    pb = spawn(cfg_b, port_b, port_a)
+    try:
+        ports_a = wait_ready(pa)
+        ports_b = wait_ready(pb)
+
+        # cross-process convergence: each daemon sees the other ESTABLISHED
+        # and the adjacency DBs of both nodes in its kvstore
+        deadline = time.monotonic() + 30
+        converged = False
+        while time.monotonic() < deadline and not converged:
+            try:
+                dump = breeze(ports_a["ctrl"], "kvstore", "dump")
+                nbrs = breeze(ports_a["ctrl"], "spark", "neighbors")
+                converged = (
+                    "adj:proc-a" in dump
+                    and "adj:proc-b" in dump
+                    and "ESTABLISHED" in nbrs
+                )
+            except AssertionError:
+                pass
+            if not converged:
+                time.sleep(0.3)
+        assert converged, "daemons did not converge"
+
+        # routes computed across the process boundary: b's view from a
+        routes = breeze(ports_a["ctrl"], "decision", "routes")
+        adj = breeze(ports_a["ctrl"], "decision", "adjacencies")
+        assert "proc-b" in adj
+
+        # graceful shutdown via SIGTERM
+        pb.send_signal(signal.SIGTERM)
+        assert pb.wait(timeout=15) == 0
+        pa.send_signal(signal.SIGTERM)
+        assert pa.wait(timeout=15) == 0
+    finally:
+        for p in (pa, pb):
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=5)

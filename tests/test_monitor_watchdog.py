@@ -1,0 +1,113 @@
+"""Monitor + Watchdog actor tests (ref openr/watchdog/Watchdog.h:28-51,
+openr/monitor/MonitorBase.h:32)."""
+
+import asyncio
+import time
+
+from openr_tpu.config import MonitorConfig, WatchdogConfig
+from openr_tpu.kvstore.wrapper import wait_until
+from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.runtime.actor import Actor
+from openr_tpu.runtime.counters import counters
+from openr_tpu.runtime.monitor import LogSample, Monitor, Watchdog
+from tests.conftest import run_async
+
+
+class TestMonitor:
+    @run_async
+    async def test_event_log_retention(self):
+        q = ReplicateQueue("logSamples")
+        mon = Monitor(
+            "node1",
+            MonitorConfig(max_event_log_entries=3),
+            q.get_reader(),
+            interval_s=0.05,
+        )
+        await mon.start()
+        try:
+            for i in range(5):
+                q.push(LogSample(event=f"EVENT_{i}", node_name="node1"))
+            await wait_until(lambda: len(mon.event_logs) == 3)
+            logs = await mon.get_event_logs()
+            # ring: only the last 3 retained
+            assert '"event": "EVENT_4"' in logs[-1]
+            assert all("EVENT_0" not in line for line in logs)
+        finally:
+            await mon.stop()
+
+    @run_async
+    async def test_process_gauges_exported(self):
+        q = ReplicateQueue("logSamples")
+        mon = Monitor("node1", MonitorConfig(), q.get_reader(), interval_s=0.02)
+        await mon.start()
+        try:
+            await wait_until(
+                lambda: counters.get_counter("process.memory.rss_mb") is not None
+            )
+            assert counters.get_counter("process.memory.rss_mb") > 0
+            assert counters.get_counter("process.uptime_s") is not None
+        finally:
+            await mon.stop()
+
+
+class TestWatchdog:
+    @run_async
+    async def test_fires_on_stalled_actor(self):
+        fired = []
+        wd = Watchdog(
+            "node1",
+            WatchdogConfig(interval_s=0.05, thread_timeout_s=0.2),
+            crash_handler=fired.append,
+        )
+        victim = Actor("victim")
+        await victim.start()
+        await wd.start()
+        try:
+            await asyncio.sleep(0.2)
+            assert not fired  # healthy heartbeat
+            wd.watch_actor(victim)
+            # simulate a stall: stop the heartbeat task but keep watching
+            await victim.stop()
+            victim.last_alive_ts = time.monotonic() - 10
+            await wait_until(lambda: fired, timeout_s=3)
+            assert "victim" in fired[0]
+            assert wd.fired is not None
+        finally:
+            await wd.stop()
+
+    @run_async
+    async def test_memory_ceiling(self):
+        fired = []
+        wd = Watchdog(
+            "node1",
+            WatchdogConfig(interval_s=0.05, thread_timeout_s=60, max_memory_mb=1),
+            crash_handler=fired.append,
+        )
+        await wd.start()
+        try:
+            await wait_until(lambda: fired, timeout_s=3)
+            assert "memory" in fired[0]
+        finally:
+            await wd.stop()
+
+    @run_async
+    async def test_queue_depth_counters(self):
+        wd = Watchdog(
+            "node1",
+            WatchdogConfig(interval_s=0.05, thread_timeout_s=60,
+                           max_memory_mb=100_000),
+            crash_handler=lambda reason: None,
+        )
+        q = ReplicateQueue("testq")
+        reader = q.get_reader("r")
+        for _ in range(7):
+            q.push(1)
+        wd.watch_queue(q)
+        await wd.start()
+        try:
+            await wait_until(
+                lambda: counters.get_counter("messaging.queue.testq.max_depth")
+                == 7
+            )
+        finally:
+            await wd.stop()
