@@ -65,6 +65,13 @@ _NEG = -(2**31)
 # jitted kernels (pure functions of arrays; shapes static per capacity class)
 # ---------------------------------------------------------------------------
 
+# relaxation steps fused per while_loop iteration: each on-device loop trip
+# has fixed dispatch overhead, and a single [N_cap, K_cap] relax is tiny —
+# fusing amortizes the trip cost over UNROLL steps (extra steps past the
+# fixpoint are no-ops)
+_UNROLL = 8
+
+
 def _sssp_kernel(in_nbr, in_w, in_up, node_over, root):
     """dist[v] fixpoint; int32 [N_cap]."""
     import jax
@@ -76,13 +83,18 @@ def _sssp_kernel(in_nbr, in_w, in_up, node_over, root):
     # overloaded (transit drain, ref LinkState.cpp:858-866)
     usable = in_up & (in_nbr >= 0) & ((in_nbr == root) | ~node_over[in_nbr])
 
-    def body(state):
-        dist, _ = state
+    def relax(dist):
         nbr_dist = dist[in_nbr]  # [N, K] gather
         cand = jnp.where(
             usable & (nbr_dist < INF), nbr_dist + in_w, INF
         ).min(axis=1)
-        new = jnp.minimum(dist, cand)
+        return jnp.minimum(dist, cand)
+
+    def body(state):
+        dist, _ = state
+        new = dist
+        for _ in range(_UNROLL):
+            new = relax(new)
         return new, jnp.any(new != dist)
 
     dist, _ = jax.lax.while_loop(lambda s: s[1], body, (dist0, jnp.bool_(True)))
@@ -114,10 +126,15 @@ def _next_hop_kernel(in_nbr, in_w, in_up, node_over, root, dist, root_nbr, root_
         & (dist[in_nbr] + in_w == dist[:, None])
     )
 
+    def step(nh):
+        prop = jnp.any(ok_parent[:, :, None] & nh[in_nbr], axis=1)
+        return seed | prop
+
     def body(state):
         nh, _ = state
-        prop = jnp.any(ok_parent[:, :, None] & nh[in_nbr], axis=1)
-        new = seed | prop
+        new = nh
+        for _ in range(_UNROLL):
+            new = step(new)
         return new, jnp.any(new != nh)
 
     nh, _ = jax.lax.while_loop(lambda s: s[1], body, (seed, jnp.bool_(True)))
@@ -189,6 +206,196 @@ def _jitted_pipeline():
     return jax.jit(pipeline)
 
 
+def pack_graph_inputs(
+    in_nbr, in_w, in_up, node_over, root_idx, root_nbr, root_w, root_up
+) -> np.ndarray:
+    """Graph-side device buffer for one vantage point, with every usability
+    rule folded into an effective weight on the HOST (the device link is
+    bandwidth-bound; fewer arrays = fewer bytes):
+
+      w_eff[v,k] = metric of edge u->v, or INF32 when the slot is padding,
+                   the link is down, u is the root (the root cannot be
+                   transit for its own routes), or u is overloaded
+                   (transit drain, ref LinkState.cpp:858-866)
+      root_w[d]  = root's out-slot metric, or INF32 when invalid/down
+                   (an overloaded NEIGHBOR keeps its slot: it is a valid
+                   destination/first hop, just not transit — its own
+                   out-edges are INF via w_eff)
+
+    Layout (int32): in_nbr [N*K] | w_eff [N*K] | root | root_nbr [D] |
+    root_w_eff [D].
+    """
+    src_ok = in_nbr >= 0
+    clipped = np.clip(in_nbr, 0, None)
+    usable = (
+        in_up
+        & src_ok
+        & (in_nbr != root_idx)
+        & ~node_over[clipped]
+    )
+    w_eff = np.where(usable, in_w, INF32).astype(np.int32)
+    rw_eff = np.where((root_nbr >= 0) & root_up, root_w, INF32).astype(np.int32)
+    return np.concatenate(
+        [
+            in_nbr.ravel(),
+            w_eff.ravel(),
+            np.array([root_idx], np.int32),
+            root_nbr,
+            rw_eff,
+        ]
+    ).astype(np.int32, copy=False)
+
+
+def pack_matrix_inputs(matrix, node_over) -> np.ndarray:
+    """Announcer-matrix device buffer; validity and per-announcer drain
+    fold into flag bits host-side.
+
+    Layout (int32): ann_node | ann_flags (bit0 valid, bit1 overloaded) |
+    path_pref | source_pref | dist_adv, each [P*A]."""
+    idx = np.clip(matrix.ann_node, 0, None)
+    flags = matrix.ann_valid.astype(np.int32) | (
+        node_over[idx].astype(np.int32) << 1
+    )
+    return np.concatenate(
+        [
+            matrix.ann_node.ravel(),
+            flags.ravel(),
+            matrix.path_pref.ravel(),
+            matrix.source_pref.ravel(),
+            matrix.dist_adv.ravel(),
+        ]
+    ).astype(np.int32, copy=False)
+
+
+def _sssp_multi_kernel(in_nbr, w_eff, seeds):
+    """Batched SSSP from D seed nodes over host-folded weights:
+    dist_d[v] fixpoint, int32 [D, N]. Invalid seeds (-1) yield all-INF."""
+    import jax
+    import jax.numpy as jnp
+
+    n = in_nbr.shape[0]
+    d = seeds.shape[0]
+    valid = seeds >= 0
+    seed_idx = jnp.clip(seeds, 0, n - 1)
+    dist0 = jnp.full((d, n), INF, jnp.int32)
+    dist0 = dist0.at[jnp.arange(d), seed_idx].min(
+        jnp.where(valid, 0, INF).astype(jnp.int32)
+    )
+    gather_ok = in_nbr >= 0
+    nbr = jnp.clip(in_nbr, 0, n - 1)
+
+    def relax(dist):
+        # dist [D, N] -> gather [D, N, K]
+        nbr_dist = dist[:, nbr]
+        cand = jnp.where(
+            gather_ok[None] & (nbr_dist < INF), nbr_dist + w_eff[None], INF
+        ).min(axis=2)
+        return jnp.minimum(dist, cand)
+
+    def body(state):
+        dist, _ = state
+        new = dist
+        for _ in range(_UNROLL):
+            new = relax(new)
+        return new, jnp.any(new != dist)
+
+    dist, _ = jax.lax.while_loop(
+        lambda s: s[1], body, (dist0, jnp.bool_(True))
+    )
+    return dist
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_packed_pipeline(n_cap: int, k_cap: int, d_cap: int, p_cap: int, a_cap: int):
+    """Packed-I/O pipeline: graph buffer + matrix buffer in, ONE int8
+    buffer out (metric bitcast to bytes).
+
+    Next hops come from a single batched SSSP from the root's D out-slot
+    neighbors in G-minus-root: via[d,v] = root_w[d] + dist_d[v], the true
+    distance is their min (root pinned to 0), and slot d lies on a
+    shortest path to v iff via[d,v] == dist[v] — the same predicate as
+    runSpf's ECMP accumulation (LinkState.cpp:885-901) without a second
+    fixpoint."""
+    import jax
+    import jax.numpy as jnp
+
+    nk = n_cap * k_cap
+    pa = p_cap * a_cap
+
+    def pipeline(gbuf, mbuf):
+        o = 0
+        in_nbr = gbuf[o : o + nk].reshape(n_cap, k_cap); o += nk
+        w_eff = gbuf[o : o + nk].reshape(n_cap, k_cap); o += nk
+        root = gbuf[o]; o += 1
+        root_nbr = gbuf[o : o + d_cap]; o += d_cap
+        root_w = gbuf[o : o + d_cap]; o += d_cap
+        o = 0
+        ann_node = mbuf[o : o + pa].reshape(p_cap, a_cap); o += pa
+        ann_flags = mbuf[o : o + pa].reshape(p_cap, a_cap); o += pa
+        path_pref = mbuf[o : o + pa].reshape(p_cap, a_cap); o += pa
+        source_pref = mbuf[o : o + pa].reshape(p_cap, a_cap); o += pa
+        dist_adv = mbuf[o : o + pa].reshape(p_cap, a_cap); o += pa
+        ann_valid = (ann_flags & 1).astype(bool)
+        ann_over = (ann_flags & 2).astype(bool)
+
+        seeds = jnp.where(root_w < INF, root_nbr, -1)
+        dist_d = _sssp_multi_kernel(in_nbr, w_eff, seeds)  # [D, N]
+        via = jnp.where(
+            (root_w[:, None] < INF) & (dist_d < INF),
+            root_w[:, None] + dist_d,
+            INF,
+        )  # [D, N]
+        dist = via.min(axis=0).at[root].set(0)  # [N]
+
+        # selection (ref _select_metric_kernel semantics, drain via flags)
+        idx = jnp.clip(ann_node, 0, n_cap - 1)
+        ann_dist = dist[idx]
+        reach = ann_valid & (ann_dist < INF)
+        pp = jnp.where(reach, path_pref, _NEG)
+        s = reach & (pp == pp.max(axis=1, keepdims=True))
+        sp = jnp.where(s, source_pref, _NEG)
+        s = s & (sp == sp.max(axis=1, keepdims=True))
+        da = jnp.where(s, dist_adv, INF)
+        s2 = s & (da == da.min(axis=1, keepdims=True))
+        nd = s2 & ~ann_over
+        s3 = jnp.where(nd.any(axis=1, keepdims=True), nd, s2)
+        igp = jnp.where(s3, ann_dist, INF)
+        metric = igp.min(axis=1)
+        s4 = s3 & (igp == metric[:, None])
+
+        # per-prefix next-hop slots: union over min-IGP announcers of the
+        # slots achieving their shortest distance
+        on_sp = via.T == dist[:, None]  # [N, D]
+        nh_mask = jnp.any(s4[:, :, None] & on_sp[idx], axis=1)  # [P, D]
+        has_route = s3.any(axis=1) & (metric < INF)
+
+        out8 = jnp.concatenate(
+            [
+                jax.lax.bitcast_convert_type(metric, jnp.int8).ravel(),
+                s3.astype(jnp.int8).ravel(),
+                nh_mask.astype(jnp.int8).ravel(),
+                has_route.astype(jnp.int8),
+            ]
+        )
+        return out8
+
+    jitted = jax.jit(pipeline)
+
+    def run(gbuf, mbuf):
+        out = np.asarray(jitted(gbuf, mbuf))  # exec + single small pull
+        o = 0
+        metric = out[o : o + 4 * p_cap].view(np.int32); o += 4 * p_cap
+        s3 = out[o : o + pa].reshape(p_cap, a_cap).astype(bool); o += pa
+        nh_mask = (
+            out[o : o + p_cap * d_cap].reshape(p_cap, d_cap).astype(bool)
+        )
+        o += p_cap * d_cap
+        has_route = out[o : o + p_cap].astype(bool)
+        return metric, s3, nh_mask, has_route
+
+    return run
+
+
 @functools.lru_cache(maxsize=None)
 def _jitted_sssp_batch():
     """vmapped multi-root SSSP (whole-fabric / benchmark path)."""
@@ -243,10 +450,11 @@ class TpuSpfSolver:
         self.my_node_name = my_node_name
         self.cpu = SpfSolver(my_node_name, **solver_kwargs)
         self._mirrors: dict[str, tuple[int, EllGraph]] = {}
-        # resident device copies, keyed on the generation counters so
-        # steady-state recomputes ship only what changed
-        self._dev_graph: dict[str, tuple[int, tuple]] = {}
+        # host-side derived caches (root out-table, announcer matrix) and
+        # the resident packed device buffer per (area, vantage)
+        self._dev_graph: dict[tuple, tuple[int, tuple]] = {}
         self._dev_matrix: dict[str, tuple] = {}
+        self._dev_buf: dict[tuple, tuple[np.ndarray, object]] = {}
         self._partition = None  # (ps.generation, fast, slow)
         self._nh_set_cache: dict = {}
         self.last_device_stats: dict = {}
@@ -283,11 +491,7 @@ class TpuSpfSolver:
         if cached is not None and cached[0] == link_state.generation:
             return cached[1]
         prev = cached[1] if cached is not None else None
-        graph = build_ell(
-            link_state,
-            n_cap=prev.n_cap if prev else 0,
-            k_cap=prev.k_cap if prev else 0,
-        )
+        graph = build_ell(link_state, prev=prev)
         self._mirrors[link_state.area] = (link_state.generation, graph)
         return graph
 
@@ -358,59 +562,61 @@ class TpuSpfSolver:
         graph = self.mirror(link_state)
         root_idx = graph.node_index[my_node_name]
 
-        # graph device arrays: resident across solves, refreshed per
-        # generation in ONE batched transfer (round trips dominate on
-        # tunneled devices). Keyed per vantage node too — build_route_db
-        # serves any-vantage queries (ctrl API), and the root's out-edge
-        # table is root-specific.
+        # root out-edge table, cached per (area, vantage, generation):
+        # build_route_db serves any-vantage queries (ctrl API)
         gkey = (area, my_node_name)
         cached = self._dev_graph.get(gkey)
         if cached is None or cached[0] != link_state.generation:
-            root_nbr, root_w, root_up, links = graph.out_table(root_idx)
-            dev = jax.device_put(
-                [
-                    graph.in_nbr,
-                    graph.in_w,
-                    graph.in_up,
-                    graph.node_overloaded,
-                    np.int32(root_idx),
-                    root_nbr,
-                    root_w,
-                    root_up,
-                ]
-            )
-            self._dev_graph[gkey] = (link_state.generation, (dev, links))
-            self._nh_set_cache.clear()  # link objects changed
-        dev_graph, links = self._dev_graph[gkey][1]
+            root_table = graph.out_table(root_idx)
+            self._dev_graph[gkey] = (link_state.generation, root_table)
+        root_nbr, root_w, root_up, links = self._dev_graph[gkey][1]
 
-        # announcer matrix: resident across solves, refreshed on either
-        # prefix churn OR topology churn (node_index is baked into the
-        # announcer indices, and topology changes can renumber nodes)
-        mkey = (prefix_state.generation, link_state.generation)
+        # announcer matrix: keyed on prefix churn + node-index stability —
+        # metric/link flaps that preserve the node set reuse it as-is
+        mkey = (prefix_state.generation, graph.index_version)
         mcached = self._dev_matrix.get(area)
         if mcached is None or mcached[0] != mkey:
             matrix = build_prefix_matrix(
                 prefix_state, graph.node_index, area, prefixes
             )
-            dev_m = jax.device_put(
-                [
-                    matrix.ann_node,
-                    matrix.ann_valid,
-                    matrix.path_pref,
-                    matrix.source_pref,
-                    matrix.dist_adv,
-                ]
-            )
-            self._dev_matrix[area] = (mkey, matrix, dev_m)
-        _, matrix, dev_matrix = self._dev_matrix[area]
+            self._dev_matrix[area] = (mkey, matrix)
+        matrix = self._dev_matrix[area][1]
 
-        pipeline = _jitted_pipeline()
-        dist, metric, s3, nh_mask, has_route = pipeline(*dev_graph, *dev_matrix)
-        # ONE batched device->host fetch (dist stays on device — the route
-        # structure doesn't need it)
-        metric_np, s3_np, nh_np, has_np = jax.device_get(
-            (metric, s3, nh_mask, has_route)
+        # TWO packed input buffers (graph-per-vantage, announcer matrix),
+        # each resident on device and re-uploaded only when its content
+        # changed — the device link is bandwidth-bound, and topology churn
+        # and prefix churn invalidate different halves
+        gbuf = pack_graph_inputs(
+            graph.in_nbr, graph.in_w, graph.in_up, graph.node_overloaded,
+            root_idx, root_nbr, root_w, root_up,
         )
+        dev_cached = self._dev_buf.get(gkey)
+        if (
+            dev_cached is None
+            or dev_cached[0].shape != gbuf.shape
+            or not np.array_equal(dev_cached[0], gbuf)
+        ):
+            self._dev_buf[gkey] = (gbuf, jax.device_put(gbuf))
+            self._nh_set_cache.clear()  # link objects may have changed
+        dev_gbuf = self._dev_buf[gkey][1]
+
+        mbuf = pack_matrix_inputs(matrix, graph.node_overloaded)
+        mbuf_key = ("matrix", area)
+        dev_mcached = self._dev_buf.get(mbuf_key)
+        if (
+            dev_mcached is None
+            or dev_mcached[0].shape != mbuf.shape
+            or not np.array_equal(dev_mcached[0], mbuf)
+        ):
+            self._dev_buf[mbuf_key] = (mbuf, jax.device_put(mbuf))
+        dev_mbuf = self._dev_buf[mbuf_key][1]
+
+        d_cap = root_nbr.shape[0]
+        p_cap, a_cap = matrix.ann_node.shape
+        run = _jitted_packed_pipeline(
+            graph.n_cap, graph.k_cap, d_cap, p_cap, a_cap
+        )
+        metric_np, s3_np, nh_np, has_np = run(dev_gbuf, dev_mbuf)
         self.last_device_stats = {
             "n_cap": graph.n_cap,
             "k_cap": graph.k_cap,

@@ -62,81 +62,115 @@ class EllGraph:
     # node index <-> name
     node_names: list  # idx -> name
     node_index: dict  # name -> idx
-    # out-edge table per node (host side, for first-hop slot extraction):
-    # out_slots[node_idx] = list of (neighbor_idx, metric, up, Link)
-    out_slots: list
+    # directed edge arrays (srcs/dsts/ws/ups aligned with edge_links) for
+    # on-demand out-edge table extraction
+    edge_src: np.ndarray  # int32 [E]
+    edge_dst: np.ndarray  # int32 [E]
+    edge_w: np.ndarray  # int32 [E]
+    edge_up: np.ndarray  # bool [E]
+    edge_links: list  # [E] Link refs (host materialization)
+    # bumped only when the node name -> index mapping changes; derived
+    # structures keyed on node indices (the prefix announcer matrix) stay
+    # valid across metric/link churn that preserves the node set
+    index_version: int = 0
 
     def out_table(self, root_idx: int, d_cap: Optional[int] = None):
         """Root's out-edge slot arrays for next-hop extraction:
         (nbr[d_cap], w[d_cap], up[d_cap], links list). Slot order is the
-        deterministic sorted-Link order."""
-        slots = self.out_slots[root_idx]
-        d_cap = d_cap or _next_pow2(max(len(slots), 1), floor=4)
+        deterministic sorted-Link order (edge arrays are built sorted)."""
+        eids = np.flatnonzero(self.edge_src == root_idx)
+        d_cap = d_cap or _next_pow2(max(len(eids), 1), floor=4)
         nbr = np.full(d_cap, -1, np.int32)
         w = np.full(d_cap, INF32, np.int32)
         up = np.zeros(d_cap, bool)
-        links = []
-        for d, (nidx, metric, is_up, link) in enumerate(slots[:d_cap]):
-            nbr[d] = nidx
-            w[d] = metric
-            up[d] = is_up
-            links.append(link)
+        eids = eids[:d_cap]
+        n_out = len(eids)
+        nbr[:n_out] = self.edge_dst[eids]
+        w[:n_out] = self.edge_w[eids]
+        up[:n_out] = self.edge_up[eids]
+        links = [self.edge_links[e] for e in eids]
         return nbr, w, up, links
 
 
-def build_ell(link_state: LinkState, n_cap: int = 0, k_cap: int = 0) -> EllGraph:
+def build_ell(
+    link_state: LinkState,
+    n_cap: int = 0,
+    k_cap: int = 0,
+    prev: Optional[EllGraph] = None,
+) -> EllGraph:
     """Mirror a LinkState into padded arrays (full rebuild path).
 
-    Vectorized where it matters; called on topologyChanged. Metric-only
-    churn can instead patch in_w via `edge_positions` + update_metrics.
-    """
+    The per-edge extraction is one Python pass over sorted links; the
+    padded-array fill is fully vectorized (stable sort by destination +
+    per-group slot offsets) — no per-edge numpy scalar writes. `prev`
+    carries capacity floors and the index_version continuity."""
     names = sorted(link_state.get_adjacency_databases().keys())
     index = {n: i for i, n in enumerate(names)}
     n = len(names)
+    if prev is not None:
+        n_cap = max(n_cap, prev.n_cap)
+        k_cap = max(k_cap, prev.k_cap)
     n_cap = max(n_cap, _next_pow2(n))
 
-    # directed edge lists (u -> v with metric from u's side)
+    # directed edge lists (u -> v with metric from u's side); one tight pass
     srcs: list[int] = []
     dsts: list[int] = []
     ws: list[int] = []
     ups: list[bool] = []
-    links_per_edge: list[Link] = []
-    out_slots: list[list] = [[] for _ in range(n_cap)]
+    edge_links: list[Link] = []
+    s_app, d_app, w_app, u_app, l_app = (
+        srcs.append, dsts.append, ws.append, ups.append, edge_links.append
+    )
     for link in sorted(link_state.all_links()):
         up = link.is_up()
-        for u_name in (link.n1, link.n2):
-            v_name = link.other_node(u_name)
-            u, v = index[u_name], index[v_name]
-            w = link.metric_from_node(u_name)
-            srcs.append(u)
-            dsts.append(v)
-            ws.append(w)
-            ups.append(up)
-            links_per_edge.append(link)
-            out_slots[u].append((v, w, up, link))
+        n1, n2 = link.n1, link.n2
+        i1, i2 = index[n1], index[n2]
+        w1 = link.metric_from_node(n1)
+        w2 = link.metric_from_node(n2)
+        s_app(i1); d_app(i2); w_app(w1); u_app(up); l_app(link)
+        s_app(i2); d_app(i1); w_app(w2); u_app(up); l_app(link)
 
-    in_deg = np.zeros(n_cap, np.int64)
-    for v in dsts:
-        in_deg[v] += 1
-    k = int(in_deg.max()) if len(dsts) else 0
+    e = len(srcs)
+    src_a = np.asarray(srcs, np.int32)
+    dst_a = np.asarray(dsts, np.int32)
+    w_a = np.asarray(ws, np.int32)
+    up_a = np.asarray(ups, bool)
+
+    if e:
+        in_deg = np.bincount(dst_a, minlength=n_cap)
+        k = int(in_deg.max())
+    else:
+        k = 0
     k_cap = max(k_cap, _next_pow2(max(k, 1), floor=4))
 
     in_nbr = np.full((n_cap, k_cap), -1, np.int32)
     in_w = np.full((n_cap, k_cap), INF32, np.int32)
     in_up = np.zeros((n_cap, k_cap), bool)
-    fill = np.zeros(n_cap, np.int64)
-    for u, v, w, up in zip(srcs, dsts, ws, ups):
-        s = fill[v]
-        in_nbr[v, s] = u
-        in_w[v, s] = w
-        in_up[v, s] = up
-        fill[v] = s + 1
+    if e:
+        order = np.argsort(dst_a, kind="stable")
+        sd = dst_a[order]
+        # slot index within each destination group
+        first = np.r_[0, np.flatnonzero(np.diff(sd)) + 1]
+        counts = np.diff(np.r_[first, e])
+        slots = np.arange(e) - np.repeat(first, counts)
+        in_nbr[sd, slots] = src_a[order]
+        in_w[sd, slots] = w_a[order]
+        in_up[sd, slots] = up_a[order]
 
     node_overloaded = np.zeros(n_cap, bool)
     node_valid = np.zeros(n_cap, bool)
     node_valid[:n] = True
+    overload = link_state.is_node_overloaded
     for i, name in enumerate(names):
-        node_overloaded[i] = link_state.is_node_overloaded(name)
+        node_overloaded[i] = overload(name)
+
+    index_version = 0
+    if prev is not None:
+        index_version = (
+            prev.index_version
+            if prev.node_names == names
+            else prev.index_version + 1
+        )
 
     return EllGraph(
         n_nodes=n,
@@ -149,7 +183,12 @@ def build_ell(link_state: LinkState, n_cap: int = 0, k_cap: int = 0) -> EllGraph
         node_valid=node_valid,
         node_names=names,
         node_index=index,
-        out_slots=out_slots,
+        edge_src=src_a,
+        edge_dst=dst_a,
+        edge_w=w_a,
+        edge_up=up_a,
+        edge_links=edge_links,
+        index_version=index_version,
     )
 
 
