@@ -10,6 +10,7 @@ thrift server on :2018); the breeze CLI (cli/breeze.py) is the client.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import time
 from typing import Optional
@@ -277,19 +278,14 @@ class CtrlServer(Actor):
         )
         reader = self._kvstore_updates_q.get_reader(f"{self.name}.sub")
 
-        async def pump():
-            try:
-                while not stream.closed:
-                    item = await reader.get()
-                    if isinstance(item, Publication) and item.area == area:
-                        stream.push({"delta": to_plain(item)})
-            except QueueClosedError:
-                pass
-            finally:
-                stream.close()
-                self._kvstore_updates_q.remove_reader(reader)
+        def on_item(item):
+            if isinstance(item, Publication) and item.area == area:
+                stream.push({"delta": to_plain(item)})
 
-        self.add_task(pump(), name=f"{self.name}.kvstore-sub")
+        self.add_task(
+            self._pump_subscription(stream, reader, self._kvstore_updates_q, on_item),
+            name=f"{self.name}.kvstore-sub",
+        )
         return stream
 
     async def _subscribe_fib(self) -> Stream:
@@ -302,18 +298,35 @@ class CtrlServer(Actor):
             )
         reader = self._fib_updates_q.get_reader(f"{self.name}.sub")
 
-        async def pump():
-            try:
-                while not stream.closed:
-                    item = await reader.get()
-                    if isinstance(item, InitializationEvent):
-                        continue
-                    stream.push({"delta": to_plain(item)})
-            except QueueClosedError:
-                pass
-            finally:
-                stream.close()
-                self._fib_updates_q.remove_reader(reader)
+        def on_item(item):
+            if not isinstance(item, InitializationEvent):
+                stream.push({"delta": to_plain(item)})
 
-        self.add_task(pump(), name=f"{self.name}.fib-sub")
+        self.add_task(
+            self._pump_subscription(stream, reader, self._fib_updates_q, on_item),
+            name=f"{self.name}.fib-sub",
+        )
         return stream
+
+    async def _pump_subscription(self, stream, reader, queue, on_item) -> None:
+        """Forward queue items into a stream until it closes. reader.get()
+        races stream closure so a disconnected client's queue reader is
+        unregistered promptly instead of on the next (possibly never)
+        published item."""
+        close_wait = asyncio.ensure_future(stream.wait_closed())
+        try:
+            while not stream.closed:
+                get_t = asyncio.ensure_future(reader.get())
+                await asyncio.wait(
+                    {get_t, close_wait}, return_when=asyncio.FIRST_COMPLETED
+                )
+                if not get_t.done():
+                    get_t.cancel()
+                    break
+                on_item(get_t.result())
+        except QueueClosedError:
+            pass
+        finally:
+            close_wait.cancel()
+            stream.close()
+            queue.remove_reader(reader)

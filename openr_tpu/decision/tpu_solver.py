@@ -455,8 +455,13 @@ class TpuSpfSolver:
         self._dev_graph: dict[tuple, tuple[int, tuple]] = {}
         self._dev_matrix: dict[str, tuple] = {}
         self._dev_buf: dict[tuple, tuple[np.ndarray, object]] = {}
+        # LRU over foreign vantages: any-vantage ctrl queries must not
+        # accumulate resident host+device buffers per queried node forever
+        self._vantage_lru: list[tuple] = []
         self._partition = None  # (ps.generation, fast, slow)
-        self._nh_set_cache: dict = {}
+        # per-vantage {(slot bits, metric) -> frozenset[NextHop]} — scoped so
+        # one vantage's buffer churn cannot thrash another's hot path
+        self._nh_set_cache: dict[str, dict] = {}
         self.last_device_stats: dict = {}
 
     # static-route passthroughs keep Decision actor backend-agnostic
@@ -484,6 +489,19 @@ class TpuSpfSolver:
     @property
     def static_mpls_routes(self):
         return self.cpu.static_mpls_routes
+
+    _MAX_FOREIGN_VANTAGES = 4
+
+    def _touch_foreign_vantage(self, gkey: tuple) -> None:
+        lru = self._vantage_lru
+        if gkey in lru:
+            lru.remove(gkey)
+        lru.append(gkey)
+        while len(lru) > self._MAX_FOREIGN_VANTAGES:
+            old = lru.pop(0)
+            self._dev_graph.pop(old, None)
+            self._dev_buf.pop(old, None)
+            self._nh_set_cache.pop(old[1], None)
 
     def mirror(self, link_state: LinkState) -> EllGraph:
         """Device mirror, refreshed when the LinkState generation moves."""
@@ -565,6 +583,8 @@ class TpuSpfSolver:
         # root out-edge table, cached per (area, vantage, generation):
         # build_route_db serves any-vantage queries (ctrl API)
         gkey = (area, my_node_name)
+        if my_node_name != self.my_node_name:
+            self._touch_foreign_vantage(gkey)
         cached = self._dev_graph.get(gkey)
         if cached is None or cached[0] != link_state.generation:
             root_table = graph.out_table(root_idx)
@@ -597,7 +617,8 @@ class TpuSpfSolver:
             or not np.array_equal(dev_cached[0], gbuf)
         ):
             self._dev_buf[gkey] = (gbuf, jax.device_put(gbuf))
-            self._nh_set_cache.clear()  # link objects may have changed
+            # link objects may have changed — this vantage's sets only
+            self._nh_set_cache.pop(my_node_name, None)
         dev_gbuf = self._dev_buf[gkey][1]
 
         mbuf = pack_matrix_inputs(matrix, graph.node_overloaded)
@@ -670,7 +691,7 @@ class TpuSpfSolver:
         ok &= (eff_min <= nh_count) & (nh_count > 0)
 
         d_range = range(nh_mask.shape[1])
-        nh_cache = self._nh_set_cache
+        nh_cache = self._nh_set_cache.setdefault(my_node_name, {})
         for p in np.flatnonzero(ok):
             prefix = matrix.prefix_list[p]
             row = s3n[p]
@@ -681,8 +702,8 @@ class TpuSpfSolver:
                 continue
             m = int(metric[p])
             bits = tuple(d for d in d_range if nh_mask[p, d])
-            # keyed per vantage: slot indices are root-relative
-            key = (my_node_name, bits, m)
+            # slot indices are root-relative; the cache dict is per-vantage
+            key = (bits, m)
             nexthops = nh_cache.get(key)
             if nexthops is None:
                 nexthops = frozenset(

@@ -168,22 +168,43 @@ class Fib(Actor):
 
     async def _sync_routes(self, perf: Optional[PerfEvents] = None) -> None:
         rs = self.route_state
+        # both tables are always attempted — a partial unicast failure must
+        # not leave pending MPLS routes unprogrammed (ref syncRoutes covers
+        # both with retry)
+        failed_p: set = set()
+        failed_l: set = set()
         try:
             await self.service.sync_fib(
                 CLIENT_ID_OPENR, list(rs.unicast_routes.values())
             )
+        except FibUpdateError as e:
+            failed_p.update(e.failed_prefixes)
+            failed_l.update(e.failed_labels)
+        except Exception as e:
+            log.warning("%s: syncFib failed: %s", self.name, e)
+            counters.increment("fib.sync_fib_failure")
+            self._schedule_retry()
+            return
+        try:
             await self.service.sync_mpls_fib(
                 CLIENT_ID_OPENR, list(rs.mpls_routes.values())
             )
         except FibUpdateError as e:
+            failed_p.update(e.failed_prefixes)
+            failed_l.update(e.failed_labels)
+        except Exception as e:
+            log.warning("%s: syncMplsFib failed: %s", self.name, e)
+            counters.increment("fib.sync_fib_failure")
+            self._schedule_retry()
+            return
+        if failed_p or failed_l:
             # partial: only the failed subset stays dirty; publish ONLY what
             # actually landed (FIB-ACK must never claim unprogrammed routes)
             now = time.monotonic()
-            for p in e.failed_prefixes:
+            for p in failed_p:
                 rs.dirty_prefixes[p] = now
-            for label in e.failed_labels:
+            for label in failed_l:
                 rs.dirty_labels[label] = now
-            failed_p = set(e.failed_prefixes)
             self._finish_sync(
                 perf,
                 unicast={
@@ -191,13 +212,12 @@ class Fib(Actor):
                     for p, r in rs.unicast_routes.items()
                     if p not in failed_p
                 },
-                mpls={},  # sync_mpls_fib never ran on this path
+                mpls={
+                    label: r
+                    for label, r in rs.mpls_routes.items()
+                    if label not in failed_l
+                },
             )
-            self._schedule_retry()
-            return
-        except Exception as e:
-            log.warning("%s: syncFib failed: %s", self.name, e)
-            counters.increment("fib.sync_fib_failure")
             self._schedule_retry()
             return
         rs.dirty_prefixes.clear()

@@ -32,14 +32,22 @@ class Timer:
         self._callback = callback
         self._handle: Optional[asyncio.TimerHandle] = None
         self._loop = loop
+        # owner registry (Actor._timers): fired one-shot timers remove
+        # themselves so schedule()-per-event call sites don't grow the list
+        # unboundedly over a long-running daemon
+        self._registry: Optional[list] = None
 
     def schedule(self, delay_s: float) -> None:
         self.cancel()
         loop = self._loop or asyncio.get_running_loop()
         self._handle = loop.call_later(delay_s, self._fire)
+        if self._registry is not None and self not in self._registry:
+            self._registry.append(self)
 
     def _fire(self) -> None:
         self._handle = None
+        if self._registry is not None and self in self._registry:
+            self._registry.remove(self)
         res = self._callback()
         if asyncio.iscoroutine(res):
             spawn_logged(res, name=f"{type(self).__name__}.callback")
@@ -48,6 +56,8 @@ class Timer:
         if self._handle is not None:
             self._handle.cancel()
             self._handle = None
+        if self._registry is not None and self in self._registry:
+            self._registry.remove(self)
 
     @property
     def scheduled(self) -> bool:
@@ -128,6 +138,10 @@ class Actor:
         def _done(t):
             if t in self._tasks:
                 self._tasks.remove(t)
+            # consume the exception (the runner already logged it) so GC
+            # does not emit 'Task exception was never retrieved'
+            if not t.cancelled():
+                t.exception()
             try:
                 coro.close()
             except RuntimeError:
@@ -138,7 +152,9 @@ class Actor:
 
     def make_timer(self, callback: Callable[[], Any]) -> Timer:
         t = Timer(callback)
-        self._timers.append(t)
+        # registered while scheduled only (self-removing on fire): _timers
+        # stays bounded by the number of concurrently pending timers
+        t._registry = self._timers
         return t
 
     def schedule(self, delay_s: float, callback: Callable[[], Any]) -> Timer:

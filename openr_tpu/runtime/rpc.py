@@ -45,6 +45,7 @@ class Stream:
     def __init__(self) -> None:
         self._queue: asyncio.Queue = asyncio.Queue()
         self.closed = False
+        self._closed_event = asyncio.Event()
 
     def push(self, item: Any) -> None:
         if not self.closed:
@@ -53,7 +54,14 @@ class Stream:
     def close(self) -> None:
         if not self.closed:
             self.closed = True
+            self._closed_event.set()
             self._queue.put_nowait(None)
+
+    async def wait_closed(self) -> None:
+        """Resolves when the stream closes (client disconnect or server
+        shutdown) — lets producers unblock promptly instead of noticing
+        closure only at their next pushed item."""
+        await self._closed_event.wait()
 
     async def _next(self) -> Optional[Any]:
         return await self._queue.get()
