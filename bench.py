@@ -1,16 +1,24 @@
-"""Benchmark: full-RIB recompute on a generated LSDB — TPU pipeline vs the
-CPU SpfSolver oracle (the reference architecture's per-root Dijkstra +
-per-prefix loop re-expressed in this repo; the reference publishes no
-absolute numbers, BASELINE.md).
+"""Benchmark: full-RIB recompute across the five BASELINE.md configs —
+TPU pipeline vs the CPU SpfSolver oracle (the reference publishes no
+absolute numbers; the oracle re-expresses its per-root Dijkstra +
+per-prefix loop, openr/decision/LinkState.cpp:836 + SpfSolver.cpp:460).
 
 Prints exactly ONE JSON line on stdout:
-  {"metric": ..., "value": ..., "unit": "ms", "vs_baseline": ...}
-value        = TPU full-RIB recompute wall time (device pipeline + host
-               route materialization), median of N runs
-vs_baseline  = CPU-oracle time / TPU time  (x-fold speedup; >1 is faster)
+  {"metric": "...", "value": N, "unit": "ms", "vs_baseline": N, ...}
 
-Progress/diagnostics go to stderr. Runs on whatever device jax picks
-(real TPU under the driver; CPU elsewhere).
+value        = TPU full-RIB recompute wall time on the headline config
+               (100k-node LSDB), median over runs, including host
+               materialization and the device round trip
+vs_baseline  = CPU-oracle time / TPU time on that config
+
+The extra "configs" key carries per-config results and a device/host
+breakdown:
+  sync_ms    host mirror sync (changelog delta -> device scatter)
+  exec_ms    device pipeline + the one result pull (tunnel RTT included;
+             measured fixed RTT is reported as rig_rtt_ms)
+  mat_ms     host route materialization (delta rows only, steady state)
+Progress goes to stderr. Runs on whatever device jax picks (real TPU
+under the driver; CPU elsewhere).
 """
 
 from __future__ import annotations
@@ -25,99 +33,226 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def main() -> None:
-    quick = "--quick" in sys.argv
-    grid_side = 10 if quick else 100  # 100 or 10k nodes
+def _flap(states, adj_dbs, victims, round_i, area="0"):
+    """Apply a metric flap on each victim node's adjacencies — BOTH link
+    directions (a fiber event costs both ways), through the real update
+    path (changelog -> device scatter). Large metric so traffic actually
+    reroutes and routes to/through the victims change."""
+    from openr_tpu.types import AdjacencyDatabase, Adjacency
 
-    import jax
+    by_name = getattr(_flap, "_index", None)
+    if by_name is None or _flap._index_id != id(adj_dbs):
+        by_name = {db.this_node_name: db for db in adj_dbs}
+        _flap._index = by_name
+        _flap._index_id = id(adj_dbs)
 
+    metric = 50 + (round_i % 5)
+    touched = {}
+    victim_names = set()
+    for v in victims:
+        db = adj_dbs[v]
+        victim_names.add(db.this_node_name)
+        touched[db.this_node_name] = tuple(
+            Adjacency(**{**a.__dict__, "metric": metric})
+            for a in db.adjacencies
+        )
+    for v in victims:
+        for a in adj_dbs[v].adjacencies:
+            nb = a.other_node_name
+            if nb in victim_names:
+                continue
+            ndb = by_name[nb]
+            base = touched.get(nb, ndb.adjacencies)
+            touched[nb] = tuple(
+                Adjacency(**{**x.__dict__, "metric": metric})
+                if x.other_node_name in victim_names
+                else x
+                for x in base
+            )
+    for name, adjs in touched.items():
+        src = by_name[name]
+        states[area].update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name=name,
+                adjacencies=adjs,
+                node_label=src.node_label,
+                area=area,
+            )
+        )
+
+
+def bench_config(name, gen, me, runs=5, flap_victims=0, cpu_baseline=True):
+    """Run one config; returns a result dict."""
     from openr_tpu.decision.spf_solver import SpfSolver
     from openr_tpu.decision.tpu_solver import TpuSpfSolver
     from openr_tpu.models import topologies
 
-    log(f"devices: {jax.devices()}")
     t0 = time.perf_counter()
-    adj_dbs, prefix_dbs = topologies.grid(grid_side)
+    adj_dbs, prefix_dbs = gen()
     states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    area = next(iter(states))
     n_nodes = len(adj_dbs)
+    n_links = len(states[area].all_links())
     log(
-        f"built grid {grid_side}x{grid_side}: {n_nodes} nodes, "
-        f"{len(states['0'].all_links())} links, {len(prefix_dbs)} prefixes "
-        f"({time.perf_counter() - t0:.1f}s)"
+        f"[{name}] {n_nodes} nodes, {n_links} links "
+        f"({time.perf_counter() - t0:.1f}s build)"
     )
-    me = f"node-{grid_side // 2}-{grid_side // 2}"
 
-    # -- CPU oracle baseline ------------------------------------------------
-    cpu = SpfSolver(me)
-    t0 = time.perf_counter()
-    cpu_db = cpu.build_route_db(me, states, ps)
-    cpu_ms = (time.perf_counter() - t0) * 1e3
-    log(f"cpu oracle full build: {cpu_ms:.1f} ms, {len(cpu_db.unicast_routes)} routes")
+    res = {"nodes": n_nodes, "links": n_links, "prefixes": len(prefix_dbs)}
 
-    # -- TPU pipeline -------------------------------------------------------
+    cpu_ms = None
+    if cpu_baseline:
+        cpu = SpfSolver(me)
+        t0 = time.perf_counter()
+        cpu_db = cpu.build_route_db(me, states, ps)
+        cpu_ms = (time.perf_counter() - t0) * 1e3
+        res["cpu_ms"] = round(cpu_ms, 1)
+        log(f"[{name}] cpu oracle: {cpu_ms:.1f} ms, {len(cpu_db.unicast_routes)} routes")
+
     tpu = TpuSpfSolver(me)
     t0 = time.perf_counter()
-    tpu_db = tpu.build_route_db(me, states, ps)  # compile + first run
-    log(f"tpu first build (compile): {(time.perf_counter() - t0) * 1e3:.1f} ms")
-    assert tpu_db.unicast_routes == cpu_db.unicast_routes, "RIB mismatch vs oracle"
+    tpu_db = tpu.build_route_db(me, states, ps)
+    res["compile_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+    log(f"[{name}] tpu first build (compile): {res['compile_ms']:.0f} ms; "
+        f"plan: {tpu.last_device_stats}")
+    if cpu_baseline:
+        assert tpu_db.unicast_routes == cpu_db.unicast_routes, (
+            f"[{name}] RIB mismatch vs oracle"
+        )
+        log(f"[{name}] parity vs CPU oracle OK")
 
-    samples = []
-    runs = 3 if quick else 5
-    for _ in range(runs):
-        # force recompute: the mirror cache keys on LinkState generation,
-        # so bump it to simulate a post-churn full rebuild
-        states["0"].generation += 1
+    # cold full rebuild, jit warm: fresh solver state -> plan build + full
+    # device pull + full host materialization (what a restarting daemon
+    # pays once)
+    tpu2 = TpuSpfSolver(me)
+    t0 = time.perf_counter()
+    tpu2.build_route_db(me, states, ps)
+    res["full_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+    tm = getattr(tpu2, "last_timing", {})
+    res["full_breakdown"] = {k: round(v, 1) for k, v in tm.items()}
+    log(f"[{name}] tpu cold full rebuild (warm jit): {res['full_ms']:.0f} ms "
+        f"{res['full_breakdown']}")
+    del tpu2
+
+    # steady-state full recompute through real churn (changelog path)
+    victims = list(range(1, (flap_victims or 1) + 1))
+    samples, mat, ex, sy = [], [], [], []
+    for i in range(runs):
+        _flap(states, adj_dbs, victims, i, area)
         t0 = time.perf_counter()
         tpu.build_route_db(me, states, ps)
         samples.append((time.perf_counter() - t0) * 1e3)
+        tm = getattr(tpu, "last_timing", {})
+        sy.append(tm.get("sync_ms", 0))
+        ex.append(tm.get("exec_ms", 0))
+        mat.append(tm.get("mat_ms", 0))
     tpu_ms = statistics.median(samples)
-    log(f"tpu full recompute samples (ms): {[f'{s:.1f}' for s in samples]}")
+    res["tpu_ms"] = round(tpu_ms, 1)
+    res["sync_ms"] = round(statistics.median(sy), 1)
+    res["exec_ms"] = round(statistics.median(ex), 1)
+    res["mat_ms"] = round(statistics.median(mat), 1)
+    res["changed_rows"] = tpu.last_device_stats.get("changed_rows")
+    if cpu_ms:
+        res["speedup"] = round(cpu_ms / tpu_ms, 2)
+    log(f"[{name}] tpu recompute: {[f'{s:.0f}' for s in samples]} ms "
+        f"(sync {res['sync_ms']} / exec {res['exec_ms']} / mat {res['mat_ms']})")
+    return res, tpu_ms, cpu_ms
 
-    # device-only portion (mirror warm, arrays resident): re-run pipeline
-    states["0"].generation += 1
-    tpu.mirror(states["0"])  # refresh mirror outside the timer
+
+def main() -> None:
+    quick = "--quick" in sys.argv
+    only = None
+    for a in sys.argv[1:]:
+        if a.startswith("--only="):
+            only = a.split("=", 1)[1]
+
+    import jax
+    import numpy as np
+
+    from openr_tpu.models import topologies
+
+    log(f"devices: {jax.devices()}")
+    # measure the rig's fixed device round trip (a pull of 8 bytes):
+    # everything below pays it once per recompute
+    x = jax.device_put(np.zeros(2, np.int32))
+    f = jax.jit(lambda a: a + 1)
+    np.asarray(f(x))
     t0 = time.perf_counter()
-    tpu.build_route_db(me, states, ps)
-    warm_ms = (time.perf_counter() - t0) * 1e3
-    log(f"tpu recompute w/ warm mirror: {warm_ms:.1f} ms")
+    np.asarray(f(x))
+    rtt_ms = (time.perf_counter() - t0) * 1e3
+    log(f"rig fixed round-trip: {rtt_ms:.1f} ms")
 
-    # incremental churn: flap one link's metric (the steady-state path —
-    # prefix matrix + partition caches stay warm, mirror rebuilds)
-    from openr_tpu.types import Adjacency, AdjacencyDatabase
+    configs = {}
+    headline = None
 
-    victim = adj_dbs[1]
-    flap_samples = []
-    for i in range(runs):
-        new_adjs = tuple(
-            Adjacency(**{**a.__dict__, "metric": 2 + i})
-            for a in victim.adjacencies
-        )
-        t0 = time.perf_counter()
-        states["0"].update_adjacency_database(
-            AdjacencyDatabase(
-                this_node_name=victim.this_node_name,
-                adjacencies=new_adjs,
-                node_label=victim.node_label,
-                area="0",
-            )
-        )
-        tpu.build_route_db(me, states, ps)
-        flap_samples.append((time.perf_counter() - t0) * 1e3)
-    log(
-        "tpu link-flap recompute samples (ms): "
-        f"{[f'{s:.1f}' for s in flap_samples]}"
+    def run(name, *args, **kw):
+        if only and name != only:
+            return None
+        r, tpu_ms, cpu_ms = bench_config(name, *args, **kw)
+        configs[name] = r
+        return r, tpu_ms, cpu_ms
+
+    # 1: 4-node mesh — CPU parity baseline (example_openr.conf scale)
+    run("mesh4", lambda: topologies.full_mesh(4), "node-0", runs=3)
+
+    # 2: 1k-node Terragraph-style mesh (street-lattice grid)
+    run("tg1k", lambda: topologies.grid(32, node_labels=False), "node-16-16")
+
+    if quick:
+        out = configs.get("tg1k") or next(iter(configs.values()))
+        print(json.dumps({
+            "metric": "full_rib_recompute_1k_ms",
+            "value": out["tpu_ms"],
+            "unit": "ms",
+            "vs_baseline": out.get("speedup", 1.0),
+            "rig_rtt_ms": round(rtt_ms, 1),
+            "configs": configs,
+        }))
+        return
+
+    # 3: 10k-node fat-tree fabric, ECMP
+    run(
+        "fabric10k",
+        lambda: topologies.fabric(pods=96, planes=8, ssws_per_plane=36,
+                                  rsws_per_pod=64),
+        "pod000-rsw00",
     )
 
-    print(
-        json.dumps(
-            {
-                "metric": f"full_rib_recompute_grid{n_nodes}_ms",
-                "value": round(tpu_ms, 2),
-                "unit": "ms",
-                "vs_baseline": round(cpu_ms / tpu_ms, 2),
-            }
-        )
+    # 4: 50k-node WAN (KSP2 segment-routing subset pending device KSP2)
+    run(
+        "wan50k",
+        lambda: topologies.wan(regions=48, region_side=32),
+        "r00-n08-08",
     )
+
+    # 5: 100k-node synthetic LSDB (grid, 400k directed adjacencies) +
+    #    1k-link flap burst
+    r5 = run(
+        "lsdb100k",
+        lambda: topologies.grid(316, node_labels=False),
+        "node-158-158",
+        runs=3,
+        flap_victims=250,  # 250 nodes x ~4 links = ~1k directed flaps
+    )
+    if r5 is not None:
+        headline = ("full_rib_recompute_100k_ms", r5[1], r5[2])
+
+    if headline is None:
+        last = list(configs)[-1]
+        headline = (
+            f"full_rib_recompute_{last}_ms",
+            configs[last]["tpu_ms"],
+            configs[last].get("cpu_ms"),
+        )
+    metric, tpu_ms, cpu_ms = headline
+    print(json.dumps({
+        "metric": metric,
+        "value": round(tpu_ms, 2),
+        "unit": "ms",
+        "vs_baseline": round((cpu_ms or tpu_ms) / tpu_ms, 2),
+        "rig_rtt_ms": round(rtt_ms, 1),
+        "configs": configs,
+    }))
 
 
 if __name__ == "__main__":

@@ -25,6 +25,7 @@ functions of the same LSDB and are differentially tested against each other.
 from __future__ import annotations
 
 import heapq
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Generic, Iterable, Optional, TypeVar
 
@@ -292,8 +293,32 @@ class LinkState:
         self._spf_results: dict[tuple[str, bool], SpfResult] = {}
         self._kth_paths: dict[tuple[str, str, int], list[Path]] = {}
         # Monotonic change counter: bumps on any applied change so derived
-        # mirrors (ops/csr.py device arrays) know when to refresh.
+        # mirrors (ops/ device arrays) know when to refresh.
         self.generation = 0
+        # Bounded changelog of (generation, event) consumed by device
+        # mirrors to apply LinkStateChange as index writes instead of full
+        # rebuilds (SURVEY §5 "delta scatter updates"). Events:
+        #   ("links", [Link...])   metric/overload changed on existing links
+        #   ("added", [Link...])   new bidirectional links
+        #   ("removed", [Link...]) links torn down
+        #   ("overload", node)     node-level transit drain toggled
+        #   ("nodes",)             node set changed — mirrors must rebuild
+        self._changelog: deque[tuple[int, tuple]] = deque(maxlen=4096)
+        # history is complete for generations > _changelog_start_gen; a
+        # consumer synced at gen <= start must full-rebuild
+        self._changelog_start_gen = 0
+
+    def _log_event(self, event: tuple) -> None:
+        if len(self._changelog) == self._changelog.maxlen:
+            self._changelog_start_gen = self._changelog[0][0]
+        self._changelog.append((self.generation + 1, event))
+
+    def events_since(self, generation: int) -> Optional[list[tuple]]:
+        """Events after `generation`, or None when history is incomplete
+        (consumer fell behind the bounded log — full rebuild required)."""
+        if generation < self._changelog_start_gen:
+            return None
+        return [ev for gen, ev in self._changelog if gen > generation]
 
     # -- introspection ------------------------------------------------------
 
@@ -393,10 +418,17 @@ class LinkState:
         old_links = self.ordered_links_from_node(node)
         self._adj_dbs[node] = new_db
         new_links = self._ordered_link_set(new_db)
+        ev_changed: list[Link] = []
+        ev_removed: list[Link] = []
 
-        change.topology_changed |= self._update_node_overloaded(
+        overload_flip = self._update_node_overloaded(
             node, new_db.is_overloaded, hold_up_ttl, hold_down_ttl
         )
+        if overload_flip:
+            self._log_event(("overload", node))
+        change.topology_changed |= overload_flip
+        if prior_db is None:
+            self._log_event(("nodes",))
         change.node_label_changed = (
             prior_db is None and new_db.node_label != 0
         ) or (prior_db is not None and prior_db.node_label != new_db.node_label)
@@ -427,27 +459,41 @@ class LinkState:
                 ol = old_links[j]
                 change.topology_changed |= ol.is_up()
                 self._remove_link(ol)
+                ev_removed.append(ol)
                 j += 1
                 continue
             # same link: diff directional attributes from `node`'s side
             nl, ol = new_links[i], old_links[j]
+            link_touched = False
             if nl.metric_from_node(node) != ol.metric_from_node(node):
-                change.topology_changed |= ol.set_metric_from_node(
+                eff = ol.set_metric_from_node(
                     node, nl.metric_from_node(node), hold_up_ttl, hold_down_ttl
                 )
+                change.topology_changed |= eff
+                link_touched |= eff
             if nl.overload_from_node(node) != ol.overload_from_node(node):
-                change.topology_changed |= ol.set_overload_from_node(
+                eff = ol.set_overload_from_node(
                     node, nl.overload_from_node(node), hold_up_ttl, hold_down_ttl
                 )
+                change.topology_changed |= eff
+                link_touched |= eff
             if nl.adj_label_from_node(node) != ol.adj_label_from_node(node):
                 change.link_attributes_changed = True
                 ol.set_adj_label_from_node(node, nl.adj_label_from_node(node))
             if nl.weight_from_node(node) != ol.weight_from_node(node):
                 change.link_attributes_changed = True
                 ol.set_weight_from_node(node, nl.weight_from_node(node))
+            if link_touched:
+                ev_changed.append(ol)
             i += 1
             j += 1
 
+        if change.added_links:
+            self._log_event(("added", list(change.added_links)))
+        if ev_removed:
+            self._log_event(("removed", ev_removed))
+        if ev_changed:
+            self._log_event(("links", ev_changed))
         if change.topology_changed:
             self._spf_results.clear()
             self._kth_paths.clear()
@@ -461,6 +507,7 @@ class LinkState:
         """ref LinkState.cpp:758-775."""
         change = LinkStateChange()
         if node in self._adj_dbs:
+            self._log_event(("nodes",))
             self._remove_node(node)
             del self._adj_dbs[node]
             self._spf_results.clear()
@@ -471,10 +518,17 @@ class LinkState:
 
     def decrement_holds(self) -> LinkStateChange:
         change = LinkStateChange()
+        hold_changed: list[Link] = []
         for link in self._all_links:
-            change.topology_changed |= link.decrement_holds()
-        for hv in self._node_overloads.values():
-            change.topology_changed |= hv.decrement_ttl()
+            if link.decrement_holds():
+                change.topology_changed = True
+                hold_changed.append(link)
+        for node, hv in self._node_overloads.items():
+            if hv.decrement_ttl():
+                change.topology_changed = True
+                self._log_event(("overload", node))
+        if hold_changed:
+            self._log_event(("links", hold_changed))
         if change.topology_changed:
             self._spf_results.clear()
             self._kth_paths.clear()

@@ -2,33 +2,42 @@
 
 Replaces the reference's per-root memoized Dijkstra + per-prefix scalar
 loops (openr/decision/LinkState.cpp:836-911 runSpf + SpfSolver.cpp:460-646
-buildRouteDb) with one fused, jit-compiled pipeline over the ops/csr.py
-array mirror:
+buildRouteDb) with one fused, jit-compiled pipeline over the shift-
+decomposed graph mirror (ops/edgeplan.py):
 
-  1. SSSP: frontier-synchronous Bellman-Ford as a fixpoint of
-         dist'[v] = min(dist[v], min_k dist[in_nbr[v,k]] + in_w[v,k])
-     under lax.while_loop — dense [N_cap, K_cap] gather + min-reduce,
-     no scatter, static shapes. Overloaded-node transit drain is the same
-     mask the reference applies in its relax step (root exempt).
-  2. First-hop ("next hop") extraction: boolean fixpoint over the shortest-
-     path DAG seeded at the root's out-edge slots — matches runSpf's ECMP
-     `>=` accumulation (dist[u]+w == dist[v] predicate,
-     LinkState.cpp:885-901).
-  3. Best-route selection: vectorized lexicographic selection over the
-     prefix x announcer matrix in the reference's order (path_preference
-     desc, source_preference desc, advertised distance asc —
-     LsdbUtil.cpp:842), drained-announcer filter with all-drained
-     fallback (SpfSolver.cpp:709-731), then min-IGP-metric announcer set
-     and the union of their first-hop masks.
+  1. Batched SSSP from the root's D out-slot neighbors in G-minus-root:
+     frontier-synchronous Bellman-Ford where each relaxation is a sum of
+     **shift-class contributions** `roll(dist + w_class, delta)` (VPU-
+     vectorized; no gather for shift-decomposable edges) plus a residual
+     ELL gather for irregular edges. Root-as-transit exclusion is ONE
+     on-device column mask, so the resident graph arrays serve every
+     vantage (any-vantage ctrl queries reuse them).
+  2. Via-distances give true distances and first-hop slots in one shot:
+     via[d,v] = root_w[d] + dist_d[v]; slot d is on a shortest path to v
+     iff via[d,v] == min_d via[d,v] — the same ECMP predicate as runSpf's
+     `>=` accumulation (LinkState.cpp:885-901) without a second fixpoint.
+  3. Vectorized best-route selection over the prefix x announcer matrix
+     in the reference's order (path_preference desc, source_preference
+     desc, advertised distance asc — LsdbUtil.cpp:842), drained-announcer
+     filter with all-drained fallback (SpfSolver.cpp:709-731), min-IGP
+     announcer set, union of their first-hop masks.
+  4. **On-device output delta**: results (metric / selected-announcer
+     bits / next-hop-slot bits, 16-bit word-packed) are compared on
+     device against the previous run's resident outputs; only changed
+     rows ship to the host (fixed delta budget, full pull fallback).
+     Steady-state link flaps therefore cost O(changed routes) in host
+     transfer + materialization — the TPU-idiomatic "incremental SPF":
+     recompute everything fast on device, ship and materialize only the
+     delta (ref incremental path: openr/decision/Decision.cpp:919-996).
 
-The memoize-per-root-on-demand strategy is deliberately replaced by
-compute-everything-batched: one TPU launch produces the full RIB's
-next-hop structure; roots batch via vmap for whole-fabric computation.
+Graph updates ride LinkState's changelog as device scatter writes
+(ops/edgeplan.py apply_events / drain_dirty) — a metric flap is a
+handful of int32 stores, not a mirror rebuild.
 
-Scope (round 2): single-area LSDBs with IP/SP_ECMP prefixes run on
-device; KSP2 / UCMP / SR_MPLS / prepend-label prefixes and multi-area
-LSDBs fall back to the CPU oracle (decision/spf_solver.py) per prefix —
-behavior is identical by construction and enforced by differential tests
+Scope: single-area LSDBs with IP/SP_ECMP prefixes run on device; KSP2 /
+UCMP / SR_MPLS / prepend-label prefixes and multi-area LSDBs fall back
+to the CPU oracle (decision/spf_solver.py) per prefix — behavior is
+identical by construction and enforced by differential tests
 (tests/test_tpu_solver.py). MPLS label routes are host-built (they are
 O(adjacent links), not hot).
 """
@@ -48,43 +57,47 @@ from openr_tpu.ops.csr import (
     INF32,
     EllGraph,
     PrefixMatrix,
-    build_ell,
     build_prefix_matrix,
+)
+from openr_tpu.ops.edgeplan import (
+    INF32E,
+    EdgePlan,
+    drain_dirty,
+    sync_plan,
 )
 from openr_tpu.types import (
     PrefixForwardingAlgorithm,
     PrefixForwardingType,
-    parse_prefix,
 )
 
 INF = int(INF32)
+INF_E = int(INF32E)
 _NEG = -(2**31)
 
+# rows shipped per delta pull; bursts changing more fall back to a full
+# pull (one extra round trip, still a single buffer)
+_DELTA_BUDGET = 4096
 
-# ---------------------------------------------------------------------------
-# jitted kernels (pure functions of arrays; shapes static per capacity class)
-# ---------------------------------------------------------------------------
-
-# relaxation steps fused per while_loop iteration: each on-device loop trip
-# has fixed dispatch overhead, and a single [N_cap, K_cap] relax is tiny —
-# fusing amortizes the trip cost over UNROLL steps (extra steps past the
-# fixpoint are no-ops)
+# relaxation steps fused per while_loop trip (steps past the fixpoint are
+# no-ops; fusing amortizes per-trip dispatch)
 _UNROLL = 8
 
 
+# ---------------------------------------------------------------------------
+# legacy single-graph kernels (driver entry / sharding / whole-fabric path)
+# ---------------------------------------------------------------------------
+
 def _sssp_kernel(in_nbr, in_w, in_up, node_over, root):
-    """dist[v] fixpoint; int32 [N_cap]."""
+    """dist[v] fixpoint over the padded in-neighbor mirror; int32 [N_cap]."""
     import jax
     import jax.numpy as jnp
 
     n = in_nbr.shape[0]
     dist0 = jnp.full((n,), INF, jnp.int32).at[root].set(0)
-    # a source node may relax its out-edges iff it is the root or not
-    # overloaded (transit drain, ref LinkState.cpp:858-866)
     usable = in_up & (in_nbr >= 0) & ((in_nbr == root) | ~node_over[in_nbr])
 
     def relax(dist):
-        nbr_dist = dist[in_nbr]  # [N, K] gather
+        nbr_dist = dist[in_nbr]
         cand = jnp.where(
             usable & (nbr_dist < INF), nbr_dist + in_w, INF
         ).min(axis=1)
@@ -102,21 +115,16 @@ def _sssp_kernel(in_nbr, in_w, in_up, node_over, root):
 
 
 def _next_hop_kernel(in_nbr, in_w, in_up, node_over, root, dist, root_nbr, root_w, root_up):
-    """First-hop slot masks nh[v, d]: root's out-edge slot d lies on a
-    shortest path to v. bool [N_cap, D_cap]."""
+    """First-hop slot masks nh[v, d] over the shortest-path DAG."""
     import jax
     import jax.numpy as jnp
 
     n, _ = in_nbr.shape
     d_cap = root_nbr.shape[0]
-    # seed: slot d reaches its neighbor iff that direct edge achieves the
-    # neighbor's shortest distance (ref: direct neighbor adds itself)
     slot_ok = (root_nbr >= 0) & root_up & (dist[jnp.clip(root_nbr, 0, n - 1)] == root_w)
     seed = jnp.zeros((n, d_cap), bool).at[
         jnp.where(root_nbr >= 0, root_nbr, n), jnp.arange(d_cap)
     ].set(slot_ok, mode="drop")
-    # propagate over shortest-path in-edges from non-root, non-overloaded
-    # parents (root's contribution is exactly the seed)
     ok_parent = (
         in_up
         & (in_nbr >= 0)
@@ -142,11 +150,8 @@ def _next_hop_kernel(in_nbr, in_w, in_up, node_over, root, dist, root_nbr, root_
 
 
 def _select_metric_kernel(dist, node_over, ann_node, ann_valid, path_pref, source_pref, dist_adv):
-    """Vectorized per-prefix best-route selection (no next-hop union):
-    returns (igp_metric[P], s3[P,A] post-drain selected set, s4[P,A]
-    min-IGP subset, idx clipped announcer indices). Shared by the
-    single-chip pipeline and the sharded step so the selection semantics
-    (incl. the all-drained fallback, SpfSolver.cpp:709-731) exist once."""
+    """Vectorized per-prefix best-route selection (no next-hop union);
+    shared with the sharded step so the selection semantics exist once."""
     import jax.numpy as jnp
 
     n = dist.shape[0]
@@ -159,7 +164,6 @@ def _select_metric_kernel(dist, node_over, ann_node, ann_valid, path_pref, sourc
     s = s & (sp == sp.max(axis=1, keepdims=True))
     da = jnp.where(s, dist_adv, INF)
     s2 = s & (da == da.min(axis=1, keepdims=True))
-    # drained-announcer filter; keep unfiltered when all drained
     nd = s2 & ~node_over[idx]
     s3 = jnp.where(nd.any(axis=1, keepdims=True), nd, s2)
     igp = jnp.where(s3, ann_dist, INF)
@@ -169,10 +173,7 @@ def _select_metric_kernel(dist, node_over, ann_node, ann_valid, path_pref, sourc
 
 
 def _select_kernel(dist, nh, node_over, ann_node, ann_valid, path_pref, source_pref, dist_adv):
-    """Selection + next-hop union.
-
-    Returns (igp_metric[P], selected[P,A] (post-drain set S3),
-    nh_mask[P,D], has_route[P])."""
+    """Selection + next-hop union."""
     import jax.numpy as jnp
 
     metric, s3, s4, idx = _select_metric_kernel(
@@ -185,8 +186,6 @@ def _select_kernel(dist, nh, node_over, ann_node, ann_valid, path_pref, source_p
 
 @functools.lru_cache(maxsize=None)
 def _jitted_pipeline():
-    """Build the fused jit once (lazy so importing this module doesn't pull
-    in jax)."""
     import jax
 
     def pipeline(
@@ -206,199 +205,8 @@ def _jitted_pipeline():
     return jax.jit(pipeline)
 
 
-def pack_graph_inputs(
-    in_nbr, in_w, in_up, node_over, root_idx, root_nbr, root_w, root_up
-) -> np.ndarray:
-    """Graph-side device buffer for one vantage point, with every usability
-    rule folded into an effective weight on the HOST (the device link is
-    bandwidth-bound; fewer arrays = fewer bytes):
-
-      w_eff[v,k] = metric of edge u->v, or INF32 when the slot is padding,
-                   the link is down, u is the root (the root cannot be
-                   transit for its own routes), or u is overloaded
-                   (transit drain, ref LinkState.cpp:858-866)
-      root_w[d]  = root's out-slot metric, or INF32 when invalid/down
-                   (an overloaded NEIGHBOR keeps its slot: it is a valid
-                   destination/first hop, just not transit — its own
-                   out-edges are INF via w_eff)
-
-    Layout (int32): in_nbr [N*K] | w_eff [N*K] | root | root_nbr [D] |
-    root_w_eff [D].
-    """
-    src_ok = in_nbr >= 0
-    clipped = np.clip(in_nbr, 0, None)
-    usable = (
-        in_up
-        & src_ok
-        & (in_nbr != root_idx)
-        & ~node_over[clipped]
-    )
-    w_eff = np.where(usable, in_w, INF32).astype(np.int32)
-    rw_eff = np.where((root_nbr >= 0) & root_up, root_w, INF32).astype(np.int32)
-    return np.concatenate(
-        [
-            in_nbr.ravel(),
-            w_eff.ravel(),
-            np.array([root_idx], np.int32),
-            root_nbr,
-            rw_eff,
-        ]
-    ).astype(np.int32, copy=False)
-
-
-def pack_matrix_inputs(matrix, node_over) -> np.ndarray:
-    """Announcer-matrix device buffer; validity and per-announcer drain
-    fold into flag bits host-side.
-
-    Layout (int32): ann_node | ann_flags (bit0 valid, bit1 overloaded) |
-    path_pref | source_pref | dist_adv, each [P*A]."""
-    idx = np.clip(matrix.ann_node, 0, None)
-    flags = matrix.ann_valid.astype(np.int32) | (
-        node_over[idx].astype(np.int32) << 1
-    )
-    return np.concatenate(
-        [
-            matrix.ann_node.ravel(),
-            flags.ravel(),
-            matrix.path_pref.ravel(),
-            matrix.source_pref.ravel(),
-            matrix.dist_adv.ravel(),
-        ]
-    ).astype(np.int32, copy=False)
-
-
-def _sssp_multi_kernel(in_nbr, w_eff, seeds):
-    """Batched SSSP from D seed nodes over host-folded weights:
-    dist_d[v] fixpoint, int32 [D, N]. Invalid seeds (-1) yield all-INF."""
-    import jax
-    import jax.numpy as jnp
-
-    n = in_nbr.shape[0]
-    d = seeds.shape[0]
-    valid = seeds >= 0
-    seed_idx = jnp.clip(seeds, 0, n - 1)
-    dist0 = jnp.full((d, n), INF, jnp.int32)
-    dist0 = dist0.at[jnp.arange(d), seed_idx].min(
-        jnp.where(valid, 0, INF).astype(jnp.int32)
-    )
-    gather_ok = in_nbr >= 0
-    nbr = jnp.clip(in_nbr, 0, n - 1)
-
-    def relax(dist):
-        # dist [D, N] -> gather [D, N, K]
-        nbr_dist = dist[:, nbr]
-        cand = jnp.where(
-            gather_ok[None] & (nbr_dist < INF), nbr_dist + w_eff[None], INF
-        ).min(axis=2)
-        return jnp.minimum(dist, cand)
-
-    def body(state):
-        dist, _ = state
-        new = dist
-        for _ in range(_UNROLL):
-            new = relax(new)
-        return new, jnp.any(new != dist)
-
-    dist, _ = jax.lax.while_loop(
-        lambda s: s[1], body, (dist0, jnp.bool_(True))
-    )
-    return dist
-
-
-@functools.lru_cache(maxsize=None)
-def _jitted_packed_pipeline(n_cap: int, k_cap: int, d_cap: int, p_cap: int, a_cap: int):
-    """Packed-I/O pipeline: graph buffer + matrix buffer in, ONE int8
-    buffer out (metric bitcast to bytes).
-
-    Next hops come from a single batched SSSP from the root's D out-slot
-    neighbors in G-minus-root: via[d,v] = root_w[d] + dist_d[v], the true
-    distance is their min (root pinned to 0), and slot d lies on a
-    shortest path to v iff via[d,v] == dist[v] — the same predicate as
-    runSpf's ECMP accumulation (LinkState.cpp:885-901) without a second
-    fixpoint."""
-    import jax
-    import jax.numpy as jnp
-
-    nk = n_cap * k_cap
-    pa = p_cap * a_cap
-
-    def pipeline(gbuf, mbuf):
-        o = 0
-        in_nbr = gbuf[o : o + nk].reshape(n_cap, k_cap); o += nk
-        w_eff = gbuf[o : o + nk].reshape(n_cap, k_cap); o += nk
-        root = gbuf[o]; o += 1
-        root_nbr = gbuf[o : o + d_cap]; o += d_cap
-        root_w = gbuf[o : o + d_cap]; o += d_cap
-        o = 0
-        ann_node = mbuf[o : o + pa].reshape(p_cap, a_cap); o += pa
-        ann_flags = mbuf[o : o + pa].reshape(p_cap, a_cap); o += pa
-        path_pref = mbuf[o : o + pa].reshape(p_cap, a_cap); o += pa
-        source_pref = mbuf[o : o + pa].reshape(p_cap, a_cap); o += pa
-        dist_adv = mbuf[o : o + pa].reshape(p_cap, a_cap); o += pa
-        ann_valid = (ann_flags & 1).astype(bool)
-        ann_over = (ann_flags & 2).astype(bool)
-
-        seeds = jnp.where(root_w < INF, root_nbr, -1)
-        dist_d = _sssp_multi_kernel(in_nbr, w_eff, seeds)  # [D, N]
-        via = jnp.where(
-            (root_w[:, None] < INF) & (dist_d < INF),
-            root_w[:, None] + dist_d,
-            INF,
-        )  # [D, N]
-        dist = via.min(axis=0).at[root].set(0)  # [N]
-
-        # selection (ref _select_metric_kernel semantics, drain via flags)
-        idx = jnp.clip(ann_node, 0, n_cap - 1)
-        ann_dist = dist[idx]
-        reach = ann_valid & (ann_dist < INF)
-        pp = jnp.where(reach, path_pref, _NEG)
-        s = reach & (pp == pp.max(axis=1, keepdims=True))
-        sp = jnp.where(s, source_pref, _NEG)
-        s = s & (sp == sp.max(axis=1, keepdims=True))
-        da = jnp.where(s, dist_adv, INF)
-        s2 = s & (da == da.min(axis=1, keepdims=True))
-        nd = s2 & ~ann_over
-        s3 = jnp.where(nd.any(axis=1, keepdims=True), nd, s2)
-        igp = jnp.where(s3, ann_dist, INF)
-        metric = igp.min(axis=1)
-        s4 = s3 & (igp == metric[:, None])
-
-        # per-prefix next-hop slots: union over min-IGP announcers of the
-        # slots achieving their shortest distance
-        on_sp = via.T == dist[:, None]  # [N, D]
-        nh_mask = jnp.any(s4[:, :, None] & on_sp[idx], axis=1)  # [P, D]
-        has_route = s3.any(axis=1) & (metric < INF)
-
-        out8 = jnp.concatenate(
-            [
-                jax.lax.bitcast_convert_type(metric, jnp.int8).ravel(),
-                s3.astype(jnp.int8).ravel(),
-                nh_mask.astype(jnp.int8).ravel(),
-                has_route.astype(jnp.int8),
-            ]
-        )
-        return out8
-
-    jitted = jax.jit(pipeline)
-
-    def run(gbuf, mbuf):
-        out = np.asarray(jitted(gbuf, mbuf))  # exec + single small pull
-        o = 0
-        metric = out[o : o + 4 * p_cap].view(np.int32); o += 4 * p_cap
-        s3 = out[o : o + pa].reshape(p_cap, a_cap).astype(bool); o += pa
-        nh_mask = (
-            out[o : o + p_cap * d_cap].reshape(p_cap, d_cap).astype(bool)
-        )
-        o += p_cap * d_cap
-        has_route = out[o : o + p_cap].astype(bool)
-        return metric, s3, nh_mask, has_route
-
-    return run
-
-
 @functools.lru_cache(maxsize=None)
 def _jitted_sssp_batch():
-    """vmapped multi-root SSSP (whole-fabric / benchmark path)."""
     import jax
 
     return jax.jit(
@@ -426,8 +234,234 @@ def sssp_all_pairs(graph: EllGraph, roots: Optional[np.ndarray] = None):
 
 
 # ---------------------------------------------------------------------------
-# solver
+# plan pipeline (the production path)
 # ---------------------------------------------------------------------------
+
+def _pack_words(bits):
+    """bool [P, X] -> int32 [P, ceil(X/16)], 16 bits per word."""
+    import jax.numpy as jnp
+
+    p, x = bits.shape
+    w = -(-x // 16)
+    pad = w * 16 - x
+    if pad:
+        bits = jnp.pad(bits, ((0, 0), (0, pad)))
+    weights = (1 << jnp.arange(16, dtype=jnp.int32))
+    return (bits.reshape(p, w, 16).astype(jnp.int32) * weights).sum(axis=2)
+
+
+def unpack_words(words: np.ndarray, x: int) -> np.ndarray:
+    """host inverse of _pack_words: int32 [R, W] -> bool [R, x]."""
+    r, wn = words.shape
+    bits = (words[:, :, None] >> np.arange(16)) & 1
+    return bits.reshape(r, wn * 16)[:, :x].astype(bool)
+
+
+def _plan_sssp(deltas, shift_w, res_rows, res_nbr, res_w, root,
+               seeds_nbr, seeds_w,
+               s_cap: int, has_res: bool, n_cap: int, d_cap: int,
+               max_trips: int):
+    """Batched SSSP [D, N] from seed nodes in G-minus-root over the
+    shift-decomposed mirror. INF discipline: INF32E = 2^29, weights
+    <= 2^28, so `dist + w` is overflow-free and needs no masks. The
+    residual gather is row-compact: it touches only destinations with
+    irregular in-edges and scatter-mins them back."""
+    import jax
+    import jax.numpy as jnp
+
+    sw = shift_w.at[:, root].set(INF_E)
+    if has_res:
+        rw = jnp.where(res_nbr == root, INF_E, res_w)
+        nbr_c = jnp.clip(res_nbr, 0, n_cap - 1)
+        rows_c = jnp.clip(res_rows, 0, n_cap - 1)
+    valid = seeds_w < INF_E
+    seed_idx = jnp.clip(seeds_nbr, 0, n_cap - 1)
+    dist0 = jnp.full((d_cap, n_cap), INF_E, jnp.int32)
+    dist0 = dist0.at[jnp.arange(d_cap), seed_idx].min(
+        jnp.where(valid, 0, INF_E).astype(jnp.int32)
+    )
+
+    def relax(dist):
+        def cls(k, acc):
+            return jnp.minimum(
+                acc, jnp.roll(dist + sw[k][None, :], deltas[k], axis=1)
+            )
+        acc = jax.lax.fori_loop(0, s_cap, cls, dist)
+        if has_res:
+            nd = dist[:, nbr_c]  # [D, R, K] gather (R = residual rows)
+            cand = (nd + rw[None]).min(axis=2)  # [D, R]
+            # pad rows (res_rows == -1) carry all-INF weights -> no-ops
+            acc = acc.at[:, rows_c].min(cand)
+        return jnp.minimum(acc, dist)
+
+    def body(state):
+        dist, _, t = state
+        new = dist
+        for _ in range(_UNROLL):
+            new = relax(new)
+        return new, jnp.any(new != dist), t + 1
+
+    def cond(state):
+        return state[1] & (state[2] < max_trips)
+
+    dist, _, trips = jax.lax.while_loop(
+        cond, body, (dist0, jnp.bool_(True), jnp.int32(0))
+    )
+    return dist, trips
+
+
+@functools.lru_cache(maxsize=None)
+def _plan_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
+                   has_res: bool,
+                   d_cap: int, p_cap: int, a_cap: int, budget: int):
+    """The fused production pipeline. Outputs:
+      delta_buf int32 [2 + B + B + B*wa + B*wd]: count, overflow?, idx,
+                metric, s3 words, nh words for up to B changed rows
+      full_buf  int32 [P * (1 + wa + wd)]: full packed outputs
+      metric, s3w, nhw: resident arrays (the next call's prev_*)
+    """
+    import jax
+    import jax.numpy as jnp
+
+    wa = -(-a_cap // 16)
+    wd = -(-d_cap // 16)
+    pa = p_cap * a_cap
+    max_trips = max(2, -(-n_cap // _UNROLL) + 2)
+
+    def pipeline(deltas, shift_w, res_rows, res_nbr, res_w, mbuf,
+                 root, root_nbr, root_w,
+                 prev_metric, prev_s3w, prev_nhw):
+        o = 0
+        ann_node = mbuf[o:o + pa].reshape(p_cap, a_cap); o += pa
+        ann_flags = mbuf[o:o + pa].reshape(p_cap, a_cap); o += pa
+        path_pref = mbuf[o:o + pa].reshape(p_cap, a_cap); o += pa
+        source_pref = mbuf[o:o + pa].reshape(p_cap, a_cap); o += pa
+        dist_adv = mbuf[o:o + pa].reshape(p_cap, a_cap); o += pa
+        ann_valid = (ann_flags & 1).astype(bool)
+        ann_over = (ann_flags & 2).astype(bool)
+
+        dist_d, trips = _plan_sssp(
+            deltas, shift_w, res_rows, res_nbr, res_w, root,
+            root_nbr, root_w,
+            s_cap, has_res, n_cap, d_cap, max_trips,
+        )  # [D, N]
+        via = root_w[:, None] + dist_d  # <= 2^30, overflow-free
+        dist = jnp.minimum(via.min(axis=0), INF_E).at[root].set(0)  # [N]
+
+        # selection (reference order; drain via flags)
+        idx = jnp.clip(ann_node, 0, n_cap - 1)
+        ann_dist = dist[idx]
+        reach = ann_valid & (ann_dist < INF_E)
+        pp = jnp.where(reach, path_pref, _NEG)
+        s = reach & (pp == pp.max(axis=1, keepdims=True))
+        sp = jnp.where(s, source_pref, _NEG)
+        s = s & (sp == sp.max(axis=1, keepdims=True))
+        da = jnp.where(s, dist_adv, INF_E)
+        s2 = s & (da == da.min(axis=1, keepdims=True))
+        nd = s2 & ~ann_over
+        s3 = jnp.where(nd.any(axis=1, keepdims=True), nd, s2)
+        igp = jnp.where(s3, ann_dist, INF_E)
+        metric = igp.min(axis=1)
+        s4 = s3 & (igp == metric[:, None])
+
+        on_sp = (via == dist[None, :]).T  # [N, D]
+        nh_mask = jnp.any(s4[:, :, None] & on_sp[idx], axis=1)  # [P, D]
+
+        s3w = _pack_words(s3)
+        nhw = _pack_words(nh_mask)
+
+        changed = (
+            (metric != prev_metric)
+            | jnp.any(s3w != prev_s3w, axis=1)
+            | jnp.any(nhw != prev_nhw, axis=1)
+        )
+        count = changed.sum().astype(jnp.int32)
+        cidx = jnp.nonzero(changed, size=budget, fill_value=p_cap)[0]
+        safe = jnp.clip(cidx, 0, p_cap - 1).astype(jnp.int32)
+        delta_buf = jnp.concatenate([
+            count[None],
+            trips[None].astype(jnp.int32),
+            cidx.astype(jnp.int32),
+            metric[safe],
+            s3w[safe].ravel(),
+            nhw[safe].ravel(),
+        ])
+        full_buf = jnp.concatenate([
+            metric, s3w.ravel(), nhw.ravel(), trips[None].astype(jnp.int32),
+        ])
+        return delta_buf, full_buf, metric, s3w, nhw
+
+    return jax.jit(pipeline)
+
+
+@functools.lru_cache(maxsize=None)
+def _scatter_jit():
+    import jax
+
+    def scatter(arr, idx, vals):
+        shape = arr.shape
+        return arr.ravel().at[idx].set(vals).reshape(shape)
+
+    return jax.jit(scatter)
+
+
+def _pack_matrix(matrix: PrefixMatrix, node_over: np.ndarray) -> tuple:
+    """(flags [P,A], mbuf int32 [5*P*A]) — validity and per-announcer
+    drain fold into flag bits host-side."""
+    idx = np.clip(matrix.ann_node, 0, None)
+    flags = matrix.ann_valid.astype(np.int32) | (
+        node_over[idx].astype(np.int32) << 1
+    )
+    mbuf = np.concatenate([
+        matrix.ann_node.ravel(),
+        flags.ravel(),
+        matrix.path_pref.ravel(),
+        matrix.source_pref.ravel(),
+        matrix.dist_adv.ravel(),
+    ]).astype(np.int32, copy=False)
+    return flags, mbuf
+
+
+class _AreaDev:
+    """Per-area resident device state: plan arrays + announcer matrix."""
+
+    __slots__ = (
+        "plan", "d_deltas", "d_shift_w", "d_res_rows", "d_res_nbr",
+        "d_res_w", "matrix_key", "matrix", "flags", "d_mbuf", "buf_version",
+    )
+
+    def __init__(self):
+        self.plan: Optional[EdgePlan] = None
+        self.d_deltas = self.d_shift_w = None
+        self.d_res_rows = self.d_res_nbr = self.d_res_w = None
+        self.matrix_key = None
+        self.matrix: Optional[PrefixMatrix] = None
+        self.flags: Optional[np.ndarray] = None
+        self.d_mbuf = None
+        # bumped whenever the matrix is rebuilt: row -> prefix mapping may
+        # change even at identical shapes, so every vantage's delta state
+        # (prev outputs + route cache) must reset against the new rows
+        self.matrix_version = 0
+
+
+class _VantageState:
+    """Per-(area, vantage) output state: resident prev outputs + host
+    route cache for delta materialization."""
+
+    __slots__ = (
+        "shape_key", "matrix_version", "prev", "routes", "nh_cache",
+        "links_tuple", "valid",
+    )
+
+    def __init__(self):
+        self.shape_key = None
+        self.matrix_version = -1
+        self.prev = None  # (metric, s3w, nhw) device handles
+        self.routes: dict[str, RibUnicastEntry] = {}
+        self.nh_cache: dict = {}
+        self.links_tuple: tuple = ()
+        self.valid = False
+
 
 def _fast_path_eligible(entries) -> bool:
     """Device fast path covers IP + SP_ECMP announcements without prepend
@@ -449,22 +483,18 @@ class TpuSpfSolver:
     def __init__(self, my_node_name: str, **solver_kwargs):
         self.my_node_name = my_node_name
         self.cpu = SpfSolver(my_node_name, **solver_kwargs)
-        self._mirrors: dict[str, tuple[int, EllGraph]] = {}
-        # host-side derived caches (root out-table, announcer matrix) and
-        # the resident packed device buffer per (area, vantage)
-        self._dev_graph: dict[tuple, tuple[int, tuple]] = {}
-        self._dev_matrix: dict[str, tuple] = {}
-        self._dev_buf: dict[tuple, tuple[np.ndarray, object]] = {}
-        # LRU over foreign vantages: any-vantage ctrl queries must not
-        # accumulate resident host+device buffers per queried node forever
+        self._area_dev: dict[str, _AreaDev] = {}
+        self._vstates: dict[tuple, _VantageState] = {}
         self._vantage_lru: list[tuple] = []
         self._partition = None  # (ps.generation, fast, slow)
-        # per-vantage {(slot bits, metric) -> frozenset[NextHop]} — scoped so
-        # one vantage's buffer churn cannot thrash another's hot path
-        self._nh_set_cache: dict[str, dict] = {}
         self.last_device_stats: dict = {}
+        # wall-time breakdown of the last fast-path solve (bench.py)
+        self.last_timing: dict = {}
+        # unrolled while_loop trips of the last device SSSP — a measured
+        # diameter bound the sharded fabric path reuses
+        self.last_trips: int = 0
 
-    # static-route passthroughs keep Decision actor backend-agnostic
+    # static-route passthroughs keep the Decision actor backend-agnostic
     def update_static_unicast_routes(self, to_update, to_delete) -> None:
         self.cpu.update_static_unicast_routes(to_update, to_delete)
 
@@ -476,8 +506,9 @@ class TpuSpfSolver:
     ):
         """Incremental per-prefix path (Decision's changed-prefix rebuild):
         single-prefix work has no batch to amortize a device launch over,
-        so it delegates to the CPU oracle. The resident SPF tensors keep
-        serving the full-rebuild path."""
+        so it delegates to the CPU oracle. Topology churn takes the full
+        device path, which is itself incremental end-to-end (on-device
+        output delta -> O(changed) host work)."""
         return self.cpu.create_route_for_prefix_or_get_static(
             my_node_name, area_link_states, prefix_state, prefix
         )
@@ -490,28 +521,20 @@ class TpuSpfSolver:
     def static_mpls_routes(self):
         return self.cpu.static_mpls_routes
 
+    # -- vantage cache management ------------------------------------------
+
     _MAX_FOREIGN_VANTAGES = 4
 
-    def _touch_foreign_vantage(self, gkey: tuple) -> None:
+    def _touch_foreign_vantage(self, vkey: tuple) -> None:
         lru = self._vantage_lru
-        if gkey in lru:
-            lru.remove(gkey)
-        lru.append(gkey)
+        if vkey in lru:
+            lru.remove(vkey)
+        lru.append(vkey)
         while len(lru) > self._MAX_FOREIGN_VANTAGES:
             old = lru.pop(0)
-            self._dev_graph.pop(old, None)
-            self._dev_buf.pop(old, None)
-            self._nh_set_cache.pop(old[1], None)
+            self._vstates.pop(old, None)
 
-    def mirror(self, link_state: LinkState) -> EllGraph:
-        """Device mirror, refreshed when the LinkState generation moves."""
-        cached = self._mirrors.get(link_state.area)
-        if cached is not None and cached[0] == link_state.generation:
-            return cached[1]
-        prev = cached[1] if cached is not None else None
-        graph = build_ell(link_state, prev=prev)
-        self._mirrors[link_state.area] = (link_state.generation, graph)
-        return graph
+    # -- build -------------------------------------------------------------
 
     def build_route_db(
         self,
@@ -520,7 +543,6 @@ class TpuSpfSolver:
         prefix_state: PrefixState,
     ) -> Optional[DecisionRouteDb]:
         # multi-area: selection must be global across areas — CPU path
-        # (single-area is the device-accelerated deployment this round)
         if len(area_link_states) != 1:
             return self.cpu.build_route_db(
                 my_node_name, area_link_states, prefix_state
@@ -566,6 +588,59 @@ class TpuSpfSolver:
             route_db.add_mpls_route(entry)
         return route_db
 
+    # -- device state sync -------------------------------------------------
+
+    def _sync_area(self, area: str, link_state: LinkState,
+                   prefix_state: PrefixState, prefixes: list) -> _AreaDev:
+        import jax
+
+        ad = self._area_dev.get(area)
+        if ad is None:
+            ad = self._area_dev[area] = _AreaDev()
+        old_plan = ad.plan
+        plan = sync_plan(link_state, old_plan)
+        rebuilt = plan is not old_plan
+        ad.plan = plan
+        if rebuilt or ad.d_deltas is None:
+            ad.d_deltas = jax.device_put(plan.deltas)
+            ad.d_shift_w = jax.device_put(plan.shift_w)
+            ad.d_res_rows = jax.device_put(plan.res_rows)
+            ad.d_res_nbr = jax.device_put(plan.res_nbr)
+            ad.d_res_w = jax.device_put(plan.res_w)
+            plan.dirty_shift = []
+            plan.dirty_res = []
+            plan.dirty_res_nbr = False
+            ad.buf_version += 1
+        else:
+            (s_idx, s_val), (r_idx, r_val), nbr_changed = drain_dirty(plan)
+            scatter = _scatter_jit()
+            if s_idx is not None:
+                ad.d_shift_w = scatter(ad.d_shift_w, s_idx, s_val)
+            if r_idx is not None:
+                ad.d_res_w = scatter(ad.d_res_w, r_idx, r_val)
+            if nbr_changed:
+                ad.d_res_rows = jax.device_put(plan.res_rows)
+                ad.d_res_nbr = jax.device_put(plan.res_nbr)
+            if s_idx is not None or r_idx is not None or nbr_changed:
+                ad.buf_version += 1
+
+        # announcer matrix: keyed on prefix churn + node-index stability
+        mkey = (prefix_state.generation, plan.index_version)
+        if ad.matrix_key != mkey or ad.matrix is None:
+            ad.matrix = build_prefix_matrix(
+                prefix_state, plan.node_index, area, prefixes
+            )
+            ad.matrix_key = mkey
+            ad.matrix_version += 1
+            ad.flags = None  # force re-pack
+        flags, mbuf = _pack_matrix(ad.matrix, plan.node_overloaded)
+        if ad.flags is None or not np.array_equal(flags, ad.flags):
+            ad.flags = flags
+            ad.d_mbuf = jax.device_put(mbuf)
+        return ad
+
+    # -- the fast path ------------------------------------------------------
+
     def _solve_fast(
         self,
         my_node_name: str,
@@ -575,137 +650,202 @@ class TpuSpfSolver:
         prefixes: list[str],
         route_db: DecisionRouteDb,
     ) -> None:
+        import time as _time
+
         import jax
 
-        graph = self.mirror(link_state)
-        root_idx = graph.node_index[my_node_name]
-
-        # root out-edge table, cached per (area, vantage, generation):
-        # build_route_db serves any-vantage queries (ctrl API)
-        gkey = (area, my_node_name)
-        if my_node_name != self.my_node_name:
-            self._touch_foreign_vantage(gkey)
-        cached = self._dev_graph.get(gkey)
-        if cached is None or cached[0] != link_state.generation:
-            root_table = graph.out_table(root_idx)
-            self._dev_graph[gkey] = (link_state.generation, root_table)
-        root_nbr, root_w, root_up, links = self._dev_graph[gkey][1]
-
-        # announcer matrix: keyed on prefix churn + node-index stability —
-        # metric/link flaps that preserve the node set reuse it as-is
-        mkey = (prefix_state.generation, graph.index_version)
-        mcached = self._dev_matrix.get(area)
-        if mcached is None or mcached[0] != mkey:
-            matrix = build_prefix_matrix(
-                prefix_state, graph.node_index, area, prefixes
-            )
-            self._dev_matrix[area] = (mkey, matrix)
-        matrix = self._dev_matrix[area][1]
-
-        # TWO packed input buffers (graph-per-vantage, announcer matrix),
-        # each resident on device and re-uploaded only when its content
-        # changed — the device link is bandwidth-bound, and topology churn
-        # and prefix churn invalidate different halves
-        gbuf = pack_graph_inputs(
-            graph.in_nbr, graph.in_w, graph.in_up, graph.node_overloaded,
-            root_idx, root_nbr, root_w, root_up,
-        )
-        dev_cached = self._dev_buf.get(gkey)
-        if (
-            dev_cached is None
-            or dev_cached[0].shape != gbuf.shape
-            or not np.array_equal(dev_cached[0], gbuf)
-        ):
-            self._dev_buf[gkey] = (gbuf, jax.device_put(gbuf))
-            # link objects may have changed — this vantage's sets only
-            self._nh_set_cache.pop(my_node_name, None)
-        dev_gbuf = self._dev_buf[gkey][1]
-
-        mbuf = pack_matrix_inputs(matrix, graph.node_overloaded)
-        mbuf_key = ("matrix", area)
-        dev_mcached = self._dev_buf.get(mbuf_key)
-        if (
-            dev_mcached is None
-            or dev_mcached[0].shape != mbuf.shape
-            or not np.array_equal(dev_mcached[0], mbuf)
-        ):
-            self._dev_buf[mbuf_key] = (mbuf, jax.device_put(mbuf))
-        dev_mbuf = self._dev_buf[mbuf_key][1]
-
+        t0 = _time.perf_counter()
+        ad = self._sync_area(area, link_state, prefix_state, prefixes)
+        plan, matrix = ad.plan, ad.matrix
+        root_idx = plan.node_index[my_node_name]
+        root_nbr, root_w, links = plan.out_links(link_state, my_node_name)
         d_cap = root_nbr.shape[0]
         p_cap, a_cap = matrix.ann_node.shape
-        run = _jitted_packed_pipeline(
-            graph.n_cap, graph.k_cap, d_cap, p_cap, a_cap
+        r_cap, kr_cap = plan.res_nbr.shape
+        has_res = plan.k_res > 0
+        shape_key = (
+            plan.n_cap, plan.s_cap, r_cap, kr_cap, has_res, d_cap, p_cap, a_cap
         )
-        metric_np, s3_np, nh_np, has_np = run(dev_gbuf, dev_mbuf)
+
+        vkey = (area, my_node_name)
+        if my_node_name != self.my_node_name:
+            self._touch_foreign_vantage(vkey)
+        vs = self._vstates.get(vkey)
+        if vs is None:
+            vs = self._vstates[vkey] = _VantageState()
+        links_tuple = tuple(links)
+        if (
+            vs.shape_key != shape_key
+            or vs.matrix_version != ad.matrix_version
+            or not vs.valid
+            or vs.links_tuple != links_tuple
+        ):
+            # (re)initialize prev outputs to zeros -> every row reads as
+            # changed -> full pull path below
+            wa = -(-a_cap // 16)
+            wd = -(-d_cap // 16)
+            vs.prev = (
+                jax.device_put(np.zeros(p_cap, np.int32)),
+                jax.device_put(np.zeros((p_cap, wa), np.int32)),
+                jax.device_put(np.zeros((p_cap, wd), np.int32)),
+            )
+            vs.shape_key = shape_key
+            vs.matrix_version = ad.matrix_version
+            vs.routes = {}
+            vs.nh_cache = {}
+            vs.links_tuple = links_tuple
+            vs.valid = False
+
+        t1 = _time.perf_counter()
+        run = _plan_pipeline(*shape_key, _DELTA_BUDGET)
+        delta_buf, full_buf, m_new, s3w_new, nhw_new = run(
+            ad.d_deltas, ad.d_shift_w, ad.d_res_rows, ad.d_res_nbr,
+            ad.d_res_w, ad.d_mbuf,
+            np.int32(root_idx), root_nbr, root_w, *vs.prev,
+        )
+        vs.prev = (m_new, s3w_new, nhw_new)
+
+        wa = -(-a_cap // 16)
+        wd = -(-d_cap // 16)
+        b = _DELTA_BUDGET
+        count = None
+        if vs.valid:
+            dbuf = np.asarray(delta_buf)  # ONE pull
+            count = int(dbuf[0])
+            self.last_trips = int(dbuf[1])
+        t2 = _time.perf_counter()
+        full_pull = count is None or count > b
         self.last_device_stats = {
-            "n_cap": graph.n_cap,
-            "k_cap": graph.k_cap,
+            "n_cap": plan.n_cap,
+            "s_cap": plan.s_cap,
+            "k_res": plan.k_res,
             "n_prefixes": len(matrix.prefix_list),
+            "changed_rows": count,
+            "full_pull": full_pull,
+        }
+        if full_pull:
+            fbuf = np.asarray(full_buf)
+            t2 = _time.perf_counter()
+            o = 0
+            metric = fbuf[o:o + p_cap]; o += p_cap
+            s3w = fbuf[o:o + p_cap * wa].reshape(p_cap, wa); o += p_cap * wa
+            nhw = fbuf[o:o + p_cap * wd].reshape(p_cap, wd); o += p_cap * wd
+            self.last_trips = int(fbuf[o])
+            self._materialize_full(
+                vs, my_node_name, prefix_state, matrix, links, root_idx,
+                metric, s3w, nhw,
+            )
+            vs.valid = True
+        elif count:
+            o = 2
+            cidx = dbuf[o:o + b]; o += b
+            metric = dbuf[o:o + b]; o += b
+            s3w = dbuf[o:o + b * wa].reshape(b, wa); o += b * wa
+            nhw = dbuf[o:o + b * wd].reshape(b, wd)
+            live = cidx < p_cap
+            self._materialize_rows(
+                vs, my_node_name, prefix_state, matrix, links, root_idx,
+                cidx[live][:count], metric[live][:count],
+                s3w[live][:count], nhw[live][:count],
+            )
+        self.last_device_stats["trips"] = self.last_trips
+
+        route_db.unicast_routes.update(vs.routes)
+        t3 = _time.perf_counter()
+        self.last_timing = {
+            "sync_ms": (t1 - t0) * 1e3,
+            "exec_ms": (t2 - t1) * 1e3,
+            "mat_ms": (t3 - t2) * 1e3,
         }
 
-        self._materialize(
-            my_node_name,
-            prefix_state,
-            matrix,
-            links,
-            root_idx,
-            metric_np,
-            s3_np,
-            nh_np,
-            has_np,
-            route_db,
-        )
+    # -- host materialization ----------------------------------------------
 
-    def _materialize(
-        self,
-        my_node_name: str,
-        prefix_state: PrefixState,
-        matrix: PrefixMatrix,
-        links: list,
-        root_idx: int,
-        metric: np.ndarray,
-        s3: np.ndarray,
-        nh_mask: np.ndarray,
-        has_route: np.ndarray,
-        route_db: DecisionRouteDb,
+    def _materialize_full(
+        self, vs, my_node_name, prefix_state, matrix, links, root_idx,
+        metric, s3w, nhw,
     ) -> None:
-        """Host materialization of device outputs into RibUnicastEntry.
-
-        All route-level filters run vectorized over numpy; the Python loop
-        only constructs entries for surviving rows, with next-hop sets
-        memoized per (slot pattern, metric) — route fan-outs repeat heavily
-        across prefixes, so the cache collapses most construction cost.
-        """
+        """Full rebuild of the vantage route cache from packed outputs.
+        Route-level filters run vectorized; the Python loop only builds
+        entries for surviving rows."""
         p_n = len(matrix.prefix_list)
-        ok = has_route[:p_n].copy()
-        # v4 gate
+        a_cap = matrix.ann_node.shape[1]
+        d_n = len(links)
+        s3 = unpack_words(s3w[:p_n], a_cap)
+        nh = unpack_words(nhw[:p_n], max(d_n, 1))
+        met = metric[:p_n]
+
+        ok = s3.any(axis=1) & (met < INF_E)
         if not (self.cpu.enable_v4 or self.cpu.v4_over_v6_nexthop):
             ok &= ~matrix.is_v4[:p_n]
-        s3n = s3[:p_n]
-        # self-advertised skip (fast path has no prepend labels)
-        ok &= ~(s3n & (matrix.ann_node[:p_n] == root_idx)).any(axis=1)
-        # min-nexthop threshold: max over selected announcers vs nh count
-        eff_min = np.where(s3n, matrix.min_nexthop[:p_n], -1).max(axis=1)
-        nh_count = nh_mask[:p_n].sum(axis=1)
+        ok &= ~(s3 & (matrix.ann_node[:p_n] == root_idx)).any(axis=1)
+        eff_min = np.where(s3, matrix.min_nexthop[:p_n], -1).max(axis=1)
+        nh_count = nh.sum(axis=1)
         ok &= (eff_min <= nh_count) & (nh_count > 0)
 
-        d_range = range(nh_mask.shape[1])
-        nh_cache = self._nh_set_cache.setdefault(my_node_name, {})
-        for p in np.flatnonzero(ok):
-            prefix = matrix.prefix_list[p]
-            row = s3n[p]
-            selected = [
-                na for a, na in enumerate(matrix.node_areas[p]) if row[a]
-            ]
+        vs.routes = {}
+        rows = np.flatnonzero(ok)
+        if len(rows):
+            self._build_entries(
+                vs, my_node_name, prefix_state, matrix, links, rows,
+                met, s3, nh,
+            )
+
+    def _materialize_rows(
+        self, vs, my_node_name, prefix_state, matrix, links, root_idx,
+        rows, metric_rows, s3w_rows, nhw_rows,
+    ) -> None:
+        """Delta path: apply only changed rows to the route cache."""
+        p_n = len(matrix.prefix_list)
+        a_cap = matrix.ann_node.shape[1]
+        d_n = len(links)
+        live = rows < p_n
+        rows = rows[live]
+        if not len(rows):
+            return
+        s3 = unpack_words(s3w_rows[live], a_cap)
+        nh = unpack_words(nhw_rows[live], max(d_n, 1))
+        met = metric_rows[live]
+
+        ok = s3.any(axis=1) & (met < INF_E)
+        if not (self.cpu.enable_v4 or self.cpu.v4_over_v6_nexthop):
+            ok &= ~matrix.is_v4[rows]
+        ok &= ~(s3 & (matrix.ann_node[rows] == root_idx)).any(axis=1)
+        eff_min = np.where(s3, matrix.min_nexthop[rows], -1).max(axis=1)
+        nh_count = nh.sum(axis=1)
+        ok &= (eff_min <= nh_count) & (nh_count > 0)
+
+        # removals
+        for p in rows[~ok]:
+            vs.routes.pop(matrix.prefix_list[p], None)
+        keep = np.flatnonzero(ok)
+        if len(keep):
+            self._build_entries(
+                vs, my_node_name, prefix_state, matrix, links,
+                rows[keep], met, s3, nh, value_rows=keep,
+            )
+
+    def _build_entries(
+        self, vs, my_node_name, prefix_state, matrix, links, rows,
+        met, s3, nh, value_rows=None,
+    ) -> None:
+        """Construct RibUnicastEntry for the given matrix rows. met/s3/nh
+        are indexed by value_rows (delta path) or by matrix row (full)."""
+        nh_cache = vs.nh_cache
+        node_areas = matrix.node_areas
+        prefix_list = matrix.prefix_list
+        nh_packed = np.packbits(nh, axis=1)
+        for i, p in enumerate(rows):
+            vi = value_rows[i] if value_rows is not None else p
+            row = s3[vi]
+            nas = node_areas[p]
+            selected = [na for a, na in enumerate(nas) if row[a]]
             if not selected:
                 continue
-            m = int(metric[p])
-            bits = tuple(d for d in d_range if nh_mask[p, d])
-            # slot indices are root-relative; the cache dict is per-vantage
-            key = (bits, m)
+            m = int(met[vi])
+            key = (nh_packed[vi].tobytes(), m)
             nexthops = nh_cache.get(key)
             if nexthops is None:
+                nh_row = nh[vi]
                 nexthops = frozenset(
                     NextHop(
                         address=links[d].nh_v6_from_node(my_node_name),
@@ -714,7 +854,7 @@ class TpuSpfSolver:
                         area=links[d].area,
                         neighbor_node_name=links[d].other_node(my_node_name),
                     )
-                    for d in bits
+                    for d in np.flatnonzero(nh_row)
                 )
                 nh_cache[key] = nexthops
             best = (
@@ -722,13 +862,12 @@ class TpuSpfSolver:
                 if len(selected) == 1
                 else select_best_node_area(set(selected), my_node_name)
             )
+            prefix = prefix_list[p]
             entries = prefix_state.entries_for(prefix)
-            route_db.add_unicast_route(
-                RibUnicastEntry(
-                    prefix=prefix,
-                    nexthops=nexthops,
-                    best_prefix_entry=entries[best],
-                    best_node_area=best,
-                    igp_cost=m,
-                )
+            vs.routes[prefix] = RibUnicastEntry(
+                prefix=prefix,
+                nexthops=nexthops,
+                best_prefix_entry=entries[best],
+                best_node_area=best,
+                igp_cost=m,
             )

@@ -58,7 +58,8 @@ def _adj(me: str, other: str, metric: int = 1, weight: int = 1) -> Adjacency:
 def _loopback_prefix(node_idx: int, v4: bool = False) -> str:
     if v4:
         return f"10.{(node_idx >> 16) & 0xFF}.{(node_idx >> 8) & 0xFF}.{node_idx & 0xFF}/32"
-    return f"fd00::{node_idx:x}/128"
+    hi, lo = node_idx >> 16, node_idx & 0xFFFF
+    return f"fd00::{hi:x}:{lo:x}/128" if hi else f"fd00::{lo:x}/128"
 
 
 def _mk_dbs(
@@ -193,6 +194,90 @@ def fat_tree(
             nodes[rsw(pod, i)] = [
                 _adj(rsw(pod, i), fsw(pod, pl)) for pl in range(planes)
             ]
+    return _mk_dbs(nodes, area, forwarding_algorithm, node_labels)
+
+
+def fabric(
+    pods: int = 96,
+    planes: int = 8,
+    ssws_per_plane: int = 36,
+    rsws_per_pod: int = 64,
+    area: str = "0",
+    forwarding_algorithm: PrefixForwardingAlgorithm = PrefixForwardingAlgorithm.SP_ECMP,
+    node_labels: bool = False,
+    prefixes_per_node: int = 1,
+) -> tuple[list[AdjacencyDatabase], list[PrefixDatabase]]:
+    """Large 3-tier fabric for benchmarks (BASELINE config 3), pod-major
+    node naming so natural-sort index order keeps pods contiguous: the
+    rsw<->fsw tier decomposes into shift classes on the device mirror
+    (ops/edgeplan.py), the pod-crossing spine tier lands in the compact
+    residual. Structure follows the reference fabric markers
+    (RoutingBenchmarkUtils.h:93-99: ssw/plane, rsw/pod); one fsw per
+    plane per pod."""
+    nodes: dict[str, list[Adjacency]] = {}
+    fsw = lambda pod, pl: f"pod{pod:03d}-fsw{pl:02d}"  # noqa: E731
+    rsw = lambda pod, i: f"pod{pod:03d}-rsw{i:02d}"  # noqa: E731
+    ssw = lambda pl, s: f"zspine{pl:02d}-ssw{s:02d}"  # noqa: E731
+
+    for pod in range(pods):
+        for pl in range(planes):
+            adjs = [_adj(fsw(pod, pl), ssw(pl, s)) for s in range(ssws_per_plane)]
+            adjs += [_adj(fsw(pod, pl), rsw(pod, i)) for i in range(rsws_per_pod)]
+            nodes[fsw(pod, pl)] = adjs
+        for i in range(rsws_per_pod):
+            nodes[rsw(pod, i)] = [
+                _adj(rsw(pod, i), fsw(pod, pl)) for pl in range(planes)
+            ]
+    for pl in range(planes):
+        for s in range(ssws_per_plane):
+            nodes[ssw(pl, s)] = [
+                _adj(ssw(pl, s), fsw(pod, pl)) for pod in range(pods)
+            ]
+    return _mk_dbs(
+        nodes, area, forwarding_algorithm, node_labels, prefixes_per_node
+    )
+
+
+def wan(
+    regions: int = 48,
+    region_side: int = 32,
+    hub_links: int = 3,
+    seed: int = 7,
+    area: str = "0",
+    forwarding_algorithm: PrefixForwardingAlgorithm = PrefixForwardingAlgorithm.SP_ECMP,
+    node_labels: bool = False,
+) -> tuple[list[AdjacencyDatabase], list[PrefixDatabase]]:
+    """Multi-region WAN for benchmarks (BASELINE config 4): each region is
+    a metro grid (region-major naming keeps intra-region edges in shared
+    shift classes); per-region hub routers interconnect over a region ring
+    plus random chords with higher metrics (long-haul)."""
+    rng = random.Random(seed)
+    nodes: dict[str, list[Adjacency]] = {}
+    name = lambda g, r, c: f"r{g:02d}-n{r:02d}-{c:02d}"  # noqa: E731
+    for g in range(regions):
+        for r in range(region_side):
+            for c in range(region_side):
+                adjs = []
+                for dr, dc in ((-1, 0), (1, 0), (0, -1), (0, 1)):
+                    rr, cc = r + dr, c + dc
+                    if 0 <= rr < region_side and 0 <= cc < region_side:
+                        adjs.append(_adj(name(g, r, c), name(g, rr, cc)))
+                nodes[name(g, r, c)] = adjs
+    # inter-region: hubs at the region center; ring + chords
+    mid = region_side // 2
+    hub = lambda g: name(g, mid, mid)  # noqa: E731
+    pairs = {
+        (min(g, (g + 1) % regions), max(g, (g + 1) % regions))
+        for g in range(regions)
+    }
+    while len(pairs) < regions * hub_links // 2:
+        a, b = rng.randrange(regions), rng.randrange(regions)
+        if a != b:
+            pairs.add((min(a, b), max(a, b)))
+    for a, b in pairs:
+        metric = rng.randint(10, 100)
+        nodes[hub(a)].append(_adj(hub(a), hub(b), metric=metric))
+        nodes[hub(b)].append(_adj(hub(b), hub(a), metric=metric))
     return _mk_dbs(nodes, area, forwarding_algorithm, node_labels)
 
 

@@ -3,23 +3,26 @@
 The reference is single-process C++ with no device parallelism; the scale
 axis it offers is per-area partitioning (SURVEY §5 long-context analogue).
 Here the TPU-native scale story is explicit (SURVEY §2 parallelism
-checklist):
+checklist), over the shift-decomposed mirror (ops/edgeplan.py):
 
-  - **batch axis ("dp")**: independent SSSP roots — whole-fabric RIB
-    computation (every node's routes, e.g. the benchmark and the
-    any-vantage ctrl API) shards roots across devices; zero communication.
-  - **graph axis ("tp"/"cp")**: the node dimension of the ELL mirror is
-    sharded across devices; each relaxation step computes new distances
-    for the local node shard from the full frontier, then reassembles the
-    frontier with jax.lax.all_gather over the 'graph' axis (the halo
-    exchange of this domain). This is what lets a 1M+-node LSDB exceed a
-    single chip's HBM.
+  - **batch axis ("dp")**: independent SSSP vantages — whole-fabric RIB
+    computation (every node's routes; the any-vantage ctrl API) shards
+    roots across devices; zero communication.
+  - **graph axis ("tp"/"cp")**: the node dimension of the WEIGHT arrays
+    (the memory that scales with LSDB size: shift_w [S, N], residual
+    ELL) is sharded across devices. Each relaxation computes the partial
+    candidate field contributed by the LOCAL source columns, then
+    combines with jax.lax.pmin over the 'graph' axis — the halo exchange
+    of this domain. The frontier (dist [D, N]) stays replicated, so a
+    relax is: local shifts over a locally-weighted full-width field +
+    one pmin collective. This is what lets a 1M+-node LSDB's weight
+    state exceed a single chip's HBM while collectives ride ICI.
 
-Both axes compose in one jax.sharding.Mesh('batch', 'graph') and ride ICI
-when the mesh maps onto a physical slice. Collectives used: all_gather
-(frontier), psum-of-bool (convergence vote, folded into the fixed-trip
-count here: lax.fori_loop with a diameter bound keeps every device in
-lockstep without a host round-trip).
+Both axes compose in one jax.sharding.Mesh('batch', 'graph') via
+shard_map. Iteration count is a diameter bound measured on device by the
+single-chip pipeline (trips are part of its output), not a blind
+n_nodes bound — every shard runs the same fixed trip count, keeping the
+mesh in lockstep with no host round-trips.
 """
 
 from __future__ import annotations
@@ -29,15 +32,16 @@ from typing import Optional
 
 import numpy as np
 
-from openr_tpu.ops.csr import INF32
+from openr_tpu.ops.edgeplan import INF32E
 
-INF = int(INF32)
+INF_E = int(INF32E)
+_UNROLL = 8
 
 
 def make_mesh(n_devices: Optional[int] = None, batch: Optional[int] = None):
     """Factor devices into a ('batch', 'graph') mesh. Prefers a wider
     batch axis (root fan-out is embarrassingly parallel; graph sharding
-    pays an all_gather per relaxation step)."""
+    pays a pmin per relaxation step)."""
     import jax
 
     devs = jax.devices()
@@ -58,110 +62,182 @@ def make_mesh(n_devices: Optional[int] = None, batch: Optional[int] = None):
     return Mesh(np.array(devs).reshape(batch, graph), ("batch", "graph"))
 
 
-def _sharded_step_fn(mesh, n_cap: int, n_iters: int):
-    """Build the shard_mapped multi-root SSSP + selection step."""
+@functools.lru_cache(maxsize=8)
+def _sharded_fabric_fn(mesh, n_cap: int, s_cap: int, r_cap: int,
+                       kr_cap: int, has_res: bool, d_cap: int,
+                       p_cap: int, a_cap: int, n_trips: int):
+    """shard_mapped whole-fabric pipeline: for each root (sharded over
+    'batch'), batched-seed SSSP with graph-axis-sharded weights, then
+    best-route selection. Returns (dist[R, N], metric[R, P],
+    nh_mask[R, P, D])."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
     graph_size = mesh.shape["graph"]
-    shard_rows = n_cap // graph_size
+    shard_cols = n_cap // graph_size
 
-    def local_step(
-        in_nbr,  # [N/g, K]   node-sharded over 'graph'
-        in_w,
-        in_up,
-        node_over,  # [N]     replicated
-        roots,  # [R/b]       root-sharded over 'batch'
-        ann_node,  # [P, A]   replicated prefix matrix
-        ann_valid,
+    def local_fn(
+        deltas,      # [S]            replicated
+        shift_w,     # [S, N/g]       node columns sharded over 'graph'
+        res_rows,    # [R/g]          residual rows sharded
+        res_nbr,     # [R/g, K]
+        res_w,       # [R/g, K]
+        roots,       # [Rt/b]         roots sharded over 'batch'
+        root_nbr,    # [Rt/b, D]
+        root_w,      # [Rt/b, D]
+        ann_node,    # [P, A]         announcer matrix replicated
+        ann_flags,
         path_pref,
         source_pref,
         dist_adv,
     ):
-        my_shard = jax.lax.axis_index("graph")
-        row0 = my_shard * shard_rows
+        my_col0 = jax.lax.axis_index("graph") * shard_cols
 
-        def one_root(root):
-            dist0 = jnp.full((n_cap,), INF, jnp.int32).at[root].set(0)
-            usable = in_up & (in_nbr >= 0) & ((in_nbr == root) | ~node_over[in_nbr])
-
-            def body(_, dist):
-                # relax local node rows against the full frontier
-                nbr_dist = dist[in_nbr]  # [N/g, K] gather from full dist
-                cand = jnp.where(
-                    usable & (nbr_dist < INF), nbr_dist + in_w, INF
-                ).min(axis=1)
-                local_new = jnp.minimum(
-                    jax.lax.dynamic_slice(dist, (row0,), (shard_rows,)), cand
-                )
-                # frontier reassembly: the halo exchange of this domain
-                return jax.lax.all_gather(
-                    local_new, "graph", tiled=True
-                )
-
-            dist = jax.lax.fori_loop(0, n_iters, body, dist0)
-
-            # selection for this root over the (replicated) prefix matrix —
-            # shared kernel with the single-chip pipeline
-            from openr_tpu.decision.tpu_solver import _select_metric_kernel
-
-            metric, _s3, _s4, _idx = _select_metric_kernel(
-                dist, node_over, ann_node, ann_valid, path_pref, source_pref, dist_adv
+        def one_root(root, seeds_nbr, seeds_w):
+            # mask root as transit within my local source columns (no
+            # column matches when the root lives in another shard)
+            local_root = root - my_col0
+            col_iota = jnp.arange(shard_cols)
+            sw = jnp.where(
+                col_iota[None, :] == local_root, INF_E, shift_w
             )
-            return dist, metric
+            rw = jnp.where(res_nbr == root, INF_E, res_w)
+            valid = seeds_w < INF_E
+            seed_idx = jnp.clip(seeds_nbr, 0, n_cap - 1)
+            dist0 = jnp.full((d_cap, n_cap), INF_E, jnp.int32)
+            dist0 = dist0.at[jnp.arange(d_cap), seed_idx].min(
+                jnp.where(valid, 0, INF_E).astype(jnp.int32)
+            )
 
-        return jax.vmap(one_root)(roots)
+            nbr_c = jnp.clip(res_nbr, 0, n_cap - 1)
+            rows_c = jnp.clip(res_rows, 0, n_cap - 1)
+
+            def relax(dist):
+                # local sources' contribution over the full-width field
+                pc = jnp.full_like(dist, INF_E)
+                def cls(k, pc):
+                    w_full = jax.lax.dynamic_update_slice(
+                        jnp.full((n_cap,), INF_E, jnp.int32),
+                        sw[k],
+                        (my_col0,),
+                    )
+                    return jnp.minimum(
+                        pc, jnp.roll(dist + w_full[None, :], deltas[k], axis=1)
+                    )
+                pc = jax.lax.fori_loop(0, s_cap, cls, pc)
+                if has_res:
+                    nd = dist[:, nbr_c]
+                    cand = (nd + rw[None]).min(axis=2)
+                    pc = pc.at[:, rows_c].min(cand)
+                # halo exchange: combine shards' candidates
+                pc = jax.lax.pmin(pc, "graph")
+                return jnp.minimum(dist, pc)
+
+            def body(i, dist):
+                for _ in range(_UNROLL):
+                    dist = relax(dist)
+                return dist
+
+            dist_d = jax.lax.fori_loop(0, n_trips, body, dist0)
+            via = seeds_w[:, None] + dist_d
+            dist = jnp.minimum(via.min(axis=0), INF_E).at[root].set(0)
+
+            ann_valid = (ann_flags & 1).astype(bool)
+            ann_over = (ann_flags & 2).astype(bool)
+            idx = jnp.clip(ann_node, 0, n_cap - 1)
+            ann_dist = dist[idx]
+            reach = ann_valid & (ann_dist < INF_E)
+            neg = -(2**31)
+            pp = jnp.where(reach, path_pref, neg)
+            s = reach & (pp == pp.max(axis=1, keepdims=True))
+            sp = jnp.where(s, source_pref, neg)
+            s = s & (sp == sp.max(axis=1, keepdims=True))
+            da = jnp.where(s, dist_adv, INF_E)
+            s2 = s & (da == da.min(axis=1, keepdims=True))
+            nd = s2 & ~ann_over
+            s3 = jnp.where(nd.any(axis=1, keepdims=True), nd, s2)
+            igp = jnp.where(s3, ann_dist, INF_E)
+            metric = igp.min(axis=1)
+            s4 = s3 & (igp == metric[:, None])
+            on_sp = (via == dist[None, :]).T
+            nh_mask = jnp.any(s4[:, :, None] & on_sp[idx], axis=1)
+            return dist, metric, nh_mask
+
+        return jax.vmap(one_root)(roots, root_nbr, root_w)
 
     from jax import shard_map
 
     return jax.jit(
         shard_map(
-            local_step,
+            local_fn,
             mesh=mesh,
             in_specs=(
-                P("graph", None),  # in_nbr: node rows sharded
-                P("graph", None),
-                P("graph", None),
-                P(),  # node_over replicated
-                P("batch"),  # roots sharded
-                P(),  # prefix matrix replicated
-                P(),
-                P(),
-                P(),
-                P(),
+                P(),                 # deltas
+                P(None, "graph"),    # shift_w columns
+                P("graph"),          # res_rows
+                P("graph", None),    # res_nbr
+                P("graph", None),    # res_w
+                P("batch"),          # roots
+                P("batch", None),    # root_nbr
+                P("batch", None),    # root_w
+                P(), P(), P(), P(), P(),
             ),
-            out_specs=(P("batch", None), P("batch", None)),
+            out_specs=(
+                P("batch", None),
+                P("batch", None),
+                P("batch", None, None),
+            ),
             check_vma=False,
         )
     )
 
 
-@functools.lru_cache(maxsize=8)
-def _cached_step(mesh, n_cap, n_iters):
-    return _sharded_step_fn(mesh, n_cap, n_iters)
+def pad_to(arr: np.ndarray, size: int, fill, axis: int = 0) -> np.ndarray:
+    if arr.shape[axis] == size:
+        return arr
+    pad = [(0, 0)] * arr.ndim
+    pad[axis] = (0, size - arr.shape[axis])
+    return np.pad(arr, pad, constant_values=fill)
 
 
-def sharded_rib_step(mesh, graph, roots, matrix, n_iters: Optional[int] = None):
-    """Run the sharded multi-root pipeline: returns (dist[R, N_cap],
-    metric[R, P_cap]) computed across the mesh.
+def sharded_fabric_step(mesh, plan, matrix, roots, out_nbr, out_w,
+                        n_trips: int):
+    """Run the sharded whole-fabric pipeline.
 
-    graph: ops.csr.EllGraph; roots: np int32 array (length must divide the
-    batch axis evenly — pad with root 0); matrix: ops.csr.PrefixMatrix.
-    n_iters defaults to a safe diameter bound (n_nodes), callers with a
-    known topology should pass something tighter.
+    plan: ops.edgeplan.EdgePlan; matrix: ops.csr.PrefixMatrix;
+    roots [Rt] int32 (padded to a multiple of the batch axis);
+    out_nbr/out_w [Rt, D]: per-root out-edge tables; n_trips: diameter
+    bound in unrolled trips (take it from the single-chip pipeline's
+    measured trip count, +1 slack).
+
+    Returns (dist [Rt, N_cap], metric [Rt, P_cap], nh_mask [Rt, P_cap, D]).
     """
-    n_iters = n_iters or max(graph.n_nodes, 1)
-    step = _cached_step(mesh, graph.n_cap, n_iters)
-    return step(
-        graph.in_nbr,
-        graph.in_w,
-        graph.in_up,
-        graph.node_overloaded,
-        roots.astype(np.int32),
-        matrix.ann_node,
-        matrix.ann_valid,
-        matrix.path_pref,
-        matrix.source_pref,
+    g = mesh.shape["graph"]
+    n_cap = plan.n_cap
+    assert n_cap % g == 0, (n_cap, g)
+    r_cap = ((plan.res_rows.shape[0] + g - 1) // g) * g
+    res_rows = pad_to(plan.res_rows, r_cap, -1)
+    res_nbr = pad_to(plan.res_nbr, r_cap, -1)
+    res_w = pad_to(plan.res_w, r_cap, INF_E)
+    kr_cap = res_nbr.shape[1]
+    d_cap = out_nbr.shape[1]
+    p_cap, a_cap = matrix.ann_node.shape
+    has_res = plan.k_res > 0
+
+    idxm = np.clip(matrix.ann_node, 0, None)
+    flags = matrix.ann_valid.astype(np.int32) | (
+        plan.node_overloaded[idxm].astype(np.int32) << 1
+    )
+
+    fn = _sharded_fabric_fn(
+        mesh, n_cap, plan.s_cap, r_cap, kr_cap, has_res, d_cap,
+        p_cap, a_cap, n_trips,
+    )
+    return fn(
+        plan.deltas, plan.shift_w, res_rows, res_nbr, res_w,
+        roots.astype(np.int32), out_nbr.astype(np.int32),
+        out_w.astype(np.int32),
+        matrix.ann_node, flags, matrix.path_pref, matrix.source_pref,
         matrix.dist_adv,
     )

@@ -1,0 +1,174 @@
+"""Unit tests for the shift-decomposed device mirror (ops/edgeplan.py):
+full-build decomposition, changelog delta application vs fresh rebuild,
+and the natural node ordering."""
+
+import numpy as np
+import pytest
+
+from openr_tpu.decision.link_state import LinkState
+from openr_tpu.models import topologies
+from openr_tpu.ops.edgeplan import (
+    INF32E,
+    build_plan,
+    natural_key,
+    sync_plan,
+)
+from openr_tpu.types import Adjacency, AdjacencyDatabase
+
+
+def dense_w(plan):
+    """Reconstruct the effective directed weight matrix from a plan —
+    min over all slots that map u->v (the semantics the relax computes)."""
+    n = plan.n_cap
+    w = np.full((n, n), int(INF32E), np.int64)
+    for k in range(plan.s_cap):
+        d = int(plan.deltas[k])
+        for u in range(n):
+            v = u + d
+            if 0 <= v < n and plan.shift_w[k, u] < INF32E:
+                w[u, v] = min(w[u, v], int(plan.shift_w[k, u]))
+    for row in range(plan.res_rows.shape[0]):
+        v = int(plan.res_rows[row])
+        if v < 0:
+            continue
+        for c in range(plan.res_nbr.shape[1]):
+            u = int(plan.res_nbr[row, c])
+            if u >= 0 and plan.res_w[row, c] < INF32E:
+                w[u, v] = min(w[u, v], int(plan.res_w[row, c]))
+    return w
+
+
+def build_ls(adj_dbs, area="0"):
+    ls = LinkState(area)
+    for db in adj_dbs:
+        ls.update_adjacency_database(db)
+    return ls
+
+
+def update_metrics(ls, adj_dbs, node_i, metric):
+    db = adj_dbs[node_i]
+    new = AdjacencyDatabase(
+        this_node_name=db.this_node_name,
+        adjacencies=tuple(
+            Adjacency(**{**a.__dict__, "metric": metric})
+            for a in db.adjacencies
+        ),
+        node_label=db.node_label,
+        area=db.area,
+    )
+    return ls.update_adjacency_database(new)
+
+
+class TestBuild:
+    def test_grid_is_pure_shifts(self):
+        adj, _ = topologies.grid(8)
+        ls = build_ls(adj)
+        plan = build_plan(ls)
+        assert plan.k_res == 0
+        # 4 shift classes: +-1 (cols) and +-8 (rows)
+        live = {int(d) for k, d in enumerate(plan.deltas)
+                if (plan.shift_w[k] < INF32E).any()}
+        assert live == {1, -1, 8, -8}
+
+    def test_fabric_residual_is_row_compact(self):
+        # pods large enough that intra-pod deltas clear the class floor
+        adj, _ = topologies.fabric(pods=12, planes=2, ssws_per_plane=3,
+                                   rsws_per_pod=6)
+        ls = build_ls(adj)
+        plan = build_plan(ls)
+        rows = int((plan.res_rows >= 0).sum())
+        # residual rows stay far below node count (spine tier only)
+        assert 0 < rows < plan.n_nodes // 2
+
+    def test_natural_order(self):
+        names = ["node-10-2", "node-2-3", "node-2-10"]
+        assert sorted(names, key=natural_key) == [
+            "node-2-3", "node-2-10", "node-10-2"
+        ]
+
+
+class TestDeltaSync:
+    def test_metric_flap_matches_fresh_build(self):
+        adj, _ = topologies.grid(6)
+        ls = build_ls(adj)
+        plan = build_plan(ls)
+        update_metrics(ls, adj, 7, 5)
+        update_metrics(ls, adj, 12, 9)
+        synced = sync_plan(ls, plan)
+        assert synced is plan  # delta path, no rebuild
+        fresh = build_plan(ls)
+        assert np.array_equal(dense_w(synced), dense_w(fresh))
+        # dirty entries queued for the device scatter
+        assert synced.dirty_shift or synced.dirty_res
+
+    def test_link_down_and_up(self):
+        adj, _ = topologies.ring(6)
+        ls = build_ls(adj)
+        plan = build_plan(ls)
+        # drop node-2 <-> node-3 by removing the adjacency from node-2
+        db = adj[2]
+        keep = tuple(
+            a for a in db.adjacencies if a.other_node_name != "node-3"
+        )
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name="node-2", adjacencies=keep,
+                node_label=db.node_label, area="0",
+            )
+        )
+        synced = sync_plan(ls, plan)
+        assert synced is plan
+        assert np.array_equal(dense_w(synced), dense_w(build_plan(ls)))
+        # restore
+        ls.update_adjacency_database(db)
+        synced = sync_plan(ls, plan)
+        assert np.array_equal(dense_w(synced), dense_w(build_plan(ls)))
+
+    def test_node_overload_drains_transit(self):
+        adj, _ = topologies.grid(4)
+        ls = build_ls(adj)
+        plan = build_plan(ls)
+        db = adj[5]
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name=db.this_node_name,
+                adjacencies=db.adjacencies,
+                node_label=db.node_label,
+                area="0",
+                is_overloaded=True,
+            )
+        )
+        synced = sync_plan(ls, plan)
+        assert synced is plan
+        fresh = build_plan(ls)
+        assert np.array_equal(dense_w(synced), dense_w(fresh))
+        # all out-edges of the drained node are INF
+        u = plan.node_index[db.this_node_name]
+        assert (dense_w(synced)[u] >= INF32E).all()
+
+    def test_node_add_triggers_rebuild(self):
+        adj, _ = topologies.ring(4)
+        ls = build_ls(adj)
+        plan = build_plan(ls)
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name="node-9",
+                adjacencies=(),
+                node_label=0,
+                area="0",
+            )
+        )
+        synced = sync_plan(ls, plan)
+        assert synced is not plan  # rebuilt
+        assert "node-9" in synced.node_index
+
+    def test_changelog_overflow_forces_rebuild(self):
+        adj, _ = topologies.ring(4)
+        ls = build_ls(adj)
+        plan = build_plan(ls)
+        for i in range(5000):  # exceed the bounded changelog
+            update_metrics(ls, adj, i % 4, 2 + i % 7)
+        assert ls.events_since(plan.synced_generation) is None
+        synced = sync_plan(ls, plan)
+        assert synced is not plan
+        assert np.array_equal(dense_w(synced), dense_w(build_plan(ls)))
