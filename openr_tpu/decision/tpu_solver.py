@@ -427,7 +427,8 @@ class _AreaDev:
 
     __slots__ = (
         "plan", "d_deltas", "d_shift_w", "d_res_rows", "d_res_nbr",
-        "d_res_w", "matrix_key", "matrix", "flags", "d_mbuf", "buf_version",
+        "d_res_w", "matrix_key", "matrix", "flags", "d_mbuf",
+        "matrix_version",
     )
 
     def __init__(self):
@@ -610,7 +611,6 @@ class TpuSpfSolver:
             plan.dirty_shift = []
             plan.dirty_res = []
             plan.dirty_res_nbr = False
-            ad.buf_version += 1
         else:
             (s_idx, s_val), (r_idx, r_val), nbr_changed = drain_dirty(plan)
             scatter = _scatter_jit()
@@ -621,8 +621,6 @@ class TpuSpfSolver:
             if nbr_changed:
                 ad.d_res_rows = jax.device_put(plan.res_rows)
                 ad.d_res_nbr = jax.device_put(plan.res_nbr)
-            if s_idx is not None or r_idx is not None or nbr_changed:
-                ad.buf_version += 1
 
         # announcer matrix: keyed on prefix churn + node-index stability
         mkey = (prefix_state.generation, plan.index_version)

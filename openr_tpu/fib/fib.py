@@ -108,6 +108,7 @@ class Fib(Actor):
         self._retry_signal = None  # asyncio.Event, created on start
         self._agent_alive_since: Optional[float] = None
         self._synced_signalled = False
+        self._partial_sync_published = False
         self._pending_perf: Optional[PerfEvents] = None
         # convergence perf-event ring (ref PerfDatabase)
         self.perf_db: collections.deque[PerfEvents] = collections.deque(
@@ -195,6 +196,27 @@ class Fib(Actor):
         except Exception as e:
             log.warning("%s: syncMplsFib failed: %s", self.name, e)
             counters.increment("fib.sync_fib_failure")
+            # the unicast sync already ran: publish the unicast routes that
+            # DID land as an INCREMENTAL delta (additive — it must not
+            # claim snapshot completeness while the MPLS table state is
+            # unknown), once per failure episode so persistent failures
+            # don't re-flood subscribers every backoff tick. State stays
+            # SYNCING, so the retry re-runs the full sync including MPLS;
+            # no dirty-marking needed (SYNCING retries never take the
+            # dirty-route path).
+            if not self._partial_sync_published:
+                self._partial_sync_published = True
+                self._publish_programmed(
+                    DecisionRouteUpdate(
+                        type=RouteUpdateType.INCREMENTAL,
+                        unicast_routes_to_update={
+                            p: r
+                            for p, r in rs.unicast_routes.items()
+                            if p not in failed_p
+                        },
+                    ),
+                    perf,
+                )
             self._schedule_retry()
             return
         if failed_p or failed_l:
@@ -235,6 +257,7 @@ class Fib(Actor):
     ) -> None:
         rs = self.route_state
         rs.state = FibState.SYNCED
+        self._partial_sync_published = False
         counters.increment("fib.full_sync")
         self._publish_programmed(
             DecisionRouteUpdate(

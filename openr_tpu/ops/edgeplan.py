@@ -122,7 +122,9 @@ class EdgePlan:
             other = link.other_node(root)
             nbr[d] = self.node_index[other]
             w[d] = (
-                link.metric_from_node(root) if link.is_up() else INF32E
+                min(link.metric_from_node(root), MAX_METRIC)
+                if link.is_up()
+                else INF32E
             )
             out.append(link)
         return nbr, w, out

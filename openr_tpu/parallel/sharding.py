@@ -140,6 +140,11 @@ def _sharded_fabric_fn(mesh, n_cap: int, s_cap: int, r_cap: int,
                 return dist
 
             dist_d = jax.lax.fori_loop(0, n_trips, body, dist0)
+            # convergence verdict: one extra relaxation must be a no-op.
+            # Under-iteration (n_trips below the true diameter bound) is
+            # thereby detected instead of silently returning too-large
+            # distances for distant roots.
+            converged = jnp.all(relax(dist_d) == dist_d)
             via = seeds_w[:, None] + dist_d
             dist = jnp.minimum(via.min(axis=0), INF_E).at[root].set(0)
 
@@ -162,7 +167,7 @@ def _sharded_fabric_fn(mesh, n_cap: int, s_cap: int, r_cap: int,
             s4 = s3 & (igp == metric[:, None])
             on_sp = (via == dist[None, :]).T
             nh_mask = jnp.any(s4[:, :, None] & on_sp[idx], axis=1)
-            return dist, metric, nh_mask
+            return dist, metric, nh_mask, converged
 
         return jax.vmap(one_root)(roots, root_nbr, root_w)
 
@@ -187,6 +192,7 @@ def _sharded_fabric_fn(mesh, n_cap: int, s_cap: int, r_cap: int,
                 P("batch", None),
                 P("batch", None),
                 P("batch", None, None),
+                P("batch"),
             ),
             check_vma=False,
         )
@@ -202,14 +208,18 @@ def pad_to(arr: np.ndarray, size: int, fill, axis: int = 0) -> np.ndarray:
 
 
 def sharded_fabric_step(mesh, plan, matrix, roots, out_nbr, out_w,
-                        n_trips: int):
+                        n_trips: int, check_convergence: bool = True):
     """Run the sharded whole-fabric pipeline.
 
     plan: ops.edgeplan.EdgePlan; matrix: ops.csr.PrefixMatrix;
     roots [Rt] int32 (padded to a multiple of the batch axis);
     out_nbr/out_w [Rt, D]: per-root out-edge tables; n_trips: diameter
     bound in unrolled trips (take it from the single-chip pipeline's
-    measured trip count, +1 slack).
+    measured trip count with 2x slack — one vantage's trip count bounds
+    its eccentricity, and another root's can be up to ~2x that). The
+    kernel emits a per-root convergence verdict (one extra relaxation
+    must be a fixpoint no-op); with check_convergence the verdict is
+    asserted host-side, so an insufficient bound fails loudly.
 
     Returns (dist [Rt, N_cap], metric [Rt, P_cap], nh_mask [Rt, P_cap, D]).
     """
@@ -234,10 +244,17 @@ def sharded_fabric_step(mesh, plan, matrix, roots, out_nbr, out_w,
         mesh, n_cap, plan.s_cap, r_cap, kr_cap, has_res, d_cap,
         p_cap, a_cap, n_trips,
     )
-    return fn(
+    dist, metric, nh_mask, converged = fn(
         plan.deltas, plan.shift_w, res_rows, res_nbr, res_w,
         roots.astype(np.int32), out_nbr.astype(np.int32),
         out_w.astype(np.int32),
         matrix.ann_node, flags, matrix.path_pref, matrix.source_pref,
         matrix.dist_adv,
     )
+    if check_convergence:
+        conv = np.asarray(converged)
+        assert conv.all(), (
+            f"sharded SSSP unconverged for roots "
+            f"{np.asarray(roots)[~conv].tolist()}: raise n_trips ({n_trips})"
+        )
+    return dist, metric, nh_mask
