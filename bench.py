@@ -81,8 +81,11 @@ def _flap(states, adj_dbs, victims, round_i, area="0"):
         )
 
 
-def bench_config(name, gen, me, runs=5, flap_victims=0, cpu_baseline=True):
-    """Run one config; returns a result dict."""
+def bench_config(name, gen, me, runs=5, flap_victims=0, cpu_baseline=True,
+                 small_graph_nodes=0):
+    """Run one config; returns a result dict. small_graph_nodes > 0
+    exercises the "auto" backend's small-graph delegation (the solver
+    routes the whole build to the CPU oracle below that node count)."""
     from openr_tpu.decision.spf_solver import SpfSolver
     from openr_tpu.decision.tpu_solver import TpuSpfSolver
     from openr_tpu.models import topologies
@@ -109,7 +112,7 @@ def bench_config(name, gen, me, runs=5, flap_victims=0, cpu_baseline=True):
         res["cpu_ms"] = round(cpu_ms, 1)
         log(f"[{name}] cpu oracle: {cpu_ms:.1f} ms, {len(cpu_db.unicast_routes)} routes")
 
-    tpu = TpuSpfSolver(me)
+    tpu = TpuSpfSolver(me, small_graph_nodes=small_graph_nodes)
     t0 = time.perf_counter()
     tpu_db = tpu.build_route_db(me, states, ps)
     res["compile_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
@@ -124,7 +127,7 @@ def bench_config(name, gen, me, runs=5, flap_victims=0, cpu_baseline=True):
     # cold full rebuild, jit warm: fresh solver state -> plan build + full
     # device pull + full host materialization (what a restarting daemon
     # pays once)
-    tpu2 = TpuSpfSolver(me)
+    tpu2 = TpuSpfSolver(me, small_graph_nodes=small_graph_nodes)
     t0 = time.perf_counter()
     tpu2.build_route_db(me, states, ps)
     res["full_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
@@ -192,8 +195,12 @@ def main() -> None:
         configs[name] = r
         return r, tpu_ms, cpu_ms
 
-    # 1: 4-node mesh — CPU parity baseline (example_openr.conf scale)
-    run("mesh4", lambda: topologies.full_mesh(4), "node-0", runs=3)
+    # 1: 4-node mesh — CPU parity baseline (example_openr.conf scale).
+    # Runs with the "auto" backend's small-graph delegation: tiny graphs
+    # solve on the CPU oracle (the device round trip alone is ~300x the
+    # whole solve here).
+    run("mesh4", lambda: topologies.full_mesh(4), "node-0", runs=3,
+        small_graph_nodes=1024)
 
     # 2: 1k-node Terragraph-style mesh (street-lattice grid)
     run("tg1k", lambda: topologies.grid(32, node_labels=False), "node-16-16")

@@ -85,6 +85,10 @@ class DecisionConfig:
     # (decision/spf_solver.py); "tpu" is the batched JAX solver
     # (decision/tpu_solver.py); "auto" prefers tpu when a device is present.
     solver_backend: str = "auto"
+    # "auto" only: below this node count the device launch + result pull
+    # costs more than the whole CPU solve (measured crossover ~1.5k nodes
+    # on the bench rig), so auto delegates small graphs to the oracle
+    auto_small_graph_nodes: int = 1024
     # capacity classes for static-shape padding (ops/csr.py)
     max_nodes_hint: int = 0  # 0 = grow on demand
 

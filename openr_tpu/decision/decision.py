@@ -89,14 +89,21 @@ class PendingUpdates:
         self.perf_events = None
 
 
-def make_solver(node_name: str, backend: str, **kwargs):
-    """The solver-backend hook (role of the plugin boundary)."""
+def make_solver(
+    node_name: str, backend: str, small_graph_nodes: int = 0, **kwargs
+):
+    """The solver-backend hook (role of the plugin boundary). "auto"
+    prefers the device but routes graphs below small_graph_nodes to the
+    CPU oracle (a device launch + result pull has a fixed cost that
+    dwarfs small solves)."""
     if backend == "cpu":
         return SpfSolver(node_name, **kwargs)
     if backend in ("tpu", "auto"):
         try:
             from openr_tpu.decision.tpu_solver import TpuSpfSolver
 
+            if backend == "auto":
+                kwargs.setdefault("small_graph_nodes", small_graph_nodes)
             return TpuSpfSolver(node_name, **kwargs)
         except Exception:
             if backend == "tpu":
@@ -129,7 +136,12 @@ class Decision(Actor):
         self.area_link_states: dict[str, LinkState] = {}
         self.prefix_state = PrefixState()
         backend = solver_backend or config.solver_backend
-        self.solver = make_solver(node_name, backend, **(solver_kwargs or {}))
+        self.solver = make_solver(
+            node_name,
+            backend,
+            small_graph_nodes=config.auto_small_graph_nodes,
+            **(solver_kwargs or {}),
+        )
         self.rib_policy: Optional[RibPolicy] = None
 
         self.pending = PendingUpdates()

@@ -325,6 +325,9 @@ class LinkState:
     def has_node(self, node: str) -> bool:
         return node in self._adj_dbs
 
+    def node_count(self) -> int:
+        return len(self._adj_dbs)
+
     def node_names(self) -> list[str]:
         return list(self._adj_dbs)
 

@@ -481,8 +481,14 @@ class TpuSpfSolver:
     """Drop-in replacement for SpfSolver.build_route_db with the hot path
     on device. Differentially tested against the CPU oracle."""
 
-    def __init__(self, my_node_name: str, **solver_kwargs):
+    def __init__(
+        self, my_node_name: str, small_graph_nodes: int = 0, **solver_kwargs
+    ):
         self.my_node_name = my_node_name
+        # graphs below this node count solve entirely on the CPU oracle:
+        # the fixed device dispatch + result-pull round trip exceeds the
+        # whole CPU solve there (the "auto" backend sets this)
+        self.small_graph_nodes = small_graph_nodes
         self.cpu = SpfSolver(my_node_name, **solver_kwargs)
         self._area_dev: dict[str, _AreaDev] = {}
         self._vstates: dict[tuple, _VantageState] = {}
@@ -551,6 +557,10 @@ class TpuSpfSolver:
         area, link_state = next(iter(area_link_states.items()))
         if not link_state.has_node(my_node_name):
             return None
+        if link_state.node_count() < self.small_graph_nodes:
+            return self.cpu.build_route_db(
+                my_node_name, area_link_states, prefix_state
+            )
 
         if self._partition is not None and self._partition[0] == prefix_state.generation:
             fast, slow = self._partition[1], self._partition[2]

@@ -206,6 +206,33 @@ def test_rib_differential_mesh_4node():
         run_both(me, states, ps)
 
 
+def test_small_graph_delegates_to_cpu_oracle():
+    """The "auto" backend's small-graph heuristic: below the node
+    threshold the whole build runs on the CPU oracle (no device state is
+    created), and results are identical by construction."""
+    adj_dbs, prefix_dbs = topologies.full_mesh(4)
+    states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    tpu = TpuSpfSolver("node-0", small_graph_nodes=64)
+    cpu = SpfSolver("node-0")
+    assert_rib_equal(
+        cpu.build_route_db("node-0", states, ps),
+        tpu.build_route_db("node-0", states, ps),
+        "small-graph delegation",
+    )
+    assert not tpu._area_dev, "device path must not run below the threshold"
+
+
+def test_make_solver_auto_passes_threshold():
+    from openr_tpu.decision.decision import make_solver
+
+    solver = make_solver("node-0", "auto", small_graph_nodes=128)
+    if isinstance(solver, TpuSpfSolver):
+        assert solver.small_graph_nodes == 128
+    # explicit "tpu" backend never delegates
+    solver = make_solver("node-0", "tpu")
+    assert solver.small_graph_nodes == 0
+
+
 def test_ksp2_and_ucmp_fall_back_to_cpu_identically():
     states = square_states()
     ps = PrefixState()
