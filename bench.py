@@ -82,10 +82,11 @@ def _flap(states, adj_dbs, victims, round_i, area="0"):
 
 
 def bench_config(name, gen, me, runs=5, flap_victims=0, cpu_baseline=True,
-                 small_graph_nodes=0):
+                 small_graph_nodes=0, **solver_kw):
     """Run one config; returns a result dict. small_graph_nodes > 0
     exercises the "auto" backend's small-graph delegation (the solver
-    routes the whole build to the CPU oracle below that node count)."""
+    routes the whole build to the CPU oracle below that node count);
+    extra solver_kw (e.g. enable_lfa) go to BOTH backends."""
     from openr_tpu.decision.spf_solver import SpfSolver
     from openr_tpu.decision.tpu_solver import TpuSpfSolver
     from openr_tpu.models import topologies
@@ -105,14 +106,14 @@ def bench_config(name, gen, me, runs=5, flap_victims=0, cpu_baseline=True,
 
     cpu_ms = None
     if cpu_baseline:
-        cpu = SpfSolver(me)
+        cpu = SpfSolver(me, **solver_kw)
         t0 = time.perf_counter()
         cpu_db = cpu.build_route_db(me, states, ps)
         cpu_ms = (time.perf_counter() - t0) * 1e3
         res["cpu_ms"] = round(cpu_ms, 1)
         log(f"[{name}] cpu oracle: {cpu_ms:.1f} ms, {len(cpu_db.unicast_routes)} routes")
 
-    tpu = TpuSpfSolver(me, small_graph_nodes=small_graph_nodes)
+    tpu = TpuSpfSolver(me, small_graph_nodes=small_graph_nodes, **solver_kw)
     t0 = time.perf_counter()
     tpu_db = tpu.build_route_db(me, states, ps)
     res["compile_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
@@ -127,7 +128,7 @@ def bench_config(name, gen, me, runs=5, flap_victims=0, cpu_baseline=True,
     # cold full rebuild, jit warm: fresh solver state -> plan build + full
     # device pull + full host materialization (what a restarting daemon
     # pays once)
-    tpu2 = TpuSpfSolver(me, small_graph_nodes=small_graph_nodes)
+    tpu2 = TpuSpfSolver(me, small_graph_nodes=small_graph_nodes, **solver_kw)
     t0 = time.perf_counter()
     tpu2.build_route_db(me, states, ps)
     res["full_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
@@ -217,12 +218,15 @@ def main() -> None:
         }))
         return
 
-    # 3: 10k-node fat-tree fabric, ECMP
+    # 3: 10k-node fat-tree fabric, ECMP + LFA backup next-hops (the CPU
+    # oracle pays one extra Dijkstra per neighbor; the device derives
+    # alternates from distance fields it already holds)
     run(
         "fabric10k",
         lambda: topologies.fabric(pods=96, planes=8, ssws_per_plane=36,
                                   rsws_per_pod=64),
         "pod000-rsw00",
+        enable_lfa=True,
     )
 
     # 4: 50k-node WAN (KSP2 segment-routing subset pending device KSP2)

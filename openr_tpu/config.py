@@ -89,6 +89,9 @@ class DecisionConfig:
     # costs more than the whole CPU solve (measured crossover ~1.5k nodes
     # on the bench rig), so auto delegates small graphs to the oracle
     auto_small_graph_nodes: int = 1024
+    # openr_tpu extension: compute rfc5286 loop-free-alternate backup
+    # next hops for SP_ECMP/IP prefixes (RibUnicastEntry.lfa_nexthops)
+    enable_lfa: bool = False
     # capacity classes for static-shape padding (ops/csr.py)
     max_nodes_hint: int = 0  # 0 = grow on demand
 

@@ -136,11 +136,14 @@ class Decision(Actor):
         self.area_link_states: dict[str, LinkState] = {}
         self.prefix_state = PrefixState()
         backend = solver_backend or config.solver_backend
+        skw = dict(solver_kwargs or {})
+        if config.enable_lfa:
+            skw.setdefault("enable_lfa", True)
         self.solver = make_solver(
             node_name,
             backend,
             small_graph_nodes=config.auto_small_graph_nodes,
-            **(solver_kwargs or {}),
+            **skw,
         )
         self.rib_policy: Optional[RibPolicy] = None
 

@@ -85,7 +85,20 @@ def filter_nexthops_to_unique_action(
 
 @dataclass(frozen=True)
 class RibUnicastEntry:
-    """One computed unicast route (ref RibEntry.h:43-110)."""
+    """One computed unicast route (ref RibEntry.h:43-110).
+
+    lfa_nexthops carries the loop-free-alternate backup next hop(s)
+    (rfc5286) when the solver runs with LFA enabled: a neighbor N is a
+    valid alternate for this prefix iff dist_N(P) < dist_N(self) +
+    dist_self(P), which guarantees N's own shortest path to P does not
+    loop back through this node. Alternates are kept separate from the
+    primary ECMP set — Fib programs them as backup next hops, never as
+    load-balanced members (their metric is the alternate path cost,
+    strictly greater than igp_cost). The reference has no LFA; this is
+    the TPU build's fast-reroute extension (BASELINE config 3), derived
+    on device from the same per-neighbor distance fields the ECMP
+    next-hop predicate uses (ref next-hop machinery this extends:
+    openr/decision/SpfSolver.cpp:1043-1285)."""
 
     prefix: str
     nexthops: frozenset[NextHop] = frozenset()
@@ -95,6 +108,7 @@ class RibUnicastEntry:
     igp_cost: int = 0
     ucmp_weight: Optional[int] = None
     counter_id: Optional[str] = None  # set by RibPolicy (ref RibEntry.h:70)
+    lfa_nexthops: frozenset[NextHop] = frozenset()
 
 
 @dataclass(frozen=True)

@@ -24,7 +24,7 @@ functions of (areaLinkStates, prefixState) and are differentially tested.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Optional
 
 from openr_tpu.decision.link_state import LinkState, NodeUcmpResult, path_a_in_path_b
@@ -110,12 +110,14 @@ class SpfSolver:
         enable_ucmp: bool = False,
         enable_best_route_selection: bool = True,
         v4_over_v6_nexthop: bool = False,
+        enable_lfa: bool = False,
     ):
         self.my_node_name = my_node_name
         self.enable_v4 = enable_v4
         self.enable_node_segment_label = enable_node_segment_label
         self.enable_adjacency_labels = enable_adjacency_labels
         self.enable_ucmp = enable_ucmp
+        self.enable_lfa = enable_lfa
         self.enable_best_route_selection = enable_best_route_selection
         self.v4_over_v6_nexthop = v4_over_v6_nexthop
         self.static_unicast_routes: dict[str, RibUnicastEntry] = {}
@@ -249,6 +251,7 @@ class SpfSolver:
         total_next_hops: set[NextHop] = set()
         ucmp_weight: Optional[int] = None
         shortest_metric = INF
+        lfa_candidates: list = []
         for area, link_state in area_link_states.items():
             rules = self._area_forwarding_rules(area, prefix_entries, selection)
             if rules is None:
@@ -270,6 +273,21 @@ class SpfSolver:
                     fwd_algo,
                     is_v4,
                 )
+                if (
+                    self.enable_lfa
+                    and fwd_type == PrefixForwardingType.IP
+                    and nhs
+                    and best_metric < INF
+                ):
+                    lfa_candidates.extend(
+                        self._lfa_candidates(
+                            my_node_name,
+                            selection,
+                            area,
+                            link_state,
+                            int(best_metric),
+                        )
+                    )
                 # only keep next hops from areas with the shortest IGP metric
                 if shortest_metric >= best_metric:
                     if shortest_metric > best_metric:
@@ -295,7 +313,7 @@ class SpfSolver:
                     )
                 )
 
-        return self._add_best_paths(
+        route = self._add_best_paths(
             my_node_name,
             prefix,
             selection,
@@ -304,6 +322,30 @@ class SpfSolver:
             0 if shortest_metric == INF else int(shortest_metric),
             ucmp_weight,
         )
+        if route is not None and lfa_candidates:
+            primary = {
+                (nh.if_name, nh.neighbor_node_name) for nh in route.nexthops
+            }
+            cands = [
+                c
+                for c in lfa_candidates
+                if (
+                    c[3].iface_from_node(my_node_name),
+                    c[3].other_node(my_node_name),
+                )
+                not in primary
+            ]
+            if cands:
+                alt_metric, _, _, link = min(cands)
+                lfa = NextHop(
+                    address=link.nh_v6_from_node(my_node_name),
+                    if_name=link.iface_from_node(my_node_name),
+                    metric=alt_metric,
+                    area=link.area,
+                    neighbor_node_name=link.other_node(my_node_name),
+                )
+                route = replace(route, lfa_nexthops=frozenset({lfa}))
+        return route
 
     # -- best-route selection (ref SpfSolver.cpp:648-707) ------------------
 
@@ -542,6 +584,66 @@ class SpfSolver:
                     )
                 )
         return next_hops
+
+    # -- LFA fast-reroute alternates (rfc5286) -----------------------------
+
+    def _lfa_candidates(
+        self,
+        my_node_name: str,
+        selection: RouteSelectionResult,
+        area: str,
+        link_state: LinkState,
+        area_metric: int,
+    ) -> list:
+        """Loop-free alternate candidates for one area: every up link to a
+        neighbor N satisfying dist_N(P) < dist_N(self) + dist_self(P),
+        where dist_N(P) = min over the selected announcers of N's own
+        distance. Strict inequality guarantees every shortest N->P path
+        avoids this node (a path through self costs at least the RHS), so
+        pre-installing N as a backup cannot loop. Overloaded neighbors are
+        skipped unless the neighbor is itself a selected destination
+        (drained nodes must not pick up transit, but a direct link to the
+        destination is fine) — mirroring the transit-drain rule runSpf
+        applies (link_state.py run_spf; ref LinkState.cpp:870-876).
+
+        Returns (alt_metric, area, link_order, link) tuples; the caller
+        filters out primaries, keeps the global minimum and materializes
+        that one winner as a NextHop. The TPU path (tpu_solver.py)
+        computes the same predicate on device from its per-neighbor
+        distance fields and is differentially tested against this oracle
+        (tests/test_lfa.py)."""
+        dsts = [n for n, a in selection.all_node_areas if a == area]
+        if not dsts:
+            return []
+        out = []
+        for order, link in enumerate(
+            link_state.ordered_links_from_node(my_node_name)
+        ):
+            if not link.is_up():
+                continue
+            neighbor = link.other_node(my_node_name)
+            n_is_dst = neighbor in dsts
+            if link_state.is_node_overloaded(neighbor) and not n_is_dst:
+                continue
+            if n_is_dst:
+                # the neighbor announces the prefix itself: trivially
+                # loop-free, alternate cost = the link metric
+                dist_np = 0
+            else:
+                spf_n = link_state.get_spf_result(neighbor)
+                dist_np = min(
+                    (spf_n[d].metric for d in dsts if d in spf_n),
+                    default=None,
+                )
+                if dist_np is None:
+                    continue
+                root_res = spf_n.get(my_node_name)
+                dist_nr = INF if root_res is None else root_res.metric
+                if not dist_np < dist_nr + area_metric:
+                    continue
+            alt_metric = link.metric_from_node(my_node_name) + dist_np
+            out.append((alt_metric, area, order, link))
+        return out
 
     # -- KSP2 (ref SpfSolver.cpp:847-973) ----------------------------------
 

@@ -313,12 +313,16 @@ def _plan_sssp(deltas, shift_w, res_rows, res_nbr, res_w, root,
 @functools.lru_cache(maxsize=None)
 def _plan_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
                    has_res: bool,
-                   d_cap: int, p_cap: int, a_cap: int, budget: int):
+                   d_cap: int, p_cap: int, a_cap: int, budget: int,
+                   lfa: bool = False):
     """The fused production pipeline. Outputs:
-      delta_buf int32 [2 + B + B + B*wa + B*wd]: count, overflow?, idx,
-                metric, s3 words, nh words for up to B changed rows
-      full_buf  int32 [P * (1 + wa + wd)]: full packed outputs
-      metric, s3w, nhw: resident arrays (the next call's prev_*)
+      delta_buf int32 [2 + B + B + B*wa + B*wd (+ 2B with lfa)]: count,
+                trips, idx, metric, s3 words, nh words (and lfa slot +
+                metric) for up to B changed rows
+      full_buf  int32 [P * (1 + wa + wd (+2 with lfa)) + 1]: full packed
+                outputs + trips
+      metric, s3w, nhw, lfa_slot, lfa_metric: resident arrays (the next
+                call's prev_*; lfa arrays are passthrough when lfa=False)
     """
     import jax
     import jax.numpy as jnp
@@ -330,7 +334,8 @@ def _plan_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
 
     def pipeline(deltas, shift_w, res_rows, res_nbr, res_w, mbuf,
                  root, root_nbr, root_w,
-                 prev_metric, prev_s3w, prev_nhw):
+                 prev_metric, prev_s3w, prev_nhw,
+                 prev_lfa_slot, prev_lfa_metric):
         o = 0
         ann_node = mbuf[o:o + pa].reshape(p_cap, a_cap); o += pa
         ann_flags = mbuf[o:o + pa].reshape(p_cap, a_cap); o += pa
@@ -367,6 +372,41 @@ def _plan_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
         on_sp = (via == dist[None, :]).T  # [N, D]
         nh_mask = jnp.any(s4[:, :, None] & on_sp[idx], axis=1)  # [P, D]
 
+        if lfa:
+            # rfc5286 loop-free alternates from the SAME per-slot distance
+            # fields: slot d is a valid backup for prefix row p iff its
+            # neighbor's own distance to the selected announcer set
+            # (min over s3 of dist_d) beats detouring back through the
+            # root (dist_d[root] + route metric). Strict < guarantees no
+            # micro-loop. One [P, A, D] row-gather — the same shape the
+            # ECMP predicate's on_sp[idx] gather already pays.
+            d_root = dist_d[:, root]  # [D] neighbor -> root distance
+            ann_nd = dist_d.T[idx]  # [P, A, D]
+            nbr_pd = jnp.where(
+                s3[:, :, None], ann_nd, INF_E
+            ).min(axis=1)  # [P, D]
+            link_up = root_w < INF_E
+            ok_lfa = (
+                link_up[None, :]
+                & ~nh_mask
+                & (nbr_pd < INF_E)  # neighbor actually reaches the prefix
+                & (nbr_pd < d_root[None, :] + metric[:, None])
+            )
+            # alternate cost <= 2^29 + 2^28 < the 2^30 mask fill
+            alt = jnp.where(
+                ok_lfa, root_w[None, :] + nbr_pd, jnp.int32(1 << 30)
+            )
+            has_lfa = ok_lfa.any(axis=1)
+            # argmin returns the FIRST minimum: lowest slot breaks ties,
+            # matching the oracle's ordered-link iteration
+            lfa_slot = jnp.where(
+                has_lfa, jnp.argmin(alt, axis=1).astype(jnp.int32), -1
+            )
+            lfa_metric = jnp.where(has_lfa, alt.min(axis=1), 0)
+        else:
+            lfa_slot = prev_lfa_slot
+            lfa_metric = prev_lfa_metric
+
         s3w = _pack_words(s3)
         nhw = _pack_words(nh_mask)
 
@@ -375,21 +415,29 @@ def _plan_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
             | jnp.any(s3w != prev_s3w, axis=1)
             | jnp.any(nhw != prev_nhw, axis=1)
         )
+        if lfa:
+            changed |= (lfa_slot != prev_lfa_slot) | (
+                lfa_metric != prev_lfa_metric
+            )
         count = changed.sum().astype(jnp.int32)
         cidx = jnp.nonzero(changed, size=budget, fill_value=p_cap)[0]
         safe = jnp.clip(cidx, 0, p_cap - 1).astype(jnp.int32)
-        delta_buf = jnp.concatenate([
+        delta_parts = [
             count[None],
             trips[None].astype(jnp.int32),
             cidx.astype(jnp.int32),
             metric[safe],
             s3w[safe].ravel(),
             nhw[safe].ravel(),
-        ])
-        full_buf = jnp.concatenate([
-            metric, s3w.ravel(), nhw.ravel(), trips[None].astype(jnp.int32),
-        ])
-        return delta_buf, full_buf, metric, s3w, nhw
+        ]
+        full_parts = [metric, s3w.ravel(), nhw.ravel()]
+        if lfa:
+            delta_parts += [lfa_slot[safe], lfa_metric[safe]]
+            full_parts += [lfa_slot, lfa_metric]
+        full_parts.append(trips[None].astype(jnp.int32))
+        delta_buf = jnp.concatenate(delta_parts)
+        full_buf = jnp.concatenate(full_parts)
+        return delta_buf, full_buf, metric, s3w, nhw, lfa_slot, lfa_metric
 
     return jax.jit(pipeline)
 
@@ -696,6 +744,8 @@ class TpuSpfSolver:
                 jax.device_put(np.zeros(p_cap, np.int32)),
                 jax.device_put(np.zeros((p_cap, wa), np.int32)),
                 jax.device_put(np.zeros((p_cap, wd), np.int32)),
+                jax.device_put(np.zeros(p_cap, np.int32)),
+                jax.device_put(np.zeros(p_cap, np.int32)),
             )
             vs.shape_key = shape_key
             vs.matrix_version = ad.matrix_version
@@ -705,13 +755,14 @@ class TpuSpfSolver:
             vs.valid = False
 
         t1 = _time.perf_counter()
-        run = _plan_pipeline(*shape_key, _DELTA_BUDGET)
-        delta_buf, full_buf, m_new, s3w_new, nhw_new = run(
+        lfa = self.cpu.enable_lfa
+        run = _plan_pipeline(*shape_key, _DELTA_BUDGET, lfa)
+        delta_buf, full_buf, *new_prev = run(
             ad.d_deltas, ad.d_shift_w, ad.d_res_rows, ad.d_res_nbr,
             ad.d_res_w, ad.d_mbuf,
             np.int32(root_idx), root_nbr, root_w, *vs.prev,
         )
-        vs.prev = (m_new, s3w_new, nhw_new)
+        vs.prev = tuple(new_prev)
 
         wa = -(-a_cap // 16)
         wd = -(-d_cap // 16)
@@ -738,10 +789,14 @@ class TpuSpfSolver:
             metric = fbuf[o:o + p_cap]; o += p_cap
             s3w = fbuf[o:o + p_cap * wa].reshape(p_cap, wa); o += p_cap * wa
             nhw = fbuf[o:o + p_cap * wd].reshape(p_cap, wd); o += p_cap * wd
+            lfa_slot = lfa_metric = None
+            if lfa:
+                lfa_slot = fbuf[o:o + p_cap]; o += p_cap
+                lfa_metric = fbuf[o:o + p_cap]; o += p_cap
             self.last_trips = int(fbuf[o])
             self._materialize_full(
                 vs, my_node_name, prefix_state, matrix, links, root_idx,
-                metric, s3w, nhw,
+                metric, s3w, nhw, lfa_slot, lfa_metric,
             )
             vs.valid = True
         elif count:
@@ -749,12 +804,18 @@ class TpuSpfSolver:
             cidx = dbuf[o:o + b]; o += b
             metric = dbuf[o:o + b]; o += b
             s3w = dbuf[o:o + b * wa].reshape(b, wa); o += b * wa
-            nhw = dbuf[o:o + b * wd].reshape(b, wd)
+            nhw = dbuf[o:o + b * wd].reshape(b, wd); o += b * wd
+            lfa_slot = lfa_metric = None
+            if lfa:
+                lfa_slot = dbuf[o:o + b]; o += b
+                lfa_metric = dbuf[o:o + b]
             live = cidx < p_cap
             self._materialize_rows(
                 vs, my_node_name, prefix_state, matrix, links, root_idx,
                 cidx[live][:count], metric[live][:count],
                 s3w[live][:count], nhw[live][:count],
+                None if lfa_slot is None else lfa_slot[live][:count],
+                None if lfa_metric is None else lfa_metric[live][:count],
             )
         self.last_device_stats["trips"] = self.last_trips
 
@@ -770,7 +831,7 @@ class TpuSpfSolver:
 
     def _materialize_full(
         self, vs, my_node_name, prefix_state, matrix, links, root_idx,
-        metric, s3w, nhw,
+        metric, s3w, nhw, lfa_slot=None, lfa_metric=None,
     ) -> None:
         """Full rebuild of the vantage route cache from packed outputs.
         Route-level filters run vectorized; the Python loop only builds
@@ -796,11 +857,14 @@ class TpuSpfSolver:
             self._build_entries(
                 vs, my_node_name, prefix_state, matrix, links, rows,
                 met, s3, nh,
+                lfa_slot[:p_n] if lfa_slot is not None else None,
+                lfa_metric[:p_n] if lfa_metric is not None else None,
             )
 
     def _materialize_rows(
         self, vs, my_node_name, prefix_state, matrix, links, root_idx,
         rows, metric_rows, s3w_rows, nhw_rows,
+        lfa_slot_rows=None, lfa_metric_rows=None,
     ) -> None:
         """Delta path: apply only changed rows to the route cache."""
         p_n = len(matrix.prefix_list)
@@ -813,6 +877,8 @@ class TpuSpfSolver:
         s3 = unpack_words(s3w_rows[live], a_cap)
         nh = unpack_words(nhw_rows[live], max(d_n, 1))
         met = metric_rows[live]
+        lfa_s = lfa_slot_rows[live] if lfa_slot_rows is not None else None
+        lfa_m = lfa_metric_rows[live] if lfa_metric_rows is not None else None
 
         ok = s3.any(axis=1) & (met < INF_E)
         if not (self.cpu.enable_v4 or self.cpu.v4_over_v6_nexthop):
@@ -829,19 +895,21 @@ class TpuSpfSolver:
         if len(keep):
             self._build_entries(
                 vs, my_node_name, prefix_state, matrix, links,
-                rows[keep], met, s3, nh, value_rows=keep,
+                rows[keep], met, s3, nh, lfa_s, lfa_m, value_rows=keep,
             )
 
     def _build_entries(
         self, vs, my_node_name, prefix_state, matrix, links, rows,
-        met, s3, nh, value_rows=None,
+        met, s3, nh, lfa_slot=None, lfa_metric=None, value_rows=None,
     ) -> None:
         """Construct RibUnicastEntry for the given matrix rows. met/s3/nh
-        are indexed by value_rows (delta path) or by matrix row (full)."""
+        (and lfa arrays) are indexed by value_rows (delta path) or by
+        matrix row (full)."""
         nh_cache = vs.nh_cache
         node_areas = matrix.node_areas
         prefix_list = matrix.prefix_list
         nh_packed = np.packbits(nh, axis=1)
+        no_lfa = frozenset()
         for i, p in enumerate(rows):
             vi = value_rows[i] if value_rows is not None else p
             row = s3[vi]
@@ -865,6 +933,26 @@ class TpuSpfSolver:
                     for d in np.flatnonzero(nh_row)
                 )
                 nh_cache[key] = nexthops
+            lfa_nexthops = no_lfa
+            if lfa_slot is not None:
+                d = int(lfa_slot[vi])
+                if 0 <= d < len(links):
+                    alt_m = int(lfa_metric[vi])
+                    lkey = ("lfa", d, alt_m)
+                    lfa_nexthops = nh_cache.get(lkey)
+                    if lfa_nexthops is None:
+                        lfa_nexthops = frozenset({
+                            NextHop(
+                                address=links[d].nh_v6_from_node(my_node_name),
+                                if_name=links[d].iface_from_node(my_node_name),
+                                metric=alt_m,
+                                area=links[d].area,
+                                neighbor_node_name=links[d].other_node(
+                                    my_node_name
+                                ),
+                            )
+                        })
+                        nh_cache[lkey] = lfa_nexthops
             best = (
                 selected[0]
                 if len(selected) == 1
@@ -878,4 +966,5 @@ class TpuSpfSolver:
                 best_prefix_entry=entries[best],
                 best_node_area=best,
                 igp_cost=m,
+                lfa_nexthops=lfa_nexthops,
             )
