@@ -114,6 +114,24 @@ def routes(ctx, from_node) -> None:
     _print(_call(ctx, "ctrl.decision.routes", {"from_node": from_node}))
 
 
+@decision.command("fabric-routes")
+@click.option(
+    "--nodes",
+    default=None,
+    help="comma-separated vantage nodes (default: every node in the LSDB)",
+)
+@click.pass_context
+def fabric_routes(ctx, nodes) -> None:
+    """Every vantage's RIB in one sharded device pass."""
+    _print(
+        _call(
+            ctx,
+            "ctrl.decision.fabric_routes",
+            {"from_nodes": nodes.split(",") if nodes else None},
+        )
+    )
+
+
 @decision.command()
 @click.pass_context
 def adjacencies(ctx) -> None:

@@ -70,6 +70,9 @@ class CtrlServer(Actor):
             s.register("ctrl.kvstore.set", self._kv_set)
         if self.decision is not None:
             s.register("ctrl.decision.routes", self._decision_routes)
+            s.register(
+                "ctrl.decision.fabric_routes", self._decision_fabric_routes
+            )
             s.register("ctrl.decision.adj_dbs", self._decision_adj_dbs)
             s.register(
                 "ctrl.decision.received_routes", self._decision_received
@@ -167,6 +170,27 @@ class CtrlServer(Actor):
         return {
             "unicast": {p: to_plain(e) for p, e in db.unicast_routes.items()},
             "mpls": {str(l): to_plain(e) for l, e in db.mpls_routes.items()},
+        }
+
+    async def _decision_fabric_routes(
+        self, from_nodes: Optional[list] = None
+    ) -> dict:
+        dbs = await self.decision.get_fabric_route_dbs(from_nodes)
+        return {
+            node: (
+                None
+                if db is None
+                else {
+                    "unicast": {
+                        p: to_plain(e) for p, e in db.unicast_routes.items()
+                    },
+                    "mpls": {
+                        str(l): to_plain(e)
+                        for l, e in db.mpls_routes.items()
+                    },
+                }
+            )
+            for node, db in dbs.items()
         }
 
     async def _decision_adj_dbs(self) -> dict:

@@ -367,6 +367,51 @@ class Decision(Actor):
             node, self.area_link_states, self.prefix_state
         )
 
+    # vantage bound for get_fabric_route_dbs' default all-nodes
+    # expansion: the computation runs inline in the actor (like every
+    # rebuild), and serializing ~100k full RIBs through ctrl would stall
+    # route processing for the duration — beyond this, the caller must
+    # name vantages explicitly
+    MAX_FABRIC_VANTAGES = 4096
+
+    async def get_fabric_route_dbs(
+        self, from_nodes: Optional[list[str]] = None
+    ) -> dict[str, Optional[DecisionRouteDb]]:
+        """Whole-fabric RIBs: every requested vantage (default: every
+        node in the LSDB, bounded by MAX_FABRIC_VANTAGES) computed in one
+        sharded device pass when the TPU backend is active
+        (TpuSpfSolver.build_fabric_route_dbs over the ('batch', 'graph')
+        mesh), per-vantage through the SAME configured solver otherwise
+        (so LFA / statics / v4 flags apply identically on both backends).
+        Same purity argument as get_decision_route_db — any vantage's RIB
+        is a function of the shared LSDB."""
+        nodes = from_nodes
+        if nodes is None:
+            nodes = sorted(
+                {
+                    n
+                    for ls in self.area_link_states.values()
+                    for n in ls.node_names()
+                }
+            )
+            if len(nodes) > self.MAX_FABRIC_VANTAGES:
+                raise ValueError(
+                    f"LSDB has {len(nodes)} nodes > "
+                    f"{self.MAX_FABRIC_VANTAGES}; pass an explicit "
+                    "vantage list"
+                )
+        fabric = getattr(self.solver, "build_fabric_route_dbs", None)
+        if fabric is not None:
+            return fabric(nodes, self.area_link_states, self.prefix_state)
+        # CPU backend: same solver instance per vantage — build_route_db
+        # is vantage-parameterized and carries the configured flags
+        return {
+            node: self.solver.build_route_db(
+                node, self.area_link_states, self.prefix_state
+            )
+            for node in nodes
+        }
+
     async def get_adj_dbs(self) -> dict[str, dict[str, AdjacencyDatabase]]:
         return {
             area: dict(ls.get_adjacency_databases())

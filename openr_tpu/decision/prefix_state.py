@@ -18,7 +18,11 @@ from openr_tpu.types import PrefixDatabase, PrefixEntry, parse_prefix
 PrefixEntries = dict
 
 
-@functools.lru_cache(maxsize=65536)
+# unbounded: the LSDB-scale target is ~100k prefixes and an LRU bound
+# below the working set thrashes (ip_network parsing is ~25us a miss —
+# a 64k bound cost ~2s per 100k-prefix matrix rebuild); entries are
+# small interned strings
+@functools.lru_cache(maxsize=None)
 def canonical_prefix(prefix: str) -> str:
     return str(parse_prefix(prefix))
 

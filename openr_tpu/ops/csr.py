@@ -121,12 +121,9 @@ def build_ell(
     s_app, d_app, w_app, u_app, l_app = (
         srcs.append, dsts.append, ws.append, ups.append, edge_links.append
     )
-    for link in sorted(link_state.all_links()):
-        up = link.is_up()
-        n1, n2 = link.n1, link.n2
-        i1, i2 = index[n1], index[n2]
-        w1 = link.metric_from_node(n1)
-        w2 = link.metric_from_node(n2)
+    for link in link_state.ordered_all_links():
+        w1, w2, up = link.mirror_fields()
+        i1, i2 = index[link.n1], index[link.n2]
         s_app(i1); d_app(i2); w_app(w1); u_app(up); l_app(link)
         s_app(i2); d_app(i1); w_app(w2); u_app(up); l_app(link)
 
@@ -213,6 +210,10 @@ class PrefixMatrix:
     # host-side columns for vectorized route materialization
     min_nexthop: np.ndarray = None  # int32 [P_cap, A_cap], -1 = unset
     is_v4: np.ndarray = None  # bool [P_cap]
+    # [p][a] -> PrefixEntry, aligned with node_areas: route entries are
+    # materialized straight from these refs (no PrefixState lookups on
+    # the hot host path)
+    entry_refs: list = None
 
 
 def build_prefix_matrix(
@@ -225,19 +226,32 @@ def build_prefix_matrix(
 ) -> PrefixMatrix:
     """Pack one area's announcer entries into arrays. Announcers outside
     `node_index` (not in this area's graph) are dropped — same effect as
-    the solver's reachability filter for unknown nodes."""
-    all_prefixes = prefixes if prefixes is not None else sorted(prefix_state.prefixes())
+    the solver's reachability filter for unknown nodes.
+
+    `prefixes` entries (and prefix_state keys) are canonical strings, so
+    rows read the state map directly; the common single-announcer row
+    skips the announcer sort."""
+    state_map = prefix_state.prefixes()
+    all_prefixes = prefixes if prefixes is not None else sorted(state_map)
     rows = []
+    a_max = 1
     for pfx in all_prefixes:
-        entries = prefix_state.entries_for(pfx) or {}
-        anns = [
-            (na, e)
-            for na, e in sorted(entries.items())
-            if na[1] == area and na[0] in node_index
-        ]
+        entries = state_map.get(pfx) or {}
+        if len(entries) == 1:
+            na, e = next(iter(entries.items()))
+            anns = (
+                [(na, e)] if na[1] == area and na[0] in node_index else []
+            )
+        else:
+            anns = [
+                (na, e)
+                for na, e in sorted(entries.items())
+                if na[1] == area and na[0] in node_index
+            ]
+            if len(anns) > a_max:
+                a_max = len(anns)
         rows.append((pfx, anns))
     p = len(rows)
-    a_max = max((len(anns) for _, anns in rows), default=1)
     p_cap = max(p_cap, _next_pow2(max(p, 1)))
     a_cap = max(a_cap, _next_pow2(max(a_max, 1), floor=2))
 
@@ -250,21 +264,57 @@ def build_prefix_matrix(
     is_v4 = np.zeros(p_cap, bool)
     prefix_list = []
     node_areas = []
+    entry_refs = []
+    # cell values buffered as tuples, scattered into the padded arrays
+    # in one shot (per-cell numpy scalar stores are ~10x slower at the
+    # 100k-prefix scale)
+    cells: list[tuple] = []
+    cell_append = cells.append
+    pl_append = prefix_list.append
+    na_append = node_areas.append
+    er_append = entry_refs.append
     for pi, (pfx, anns) in enumerate(rows):
-        prefix_list.append(pfx)
-        is_v4[pi] = ":" not in pfx
-        row_nas = []
-        for ai, (na, entry) in enumerate(anns[:a_cap]):
-            ann_node[pi, ai] = node_index[na[0]]
-            ann_valid[pi, ai] = True
+        pl_append(pfx)
+        if len(anns) == 1:
+            na, entry = anns[0]
             m = entry.metrics
-            path_pref[pi, ai] = m.path_preference
-            source_pref[pi, ai] = m.source_preference
-            dist_adv[pi, ai] = m.distance
-            if entry.min_nexthop is not None:
-                min_nexthop[pi, ai] = entry.min_nexthop
+            cell_append((
+                pi, 0, node_index[na[0]], m.path_preference,
+                m.source_preference, m.distance,
+                -1 if entry.min_nexthop is None else entry.min_nexthop,
+            ))
+            na_append([na])
+            er_append([entry])
+            continue
+        row_nas = []
+        row_entries = []
+        for ai, (na, entry) in enumerate(anns[:a_cap]):
+            m = entry.metrics
+            cell_append((
+                pi, ai, node_index[na[0]], m.path_preference,
+                m.source_preference, m.distance,
+                -1 if entry.min_nexthop is None else entry.min_nexthop,
+            ))
             row_nas.append(na)
-        node_areas.append(row_nas)
+            row_entries.append(entry)
+        na_append(row_nas)
+        er_append(row_entries)
+    if p:
+        is_v4[:p] = np.fromiter(
+            (":" not in pfx for pfx in prefix_list), bool, p
+        )
+    if cells:
+        c_pi, c_ai, c_node, c_pp, c_sp, c_da, c_mn = zip(*cells)
+        pi_a = np.asarray(c_pi, np.int64)
+        ai_a = np.asarray(c_ai, np.int64)
+        ann_node[pi_a, ai_a] = c_node
+        ann_valid[pi_a, ai_a] = True
+        path_pref[pi_a, ai_a] = c_pp
+        source_pref[pi_a, ai_a] = c_sp
+        dist_adv[pi_a, ai_a] = np.minimum(
+            np.asarray(c_da, np.int64), int(INF32)
+        )
+        min_nexthop[pi_a, ai_a] = c_mn
     return PrefixMatrix(
         prefix_list=prefix_list,
         node_areas=node_areas,
@@ -275,4 +325,5 @@ def build_prefix_matrix(
         dist_adv=dist_adv,
         min_nexthop=min_nexthop,
         is_v4=is_v4,
+        entry_refs=entry_refs,
     )

@@ -54,16 +54,19 @@ from openr_tpu.decision.link_state import Link, LinkState
 INF32E = np.int32(1 << 29)
 MAX_METRIC = int(1 << 28)
 
-_NAT_RE = re.compile(r"(\d+)")
+_NAT_RE = re.compile(r"\d+")
+_ZFILL = lambda m: m.group().zfill(12)  # noqa: E731
 
 
-def natural_key(name: str):
+def natural_key(name: str) -> str:
     """Numeric-aware sort key: node-10-2 orders after node-2-3. Index
     locality under this ordering is what makes shift classes dense for
-    generated and real-world (rsw001.p002-style) names alike."""
-    return tuple(
-        int(tok) if tok.isdigit() else tok for tok in _NAT_RE.split(name)
-    )
+    generated and real-world (rsw001.p002-style) names alike.
+
+    Digit runs are zero-padded to fixed width so the key is a plain
+    string (C-speed compares, no per-token tuples, and no int-vs-str
+    TypeError when one name has digits where another has letters)."""
+    return _NAT_RE.sub(_ZFILL, name)
 
 
 def _next_pow2(n: int, floor: int = 1) -> int:
@@ -92,8 +95,16 @@ class EdgePlan:
     node_overloaded: np.ndarray  # bool [n_cap]
     node_names: list
     node_index: dict
-    # (link_key, src_name) -> ("s", k, u_idx) | ("r", row, col)
-    edge_loc: dict = field(default_factory=dict)
+    # (link_key, src_name) -> ("s", k, u_idx) | ("r", row, col).
+    # Built LAZILY from the compact location arrays below on the first
+    # delta application (cold full builds never pay the 2E-entry dict)
+    edge_loc: Optional[dict] = None
+    # per-directed-edge slot locations, aligned with _links_sorted order
+    # (edge 2i = links[i].n1 -> n2, edge 2i+1 the reverse)
+    _links_sorted: list = field(default_factory=list)
+    _loc_kind: Optional[np.ndarray] = None  # uint8: 0 = shift, 1 = residual
+    _loc_a: Optional[np.ndarray] = None  # int32: k | row
+    _loc_b: Optional[np.ndarray] = None  # int32: u | col
     # occupancy (a slot with INF weight may still be owned by a down link)
     _shift_occ: Optional[np.ndarray] = None  # bool [s_cap, n_cap]
     _res_row_of: dict = field(default_factory=dict)  # v_idx -> row
@@ -136,6 +147,28 @@ def _effective_w(link: Link, src: str, overloaded_src: bool) -> int:
     return min(link.metric_from_node(src), MAX_METRIC)
 
 
+def _ensure_edge_loc(plan: EdgePlan) -> dict:
+    """Materialize the (link, src_name) -> slot-location dict from the
+    compact per-edge arrays. Deferred so cold full builds skip it; the
+    first apply_events call pays it once per rebuild."""
+    if plan.edge_loc is None:
+        kinds = plan._loc_kind.tolist()
+        las = plan._loc_a.tolist()
+        lbs = plan._loc_b.tolist()
+        d = {}
+        for i, link in enumerate(plan._links_sorted):
+            e = 2 * i
+            d[(link, link.n1)] = (
+                ("s", las[e], lbs[e]) if kinds[e] == 0 else ("r", las[e], lbs[e])
+            )
+            e += 1
+            d[(link, link.n2)] = (
+                ("s", las[e], lbs[e]) if kinds[e] == 0 else ("r", las[e], lbs[e])
+            )
+        plan.edge_loc = d
+    return plan.edge_loc
+
+
 def build_plan(
     link_state: LinkState,
     n_cap: int = 0,
@@ -144,73 +177,118 @@ def build_plan(
     prev: Optional[EdgePlan] = None,
 ) -> EdgePlan:
     """Full build: natural-order the nodes, histogram index deltas, keep
-    the top classes, spill the rest to the residual ELL."""
-    names = sorted(link_state.get_adjacency_databases().keys(), key=natural_key)
+    the top classes, spill the rest to the residual ELL.
+
+    Fully vectorized over directed-edge arrays — the only Python-level
+    per-link work is one sort key, one index lookup per endpoint and one
+    mirror_fields() call; slot assignment (first edge per (class, src)
+    wins), residual grouping and the location tables are numpy. The
+    (link, src) -> slot dict is deferred to the first delta application
+    (_ensure_edge_loc), so a cold daemon start never builds it."""
+    adj_dbs = link_state.get_adjacency_databases()
+    names = None
+    if prev is not None and adj_dbs.keys() == set(prev.node_names):
+        names = prev.node_names  # node set unchanged: skip the re-sort
+    if names is None:
+        names = sorted(adj_dbs.keys(), key=natural_key)
     index = {n: i for i, n in enumerate(names)}
     n = len(names)
     if prev is not None:
         n_cap = max(n_cap, prev.n_cap)
     n_cap = max(n_cap, _next_pow2(max(n, 1), 8))
 
-    # directed edge extraction (one tight pass; full builds are rare —
-    # steady-state churn goes through apply_events)
-    links_sorted = sorted(link_state.all_links())
-    e2 = len(links_sorted) * 2
-    src = np.empty(e2, np.int32)
-    dst = np.empty(e2, np.int32)
-    w = np.empty(e2, np.int32)
-    overload = link_state.is_node_overloaded
     node_over = np.zeros(n_cap, bool)
-    for i, nm in enumerate(names):
-        node_over[i] = overload(nm)
-    for e, link in enumerate(links_sorted):
-        i1, i2 = index[link.n1], index[link.n2]
-        src[2 * e] = i1
-        dst[2 * e] = i2
-        w[2 * e] = _effective_w(link, link.n1, node_over[i1])
-        src[2 * e + 1] = i2
-        dst[2 * e + 1] = i1
-        w[2 * e + 1] = _effective_w(link, link.n2, node_over[i2])
+    for nm in link_state.overloaded_nodes():
+        i = index.get(nm)
+        if i is not None:
+            node_over[i] = True
 
-    delta = dst - src
-    # class selection: most-populous deltas, subject to a usefulness floor
-    if e2:
+    # directed edge extraction: edge 2i = links[i].n1 -> n2, 2i+1 reverse
+    links_sorted = link_state.ordered_all_links()
+    m = len(links_sorted)
+    e2 = m * 2
+    if m:
+        n1i = np.fromiter(
+            (index[l.n1] for l in links_sorted), np.int32, m
+        )
+        n2i = np.fromiter(
+            (index[l.n2] for l in links_sorted), np.int32, m
+        )
+        trip = np.array(
+            [l.mirror_fields() for l in links_sorted], np.int64
+        )  # [m, 3]: w12, w21, up
+        src = np.empty(e2, np.int32)
+        dst = np.empty(e2, np.int32)
+        wdir = np.empty(e2, np.int64)
+        src[0::2] = n1i
+        src[1::2] = n2i
+        dst[0::2] = n2i
+        dst[1::2] = n1i
+        wdir[0::2] = trip[:, 0]
+        wdir[1::2] = trip[:, 1]
+        up2 = np.repeat(trip[:, 2].astype(bool), 2)
+        w = np.where(
+            up2 & ~node_over[src],
+            np.minimum(wdir, MAX_METRIC),
+            int(INF32E),
+        ).astype(np.int32)
+        delta = dst - src
+        # class selection: most-populous deltas above a usefulness floor
         vals, counts = np.unique(delta, return_counts=True)
         order = np.argsort(-counts)
         floor = max(8, int(e2 * min_class_frac))
         chosen = [int(vals[o]) for o in order[:s_max] if counts[o] >= floor]
     else:
+        src = dst = delta = np.empty(0, np.int32)
+        w = np.empty(0, np.int32)
         chosen = []
     s_cap = _next_pow2(max(len(chosen), 1), 4)
     if prev is not None:
         s_cap = max(s_cap, prev.s_cap)
     deltas = np.zeros(s_cap, np.int32)
     deltas[: len(chosen)] = chosen
-    class_of = {d: k for k, d in enumerate(chosen)}
 
     shift_w = np.full((s_cap, n_cap), INF32E, np.int32)
     shift_occ = np.zeros((s_cap, n_cap), bool)
-    edge_loc: dict = {}
-    res_edges: list = []  # (v, u, w, link, src_name)
+    loc_kind = np.zeros(e2, np.uint8)
+    loc_a = np.zeros(e2, np.int32)
+    loc_b = np.zeros(e2, np.int32)
 
-    for e in range(e2):
-        link = links_sorted[e // 2]
-        u, v = int(src[e]), int(dst[e])
-        src_name = names[u]
-        k = class_of.get(int(delta[e]))
-        if k is not None and not shift_occ[k, u]:
-            shift_occ[k, u] = True
-            shift_w[k, u] = w[e]
-            edge_loc[(link, src_name)] = ("s", k, u)
-        else:
-            res_edges.append((v, u, int(w[e]), link, src_name))
+    if chosen:
+        # delta value -> class index, vectorized through a sorted view
+        chosen_arr = np.array(chosen, np.int32)
+        sort_ix = np.argsort(chosen_arr)
+        sorted_vals = chosen_arr[sort_ix]
+        pos = np.searchsorted(sorted_vals, delta)
+        pos_c = np.clip(pos, 0, len(chosen) - 1)
+        in_class = sorted_vals[pos_c] == delta
+        k_of = sort_ix[pos_c].astype(np.int32)
+        # first edge (in edge order) per (class, src) occupies the slot
+        elig = np.flatnonzero(in_class)
+        key = k_of[elig].astype(np.int64) * n_cap + src[elig]
+        _, first = np.unique(key, return_index=True)
+        shift_edges = elig[first]
+        ks, us = k_of[shift_edges], src[shift_edges]
+        shift_occ[ks, us] = True
+        shift_w[ks, us] = w[shift_edges]
+        is_shift = np.zeros(e2, bool)
+        is_shift[shift_edges] = True
+        loc_a[shift_edges] = ks
+        loc_b[shift_edges] = us
+        res_idx = np.flatnonzero(~is_shift)
+    else:
+        res_idx = np.arange(e2)
 
-    res_count: dict[int, int] = {}
-    for v, _u, _w, _l, _s in res_edges:
-        res_count[v] = res_count.get(v, 0) + 1
-    k_res = max(res_count.values()) if res_count else 0
+    # residual ELL: group leftover edges by destination (row-compact)
+    rv = dst[res_idx]
+    order2 = np.argsort(rv, kind="stable")  # edge order within a group
+    res_sorted = res_idx[order2]
+    sv = rv[order2]
+    uniq_v, first_v = np.unique(sv, return_index=True)
+    n_rows = len(uniq_v)
+    group_counts = np.diff(np.r_[first_v, len(sv)]).astype(np.int32)
+    k_res = int(group_counts.max()) if n_rows else 0
     k_cap = _next_pow2(max(k_res, 1), 2)
-    n_rows = len(res_count)
     r_cap = _next_pow2(max(n_rows, 1), 8)
     if prev is not None and prev.k_res:
         k_cap = max(k_cap, prev.res_nbr.shape[1])
@@ -218,18 +296,23 @@ def build_plan(
     res_rows = np.full(r_cap, -1, np.int32)
     res_nbr = np.full((r_cap, k_cap), -1, np.int32)
     res_w = np.full((r_cap, k_cap), INF32E, np.int32)
-    row_of: dict[int, int] = {}
-    for row, v in enumerate(sorted(res_count)):
-        res_rows[row] = v
-        row_of[v] = row
     fill = np.zeros(r_cap, np.int32)
-    for v, u, we, link, src_name in res_edges:
-        row = row_of[v]
-        col = int(fill[row])
-        fill[row] = col + 1
-        res_nbr[row, col] = u
-        res_w[row, col] = we
-        edge_loc[(link, src_name)] = ("r", row, col)
+    if n_rows:
+        res_rows[:n_rows] = uniq_v
+        rows_per_edge = np.repeat(
+            np.arange(n_rows, dtype=np.int32), group_counts
+        )
+        cols_per_edge = (
+            np.arange(len(sv), dtype=np.int32)
+            - np.repeat(first_v.astype(np.int32), group_counts)
+        )
+        res_nbr[rows_per_edge, cols_per_edge] = src[res_sorted]
+        res_w[rows_per_edge, cols_per_edge] = w[res_sorted]
+        fill[:n_rows] = group_counts
+        loc_kind[res_sorted] = 1
+        loc_a[res_sorted] = rows_per_edge
+        loc_b[res_sorted] = cols_per_edge
+    row_of = {int(v): r for r, v in enumerate(uniq_v)}
 
     index_version = 0
     if prev is not None:
@@ -252,7 +335,11 @@ def build_plan(
         node_overloaded=node_over,
         node_names=names,
         node_index=index,
-        edge_loc=edge_loc,
+        edge_loc=None,
+        _links_sorted=links_sorted,
+        _loc_kind=loc_kind,
+        _loc_a=loc_a,
+        _loc_b=loc_b,
         _shift_occ=shift_occ,
         _res_row_of=row_of,
         _res_fill=fill,
@@ -365,6 +452,7 @@ def apply_events(
     plan: EdgePlan, link_state: LinkState, events: list[tuple]
 ) -> bool:
     """Apply a changelog slice; returns False when a rebuild is needed."""
+    _ensure_edge_loc(plan)
     for ev in events:
         kind = ev[0]
         if kind == "nodes":

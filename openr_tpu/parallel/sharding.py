@@ -65,7 +65,8 @@ def make_mesh(n_devices: Optional[int] = None, batch: Optional[int] = None):
 @functools.lru_cache(maxsize=8)
 def _sharded_fabric_fn(mesh, n_cap: int, s_cap: int, r_cap: int,
                        kr_cap: int, has_res: bool, d_cap: int,
-                       p_cap: int, a_cap: int, n_trips: int):
+                       p_cap: int, a_cap: int, n_trips: int,
+                       lfa: bool = False):
     """shard_mapped whole-fabric pipeline: for each root (sharded over
     'batch'), batched-seed SSSP with graph-axis-sharded weights, then
     best-route selection. Returns (dist[R, N], metric[R, P],
@@ -167,7 +168,37 @@ def _sharded_fabric_fn(mesh, n_cap: int, s_cap: int, r_cap: int,
             s4 = s3 & (igp == metric[:, None])
             on_sp = (via == dist[None, :]).T
             nh_mask = jnp.any(s4[:, :, None] & on_sp[idx], axis=1)
-            return dist, metric, nh_mask, converged
+            if lfa:
+                # rfc5286 alternates, same predicate as the single-chip
+                # pipeline (tpu_solver._plan_pipeline): neighbor slot d
+                # backs up prefix p iff its own distance to the selected
+                # announcers beats detouring back through this root
+                d_root = dist_d[:, root]
+                ann_nd = dist_d.T[idx]  # [P, A, D]
+                nbr_pd = jnp.where(
+                    s3[:, :, None], ann_nd, INF_E
+                ).min(axis=1)
+                link_up = seeds_w < INF_E
+                ok_lfa = (
+                    link_up[None, :]
+                    & ~nh_mask
+                    & (nbr_pd < INF_E)
+                    & (nbr_pd < d_root[None, :] + metric[:, None])
+                )
+                alt = jnp.where(
+                    ok_lfa, seeds_w[None, :] + nbr_pd, jnp.int32(1 << 30)
+                )
+                has_lfa = ok_lfa.any(axis=1)
+                lfa_slot = jnp.where(
+                    has_lfa,
+                    jnp.argmin(alt, axis=1).astype(jnp.int32),
+                    -1,
+                )
+                lfa_metric = jnp.where(has_lfa, alt.min(axis=1), 0)
+            else:
+                lfa_slot = jnp.full((p_cap,), -1, jnp.int32)
+                lfa_metric = jnp.zeros((p_cap,), jnp.int32)
+            return dist, metric, s3, nh_mask, lfa_slot, lfa_metric, converged
 
         return jax.vmap(one_root)(roots, root_nbr, root_w)
 
@@ -192,11 +223,18 @@ def _sharded_fabric_fn(mesh, n_cap: int, s_cap: int, r_cap: int,
                 P("batch", None),
                 P("batch", None),
                 P("batch", None, None),
+                P("batch", None, None),
+                P("batch", None),
+                P("batch", None),
                 P("batch"),
             ),
             check_vma=False,
         )
     )
+
+
+class Unconverged(AssertionError):
+    """The fixed trip bound was below the graph's diameter bound."""
 
 
 def pad_to(arr: np.ndarray, size: int, fill, axis: int = 0) -> np.ndarray:
@@ -208,7 +246,8 @@ def pad_to(arr: np.ndarray, size: int, fill, axis: int = 0) -> np.ndarray:
 
 
 def sharded_fabric_step(mesh, plan, matrix, roots, out_nbr, out_w,
-                        n_trips: int, check_convergence: bool = True):
+                        n_trips: int, check_convergence: bool = True,
+                        lfa: bool = False):
     """Run the sharded whole-fabric pipeline.
 
     plan: ops.edgeplan.EdgePlan; matrix: ops.csr.PrefixMatrix;
@@ -219,9 +258,14 @@ def sharded_fabric_step(mesh, plan, matrix, roots, out_nbr, out_w,
     its eccentricity, and another root's can be up to ~2x that). The
     kernel emits a per-root convergence verdict (one extra relaxation
     must be a fixpoint no-op); with check_convergence the verdict is
-    asserted host-side, so an insufficient bound fails loudly.
+    asserted host-side (raising Unconverged), so an insufficient bound
+    fails loudly — TpuSpfSolver.build_fabric_route_dbs catches it and
+    retries with a doubled bound.
 
-    Returns (dist [Rt, N_cap], metric [Rt, P_cap], nh_mask [Rt, P_cap, D]).
+    Returns (dist [Rt, N_cap], metric [Rt, P_cap], s3 [Rt, P_cap, A]
+    selected-announcer masks, nh_mask [Rt, P_cap, D], lfa_slot
+    [Rt, P_cap] (-1 = none; only meaningful with lfa=True), lfa_metric
+    [Rt, P_cap]).
     """
     g = mesh.shape["graph"]
     n_cap = plan.n_cap
@@ -242,9 +286,9 @@ def sharded_fabric_step(mesh, plan, matrix, roots, out_nbr, out_w,
 
     fn = _sharded_fabric_fn(
         mesh, n_cap, plan.s_cap, r_cap, kr_cap, has_res, d_cap,
-        p_cap, a_cap, n_trips,
+        p_cap, a_cap, n_trips, lfa,
     )
-    dist, metric, nh_mask, converged = fn(
+    dist, metric, s3, nh_mask, lfa_slot, lfa_metric, converged = fn(
         plan.deltas, plan.shift_w, res_rows, res_nbr, res_w,
         roots.astype(np.int32), out_nbr.astype(np.int32),
         out_w.astype(np.int32),
@@ -253,8 +297,9 @@ def sharded_fabric_step(mesh, plan, matrix, roots, out_nbr, out_w,
     )
     if check_convergence:
         conv = np.asarray(converged)
-        assert conv.all(), (
-            f"sharded SSSP unconverged for roots "
-            f"{np.asarray(roots)[~conv].tolist()}: raise n_trips ({n_trips})"
-        )
-    return dist, metric, nh_mask
+        if not conv.all():
+            raise Unconverged(
+                f"sharded SSSP unconverged for roots "
+                f"{np.asarray(roots)[~conv].tolist()}: raise n_trips ({n_trips})"
+            )
+    return dist, metric, s3, nh_mask, lfa_slot, lfa_metric

@@ -7,7 +7,7 @@ assert the emitted DecisionRouteUpdate deltas, for both solver backends.
 import asyncio
 
 from openr_tpu.config import DecisionConfig
-from openr_tpu.decision.decision import Decision
+from openr_tpu.decision.decision import Decision, make_solver
 from openr_tpu.decision.rib import RouteUpdateType
 from openr_tpu.decision.rib_policy import (
     RibPolicy,
@@ -411,3 +411,42 @@ class TestRibPolicyExpiry:
             # expiry re-arms a rebuild with the policy inactive: route back
             update = await h.next_route_update(timeout=5)
             assert "10.0.0.2/32" in update.unicast_routes_to_update
+
+
+class TestFabricRouteDbs:
+    @run_async
+    async def test_fabric_route_dbs_both_backends(self):
+        """Decision.get_fabric_route_dbs (the ctrl fabric_routes surface)
+        returns every vantage's RIB, identically on the sharded TPU path
+        and the per-vantage CPU fallback — including flags like
+        enable_lfa that the fallback must not drop."""
+        results = {}
+        for backend in ("cpu", "tpu"):
+            async with DecisionHarness(backend=backend) as h:
+                h.decision.solver = make_solver(
+                    "1", backend, enable_lfa=True
+                )
+                h.publish(
+                    adj_db_kv("1", [adj("1", "2"), adj("1", "3")]),
+                    adj_db_kv("2", [adj("2", "1"), adj("2", "4")]),
+                    adj_db_kv("3", [adj("3", "1"), adj("3", "4")]),
+                    adj_db_kv("4", [adj("4", "2"), adj("4", "3")]),
+                )
+                h.publish(
+                    prefix_db_kv("2", "10.0.0.2/32"),
+                    prefix_db_kv("4", "10.0.0.4/32"),
+                )
+                h.synced()
+                await h.next_route_update()
+                dbs = await h.decision.get_fabric_route_dbs()
+                assert set(dbs) == {"1", "2", "3", "4"}
+                results[backend] = {
+                    n: db.unicast_routes for n, db in dbs.items()
+                }
+                # unknown vantage -> None
+                sub = await h.decision.get_fabric_route_dbs(["2", "ghost"])
+                assert sub["ghost"] is None
+                assert sub["2"].unicast_routes == results[backend]["2"]
+        # equality above ran with enable_lfa=True on both backends, so a
+        # fallback that dropped the flag would have diverged
+        assert results["cpu"] == results["tpu"]

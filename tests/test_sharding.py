@@ -1,0 +1,155 @@
+"""Multi-chip sharded fabric-path tests, on the virtual 8-CPU device mesh
+(conftest sets xla_force_host_platform_device_count=8).
+
+The sharded pipeline (parallel/sharding.py) computes EVERY vantage's
+routes in one pass: roots data-parallel over the 'batch' mesh axis, the
+graph's node columns sharded over 'graph' with a pmin halo exchange per
+relaxation. TpuSpfSolver.build_fabric_route_dbs wraps it with trip-bound
+derivation (measured single-chip trips, convergence-vote verified,
+doubling retry) and full route materialization; results must equal the
+per-vantage CPU oracle exactly.
+"""
+
+import numpy as np
+
+from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.decision.tpu_solver import TpuSpfSolver
+from openr_tpu.models import topologies
+from openr_tpu.parallel.sharding import Unconverged, make_mesh, sharded_fabric_step
+from openr_tpu.types import Adjacency, AdjacencyDatabase
+from tests.test_tpu_solver import assert_rib_equal
+
+
+def test_make_mesh_factors_devices():
+    mesh = make_mesh(8)
+    assert mesh.shape["batch"] * mesh.shape["graph"] == 8
+    assert mesh.shape["graph"] == 2  # both axes exercised at >= 4 devices
+
+
+def fabric_vs_oracle(states, ps, roots, mesh=None, **solver_kw):
+    tpu = TpuSpfSolver(roots[0], **solver_kw)
+    dbs = tpu.build_fabric_route_dbs(roots, states, ps, mesh=mesh)
+    for root in roots:
+        cpu_db = SpfSolver(root, **solver_kw).build_route_db(root, states, ps)
+        if cpu_db is None:
+            assert dbs[root] is None, root
+            continue
+        assert_rib_equal(cpu_db, dbs[root], f"fabric vantage {root}")
+    return tpu, dbs
+
+
+def test_fabric_route_dbs_grid_all_vantage_parity():
+    adj_dbs, prefix_dbs = topologies.grid(8)
+    states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    roots = [db.this_node_name for db in adj_dbs[::7]]  # 10 vantages
+    tpu, dbs = fabric_vs_oracle(states, ps, roots, mesh=make_mesh(8))
+    assert len(dbs) == len(roots)
+
+
+def test_fabric_route_dbs_with_lfa():
+    """LFA backups computed on the sharded path match the oracle."""
+    adj_dbs, prefix_dbs = topologies.grid(6)
+    states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    roots = ["node-0-0", "node-2-3", "node-5-5"]
+    # parity incl. lfa_nexthops is asserted inside fabric_vs_oracle
+    fabric_vs_oracle(states, ps, roots, enable_lfa=True)
+
+
+def test_fabric_route_dbs_drained_and_churn():
+    adj_dbs, prefix_dbs = topologies.random_mesh(30, seed=3)
+    states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    ls = states["0"]
+    victim = next(d for d in adj_dbs if d.this_node_name == "node-7")
+    ls.update_adjacency_database(
+        AdjacencyDatabase(
+            this_node_name="node-7",
+            adjacencies=victim.adjacencies,
+            is_overloaded=True,
+            area="0",
+        )
+    )
+    roots = ["node-0", "node-7", "node-15"]
+    tpu, _ = fabric_vs_oracle(states, ps, roots)
+    # metric churn, then the same solver instance recomputes correctly
+    ls.update_adjacency_database(
+        AdjacencyDatabase(
+            this_node_name="node-3",
+            adjacencies=tuple(
+                Adjacency(**{**a.__dict__, "metric": 9})
+                for a in next(
+                    d for d in adj_dbs if d.this_node_name == "node-3"
+                ).adjacencies
+            ),
+            area="0",
+        )
+    )
+    dbs = tpu.build_fabric_route_dbs(roots, states, ps)
+    for root in roots:
+        cpu_db = SpfSolver(root).build_route_db(root, states, ps)
+        assert_rib_equal(cpu_db, dbs[root], f"after churn {root}")
+
+
+def test_fabric_unknown_root_returns_none():
+    adj_dbs, prefix_dbs = topologies.grid(4)
+    states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    tpu = TpuSpfSolver("node-0-0")
+    dbs = tpu.build_fabric_route_dbs(
+        ["node-0-0", "not-a-node"], states, ps
+    )
+    assert dbs["not-a-node"] is None
+    assert dbs["node-0-0"] is not None
+
+
+def test_fabric_trip_bound_retry_from_cold_solver():
+    """A fresh solver has no measured trip count (last_trips == 0); the
+    seed bound is tiny and the convergence vote must drive the doubling
+    retry to a correct result on a high-diameter graph."""
+    adj_dbs, prefix_dbs = topologies.grid(8)
+    states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    tpu = TpuSpfSolver("node-0-0")
+    assert tpu.last_trips == 0
+    dbs = tpu.build_fabric_route_dbs(["node-0-0", "node-7-7"], states, ps)
+    cpu_db = SpfSolver("node-0-0").build_route_db("node-0-0", states, ps)
+    assert_rib_equal(cpu_db, dbs["node-0-0"], "retry path")
+
+
+def test_sharded_step_unconverged_raises():
+    """Directly under-bound the trip count: the kernel's convergence
+    vote must raise instead of returning too-large distances."""
+    from openr_tpu.ops.csr import build_prefix_matrix
+    from openr_tpu.ops.edgeplan import INF32E, build_plan
+
+    adj_dbs, prefix_dbs = topologies.grid(10, node_labels=False)
+    states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    ls = states["0"]
+    plan = build_plan(ls)
+    matrix = build_prefix_matrix(ps, plan.node_index, "0")
+    mesh = make_mesh(4)
+    batch = mesh.shape["batch"]
+    roots_names = [plan.node_names[0]] * batch
+    roots = np.array([plan.node_index[n] for n in roots_names], np.int32)
+    outs = [plan.out_links(ls, n) for n in roots_names]
+    d_cap = max(o[0].shape[0] for o in outs)
+    out_nbr = np.full((batch, d_cap), -1, np.int32)
+    out_w = np.full((batch, d_cap), int(INF32E), np.int32)
+    for i, (nbr, w, _l) in enumerate(outs):
+        out_nbr[i, : nbr.shape[0]] = nbr
+        out_w[i, : w.shape[0]] = w
+    try:
+        sharded_fabric_step(mesh, plan, matrix, roots, out_nbr, out_w, 1)
+    except Unconverged:
+        pass
+    else:  # pragma: no cover
+        raise AssertionError("expected Unconverged for a 1-trip bound")
+
+
+def test_fabric_matches_single_chip_solver():
+    """The sharded path and the single-chip resident pipeline are two
+    implementations of the same function."""
+    adj_dbs, prefix_dbs = topologies.grid(6)
+    states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    single = TpuSpfSolver("node-3-3")
+    single_db = single.build_route_db("node-3-3", states, ps)
+    fabric = TpuSpfSolver("node-3-3")
+    dbs = fabric.build_fabric_route_dbs(["node-3-3"], states, ps)
+    assert_rib_equal(single_db, dbs["node-3-3"], "single vs fabric")
