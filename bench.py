@@ -238,10 +238,12 @@ def main() -> None:
         enable_lfa=True,
     )
 
-    # 4: 50k-node WAN (KSP2 segment-routing subset pending device KSP2)
+    # 4: 50k-node WAN with a segment-routed KSP2 subset (every 768th
+    # node's loopback is SR_MPLS + KSP2_ED_ECMP -> 64 destinations whose
+    # per-destination second-pass SPFs batch on device, ops/ksp2.py)
     run(
         "wan50k",
-        lambda: topologies.wan(regions=48, region_side=32),
+        lambda: topologies.wan(regions=48, region_side=32, ksp2_every=768),
         "r00-n08-08",
     )
 

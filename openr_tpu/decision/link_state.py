@@ -644,22 +644,83 @@ class LinkState:
 
     # -- k edge-disjoint paths (ref LinkState.cpp:790-819) -----------------
 
-    def _trace_one_path(
-        self, src: str, dest: str, result: SpfResult, links_to_ignore: set[Link]
+    def _trace_one_on_dist(
+        self,
+        src: str,
+        v: str,
+        dist_of,
+        excluded: set[Link],
+        visited: set[Link],
     ) -> Optional[Path]:
-        """DFS one src->dest path over the SPF DAG, consuming links
-        (ref LinkState.cpp:418-439)."""
-        if src == dest:
+        """DFS one src->v path backward over the shortest-path DAG implied
+        by a distance field (ref traceOnePath, LinkState.cpp:418-439).
+
+        A link (u, v) is a DAG edge iff dist(u) + w(u->v) == dist(v), the
+        link is up and not excluded/consumed, and u may transit (src is
+        exempt from its own overload, matching run_spf). Candidates are
+        tried in CANONICAL order — (dist(u), u, link key) — so the traced
+        paths depend only on the distance VALUES, not on which engine
+        produced them: the CPU run_spf field and the TPU batched masked
+        SSSP field (ops/ksp2.py) yield identical paths by construction.
+        Tried links are consumed even when the branch dead-ends (same
+        greedy semantics as the reference)."""
+        if v == src:
             return []
-        for path_link in result[dest].path_links:
-            if path_link.link in links_to_ignore:
+        dv = dist_of(v)
+        cands = []
+        for link in self._link_map.get(v, ()):
+            if link in excluded or link in visited or not link.is_up():
                 continue
-            links_to_ignore.add(path_link.link)
-            path = self._trace_one_path(src, path_link.prev_node, result, links_to_ignore)
+            u = link.other_node(v)
+            if u != src and self.is_node_overloaded(u):
+                continue
+            du = dist_of(u)
+            if du is None or du + link.metric_from_node(u) != dv:
+                continue
+            cands.append((du, u, link._sort_key, link))
+        cands.sort()
+        for du, u, _key, link in cands:
+            visited.add(link)
+            path = self._trace_one_on_dist(src, u, dist_of, excluded, visited)
             if path is not None:
-                path.append(path_link.link)
+                path.append(link)
                 return path
         return None
+
+    def trace_paths_on_dist(
+        self, src: str, dest: str, dist_of, excluded: set[Link]
+    ) -> list[Path]:
+        """All greedily-consumable edge-disjoint shortest src->dest paths
+        of the DAG implied by a distance field. dist_of(node) -> metric
+        or None (unreachable). Shared by get_kth_paths (CPU field) and
+        the device-assisted KSP2 second pass (TPU field)."""
+        paths: list[Path] = []
+        if dist_of(dest) is None:
+            return paths
+        visited: set[Link] = set()
+        while True:
+            path = self._trace_one_on_dist(src, dest, dist_of, excluded, visited)
+            if not path:
+                break
+            paths.append(path)
+        return paths
+
+    def prime_kth_paths(self, src: str, dest: str, k: int, paths: list) -> None:
+        """Install an externally-computed result into the k-paths cache
+        (the TPU solver batches the k=2 masked SSSPs on device and primes
+        here; SpfSolver then assembles KSP2 routes through the unchanged
+        code path). The cache clears on any topology change, like the SPF
+        memo."""
+        self._kth_paths[(src, dest, k)] = paths
+
+    def kth_paths_ignore_set(self, src: str, dest: str, k: int) -> set[Link]:
+        """Union of links on all (k-1)th-and-below paths — what the kth
+        SPF pass must exclude."""
+        links_to_ignore: set[Link] = set()
+        for i in range(1, k):
+            for path in self.get_kth_paths(src, dest, i):
+                links_to_ignore.update(path)
+        return links_to_ignore
 
     def get_kth_paths(self, src: str, dest: str, k: int) -> list[Path]:
         assert k >= 1
@@ -667,23 +728,18 @@ class LinkState:
         cached = self._kth_paths.get(key)
         if cached is not None:
             return cached
-        links_to_ignore: set[Link] = set()
-        for i in range(1, k):
-            for path in self.get_kth_paths(src, dest, i):
-                links_to_ignore.update(path)
-        paths: list[Path] = []
+        links_to_ignore = self.kth_paths_ignore_set(src, dest, k)
         res = (
             self.get_spf_result(src, True)
             if not links_to_ignore
             else self.run_spf(src, True, links_to_ignore)
         )
-        if dest in res:
-            visited: set[Link] = set()
-            while True:
-                path = self._trace_one_path(src, dest, res, visited)
-                if not path:
-                    break
-                paths.append(path)
+
+        def dist_of(n, _res=res):
+            node = _res.get(n)
+            return None if node is None else node.metric
+
+        paths = self.trace_paths_on_dist(src, dest, dist_of, links_to_ignore)
         self._kth_paths[key] = paths
         return paths
 

@@ -525,6 +525,20 @@ def _fast_path_eligible(entries) -> bool:
     return True
 
 
+def _ksp2_eligible(entries) -> bool:
+    """KSP2 prefixes (SR_MPLS + KSP2_ED_ECMP on every announcement) get
+    the device-assisted path: batched masked SSSP for the per-destination
+    second pass, oracle code for selection/trace/label assembly."""
+    for entry in entries.values():
+        if (
+            entry.forwarding_type != PrefixForwardingType.SR_MPLS
+            or entry.forwarding_algorithm
+            != PrefixForwardingAlgorithm.KSP2_ED_ECMP
+        ):
+            return False
+    return True
+
+
 class TpuSpfSolver:
     """Drop-in replacement for SpfSolver.build_route_db with the hot path
     on device. Differentially tested against the CPU oracle."""
@@ -613,13 +627,7 @@ class TpuSpfSolver:
                 my_node_name, area_link_states, prefix_state
             )
 
-        if self._partition is not None and self._partition[0] == prefix_state.generation:
-            fast, slow = self._partition[1], self._partition[2]
-        else:
-            fast, slow = [], []
-            for prefix, entries in prefix_state.prefixes().items():
-                (fast if _fast_path_eligible(entries) else slow).append(prefix)
-            self._partition = (prefix_state.generation, fast, slow)
+        fast, slow, ksp2 = self._partition_prefixes(prefix_state)
 
         route_db = DecisionRouteDb()
         finish_fast = None
@@ -631,13 +639,35 @@ class TpuSpfSolver:
             finish_fast = self._solve_fast(
                 my_node_name, area, link_state, prefix_state, fast
             )
+        if ksp2:
+            # batch the per-destination second-pass SSSPs on device and
+            # prime the k-paths cache; the oracle loop below then
+            # assembles KSP2 routes through its unchanged code path
+            self._prime_ksp2(
+                my_node_name, area, link_state, prefix_state, ksp2, fast
+            )
 
         self._host_routes(
-            my_node_name, area_link_states, prefix_state, slow, route_db
+            my_node_name, area_link_states, prefix_state,
+            slow + ksp2, route_db,
         )
         if finish_fast is not None:
             finish_fast(route_db)
         return route_db
+
+    def _partition_prefixes(self, prefix_state: PrefixState):
+        if self._partition is not None and self._partition[0] == prefix_state.generation:
+            return self._partition[1], self._partition[2], self._partition[3]
+        fast, slow, ksp2 = [], [], []
+        for prefix, entries in prefix_state.prefixes().items():
+            if _fast_path_eligible(entries):
+                fast.append(prefix)
+            elif _ksp2_eligible(entries):
+                ksp2.append(prefix)
+            else:
+                slow.append(prefix)
+        self._partition = (prefix_state.generation, fast, slow, ksp2)
+        return fast, slow, ksp2
 
     def _host_routes(
         self, my_node_name, area_link_states, prefix_state, slow, route_db
@@ -702,13 +732,7 @@ class TpuSpfSolver:
             }
         area, link_state = next(iter(area_link_states.items()))
 
-        if self._partition is not None and self._partition[0] == prefix_state.generation:
-            fast, slow = self._partition[1], self._partition[2]
-        else:
-            fast, slow = [], []
-            for prefix, entries in prefix_state.prefixes().items():
-                (fast if _fast_path_eligible(entries) else slow).append(prefix)
-            self._partition = (prefix_state.generation, fast, slow)
+        fast, slow, ksp2 = self._partition_prefixes(prefix_state)
 
         result: dict[str, Optional[DecisionRouteDb]] = {}
         known = [r for r in root_names if link_state.has_node(r)]
@@ -777,8 +801,14 @@ class TpuSpfSolver:
             db = result.get(nm)
             if db is None:
                 db = result[nm] = DecisionRouteDb()
+            if ksp2:
+                # one batched masked-SSSP device pass per vantage instead
+                # of one host Dijkstra per (vantage, KSP2 destination)
+                self._prime_ksp2(
+                    nm, area, link_state, prefix_state, ksp2, fast
+                )
             self._host_routes(
-                nm, area_link_states, prefix_state, slow, db
+                nm, area_link_states, prefix_state, slow + ksp2, db
             )
         return result
 
@@ -988,6 +1018,96 @@ class TpuSpfSolver:
             }
 
         return finish
+
+    # -- device-assisted KSP2 ----------------------------------------------
+
+    def _prime_ksp2(
+        self, my_node_name, area, link_state, prefix_state, prefixes, fast
+    ) -> None:
+        """Batch the k=2 masked SSSPs for every KSP2 destination in one
+        device pass and prime LinkState's k-paths cache, so the oracle's
+        unchanged KSP2 assembly (selection, canonical trace, label
+        stacks — spf_solver._select_best_paths_ksp2) consumes device
+        distance fields instead of one host Dijkstra per destination.
+        Parity is structural: the masked fields equal run_spf's metrics
+        (SSSP has unique values), and the canonical trace depends only on
+        those values. Ref hot loop replaced:
+        openr/decision/LinkState.cpp:790-819."""
+        from openr_tpu.ops.edgeplan import _ensure_edge_loc
+        from openr_tpu.ops.ksp2 import masked_sssp_batch
+
+        import jax
+
+        ad = self._sync_area(area, link_state, prefix_state, fast)
+        plan = ad.plan
+        edge_loc = _ensure_edge_loc(plan)
+
+        dests = sorted({
+            node
+            for pfx in prefixes
+            for (node, a) in (prefix_state.entries_for(pfx) or {})
+            if a == area
+            and node != my_node_name
+            and link_state.has_node(node)
+        })
+        jobs = []  # (dest, ignore_set, mask_locs)
+        for dest in dests:
+            if (my_node_name, dest, 2) in link_state._kth_paths:
+                continue
+            # k=1 from the shared memoized SPF (one host Dijkstra total,
+            # which the oracle's reachability filter needs anyway)
+            first = link_state.get_kth_paths(my_node_name, dest, 1)
+            if not first:
+                link_state.prime_kth_paths(my_node_name, dest, 2, [])
+                continue
+            ignore = link_state.kth_paths_ignore_set(my_node_name, dest, 2)
+            locs = []
+            for link in ignore:
+                locs.append(edge_loc[(link, link.n1)])
+                locs.append(edge_loc[(link, link.n2)])
+            jobs.append((dest, ignore, locs))
+        if not jobs:
+            return
+
+        d_shift_w, d_res_w = ad.d_shift_w, ad.d_res_w
+        if link_state.is_node_overloaded(my_node_name):
+            # run_spf exempts the root from its own transit drain; the
+            # mirror folded the drain into the root's out-edge weights,
+            # so restore them for this (rare) case
+            sw = plan.shift_w.copy()
+            rw = plan.res_w.copy()
+            for link in link_state.links_from_node(my_node_name):
+                if not link.is_up():
+                    continue
+                w = min(link.metric_from_node(my_node_name), 1 << 28)
+                kind, a, b = edge_loc[(link, my_node_name)]
+                if kind == "s":
+                    sw[a, b] = w
+                else:
+                    rw[a, b] = w
+            d_shift_w = jax.device_put(sw)
+            d_res_w = jax.device_put(rw)
+
+        dist = masked_sssp_batch(
+            plan, d_shift_w, ad.d_res_rows, ad.d_res_nbr, d_res_w,
+            ad.d_deltas, plan.node_index[my_node_name],
+            [locs for _, _, locs in jobs],
+        )
+        node_index = plan.node_index
+        for i, (dest, ignore, _locs) in enumerate(jobs):
+            row = dist[i]
+
+            def dist_of(n, _row=row, _idx=node_index):
+                j = _idx.get(n)
+                if j is None:
+                    return None
+                v = int(_row[j])
+                return None if v >= INF_E else v
+
+            paths2 = link_state.trace_paths_on_dist(
+                my_node_name, dest, dist_of, ignore
+            )
+            link_state.prime_kth_paths(my_node_name, dest, 2, paths2)
 
     def device_compute_ms(self, iters: int = 8) -> Optional[float]:
         """Amortized device-only time per full pipeline execution: chain

@@ -68,12 +68,25 @@ def _mk_dbs(
     forwarding_algorithm: PrefixForwardingAlgorithm,
     node_labels: bool,
     prefixes_per_node: int = 1,
+    ksp2_every: int = 0,
 ) -> tuple[list[AdjacencyDatabase], list[PrefixDatabase]]:
-    fwd_type = (
-        PrefixForwardingType.SR_MPLS
-        if forwarding_algorithm == PrefixForwardingAlgorithm.KSP2_ED_ECMP
-        else PrefixForwardingType.IP
-    )
+    """ksp2_every > 0 marks every Nth node's prefixes SR_MPLS +
+    KSP2_ED_ECMP (a segment-routed subset over a plain-IP fabric —
+    BASELINE config 4's shape); it implies node labels (label stacks
+    need them)."""
+    if ksp2_every:
+        node_labels = True
+
+    def algo_for(idx: int):
+        if ksp2_every and idx % ksp2_every == 0:
+            return (
+                PrefixForwardingType.SR_MPLS,
+                PrefixForwardingAlgorithm.KSP2_ED_ECMP,
+            )
+        if forwarding_algorithm == PrefixForwardingAlgorithm.KSP2_ED_ECMP:
+            return (PrefixForwardingType.SR_MPLS, forwarding_algorithm)
+        return (PrefixForwardingType.IP, forwarding_algorithm)
+
     adj_dbs = []
     prefix_dbs = []
     for idx, (name, adjs) in enumerate(nodes.items()):
@@ -85,6 +98,7 @@ def _mk_dbs(
                 area=area,
             )
         )
+        fwd_type, fwd_algo = algo_for(idx)
         for p in range(prefixes_per_node):
             prefix = _loopback_prefix(idx * prefixes_per_node + p + 1)
             prefix_dbs.append(
@@ -95,7 +109,7 @@ def _mk_dbs(
                             prefix=prefix,
                             type=PrefixType.LOOPBACK,
                             forwarding_type=fwd_type,
-                            forwarding_algorithm=forwarding_algorithm,
+                            forwarding_algorithm=fwd_algo,
                         ),
                     ),
                     area=area,
@@ -246,6 +260,7 @@ def wan(
     area: str = "0",
     forwarding_algorithm: PrefixForwardingAlgorithm = PrefixForwardingAlgorithm.SP_ECMP,
     node_labels: bool = False,
+    ksp2_every: int = 0,
 ) -> tuple[list[AdjacencyDatabase], list[PrefixDatabase]]:
     """Multi-region WAN for benchmarks (BASELINE config 4): each region is
     a metro grid (region-major naming keeps intra-region edges in shared
@@ -270,7 +285,10 @@ def wan(
         (min(g, (g + 1) % regions), max(g, (g + 1) % regions))
         for g in range(regions)
     }
-    while len(pairs) < regions * hub_links // 2:
+    # target bounded by the number of distinct hub pairs, else few-region
+    # configs loop forever asking for more chords than exist
+    target = min(regions * hub_links // 2, regions * (regions - 1) // 2)
+    while len(pairs) < target:
         a, b = rng.randrange(regions), rng.randrange(regions)
         if a != b:
             pairs.add((min(a, b), max(a, b)))
@@ -278,7 +296,9 @@ def wan(
         metric = rng.randint(10, 100)
         nodes[hub(a)].append(_adj(hub(a), hub(b), metric=metric))
         nodes[hub(b)].append(_adj(hub(b), hub(a), metric=metric))
-    return _mk_dbs(nodes, area, forwarding_algorithm, node_labels)
+    return _mk_dbs(
+        nodes, area, forwarding_algorithm, node_labels, ksp2_every=ksp2_every
+    )
 
 
 def random_mesh(

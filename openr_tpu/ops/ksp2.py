@@ -1,0 +1,149 @@
+"""Batched masked SSSP — the device half of KSP2 (k=2 edge-disjoint).
+
+The reference computes k-shortest edge-disjoint paths by re-running
+Dijkstra per destination with that destination's first-path links
+removed (openr/decision/LinkState.cpp:790-819 getKthPaths). That second
+pass is the KSP2 hot loop: one full SPF per KSP2 destination. Here the
+second-pass distance fields for MANY destinations compute in one
+jit-compiled batch over the shift-decomposed mirror (ops/edgeplan.py):
+each batch row masks its own destination's excluded directed edges
+(a handful of scatter-INF writes into a private view of the weight
+arrays) and relaxes to fixpoint; rows vmap across the batch.
+
+The path EXTRACTION stays on the host
+(link_state.trace_paths_on_dist): distances are unique, so tracing the
+device field with the canonical candidate order yields byte-identical
+paths to tracing the CPU run_spf field — the oracle and the device
+path cannot diverge.
+
+Semantics mirror run_spf with links_to_ignore: full graph (the root may
+transit, unlike the ECMP pipeline's G-minus-root), link-down and
+transit-drain folded into effective weights, masked links removed in
+both directions.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from openr_tpu.ops.edgeplan import INF32E
+
+INF_E = int(INF32E)
+_UNROLL = 8
+
+
+@functools.lru_cache(maxsize=None)
+def _masked_sssp_fn(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
+                    has_res: bool, b_cap: int, ms_cap: int, mr_cap: int):
+    import jax
+    import jax.numpy as jnp
+
+    max_trips = max(2, -(-n_cap // _UNROLL) + 2)
+
+    def batch(deltas, shift_w, res_rows, res_nbr, res_w, root,
+              mask_s_idx,  # int32 [B, Ms] flat into [S*N]; pad = S*N (dropped)
+              mask_r_idx):  # int32 [B, Mr] flat into [R*K]; pad = R*K
+        nbr_c = jnp.clip(res_nbr, 0, n_cap - 1)
+        rows_c = jnp.clip(res_rows, 0, n_cap - 1)
+
+        def one(ms_idx, mr_idx):
+            sw = (
+                shift_w.ravel()
+                .at[ms_idx]
+                .set(INF_E, mode="drop")
+                .reshape(s_cap, n_cap)
+            )
+            if has_res:
+                rw = (
+                    res_w.ravel()
+                    .at[mr_idx]
+                    .set(INF_E, mode="drop")
+                    .reshape(r_cap, kr_cap)
+                )
+            dist0 = jnp.full((n_cap,), INF_E, jnp.int32).at[root].set(0)
+
+            def relax(dist):
+                def cls(k, acc):
+                    return jnp.minimum(
+                        acc, jnp.roll(dist + sw[k], deltas[k])
+                    )
+
+                acc = jax.lax.fori_loop(0, s_cap, cls, dist)
+                if has_res:
+                    nd = dist[nbr_c]  # [R, K]
+                    cand = (nd + rw).min(axis=1)
+                    acc = acc.at[rows_c].min(cand)
+                return jnp.minimum(acc, dist)
+
+            def body(state):
+                dist, _, t = state
+                new = dist
+                for _ in range(_UNROLL):
+                    new = relax(new)
+                return new, jnp.any(new != dist), t + 1
+
+            dist, _, _ = jax.lax.while_loop(
+                lambda s: s[1] & (s[2] < max_trips),
+                body,
+                (dist0, jnp.bool_(True), jnp.int32(0)),
+            )
+            return dist
+
+        return jax.vmap(one)(mask_s_idx, mask_r_idx)
+
+    return jax.jit(batch)
+
+
+def _next_pow2(n: int, floor: int = 1) -> int:
+    c = floor
+    while c < n:
+        c *= 2
+    return c
+
+
+def masked_sssp_batch(plan, d_shift_w, d_res_rows, d_res_nbr, d_res_w,
+                      d_deltas, root_idx: int, mask_locs: list,
+                      chunk: int = 64) -> np.ndarray:
+    """Distance fields [len(mask_locs), n_cap] int32, one per mask set.
+
+    mask_locs[i] is a list of ("s", k, u) | ("r", row, col) directed-edge
+    locations (ops/edgeplan.py edge_loc values) to remove for row i.
+    Rows are chunked so the vmapped per-row weight copies stay bounded.
+    """
+    n_cap, s_cap = plan.n_cap, plan.s_cap
+    r_cap, kr_cap = plan.res_nbr.shape
+    has_res = plan.k_res > 0
+    s_pad = s_cap * n_cap
+    r_pad = r_cap * kr_cap
+
+    out = np.empty((len(mask_locs), n_cap), np.int32)
+    for base in range(0, len(mask_locs), chunk):
+        locs = mask_locs[base:base + chunk]
+        b = len(locs)
+        ms = max((sum(1 for t in ls if t[0] == "s") for ls in locs), default=0)
+        mr = max((sum(1 for t in ls if t[0] == "r") for ls in locs), default=0)
+        ms_cap = _next_pow2(max(ms, 1), 4)
+        mr_cap = _next_pow2(max(mr, 1), 4)
+        b_cap = _next_pow2(b, 4)
+        mask_s = np.full((b_cap, ms_cap), s_pad, np.int32)
+        mask_r = np.full((b_cap, mr_cap), r_pad, np.int32)
+        for i, ls in enumerate(locs):
+            si = ri = 0
+            for t in ls:
+                if t[0] == "s":
+                    mask_s[i, si] = t[1] * n_cap + t[2]
+                    si += 1
+                else:
+                    mask_r[i, ri] = t[1] * kr_cap + t[2]
+                    ri += 1
+        fn = _masked_sssp_fn(
+            n_cap, s_cap, r_cap, kr_cap, has_res, b_cap, ms_cap, mr_cap
+        )
+        dist = fn(
+            d_deltas, d_shift_w, d_res_rows, d_res_nbr, d_res_w,
+            np.int32(root_idx), mask_s, mask_r,
+        )
+        out[base:base + b] = np.asarray(dist)[:b]
+    return out

@@ -1,0 +1,186 @@
+"""Device-assisted KSP2 tests (BASELINE config 4's algorithm).
+
+The TPU path batches the per-destination second-pass masked SSSPs
+(ops/ksp2.py) and primes LinkState's k-paths cache; route assembly
+(selection, canonical trace, MPLS label stacks) is the oracle's own code.
+Differential tests therefore build FRESH LinkStates per backend — the
+k-paths cache is shared state, and reusing it would let either backend
+consume the other's results.
+"""
+
+from openr_tpu.decision.link_state import LinkState
+from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.decision.tpu_solver import TpuSpfSolver
+from openr_tpu.models import topologies
+from openr_tpu.types import (
+    Adjacency,
+    AdjacencyDatabase,
+    PrefixForwardingAlgorithm,
+)
+from tests.test_tpu_solver import assert_rib_equal
+
+KSP2 = PrefixForwardingAlgorithm.KSP2_ED_ECMP
+
+
+def fresh(gen):
+    adj_dbs, prefix_dbs = gen()
+    return topologies.build_states(adj_dbs, prefix_dbs)
+
+
+def run_both_fresh(me, gen, **kw):
+    """CPU and TPU on independent state instances; RIBs must match."""
+    cpu_states, cpu_ps = fresh(gen)
+    tpu_states, tpu_ps = fresh(gen)
+    cpu_db = SpfSolver(me, **kw).build_route_db(me, cpu_states, cpu_ps)
+    tpu_db = TpuSpfSolver(me, **kw).build_route_db(me, tpu_states, tpu_ps)
+    assert_rib_equal(cpu_db, tpu_db, me)
+    return cpu_db
+
+
+def test_ksp2_square_device_matches_oracle():
+    cpu_db = run_both_fresh(
+        "node-0-0",
+        lambda: topologies.grid(2, forwarding_algorithm=KSP2),
+    )
+    # 2x2 grid: two edge-disjoint L-paths to the far corner
+    route = cpu_db.unicast_routes["fd00::4/128"]
+    assert len(route.nexthops) == 2
+    for nh in route.nexthops:
+        assert nh.mpls_action is not None
+
+
+def test_ksp2_grid_multiple_vantages():
+    for me in ("node-0-0", "node-2-3", "node-4-4"):
+        run_both_fresh(
+            me, lambda: topologies.grid(5, forwarding_algorithm=KSP2)
+        )
+
+
+def test_ksp2_subset_mixed_with_fast_path():
+    """SR_MPLS/KSP2 subset over a plain-IP grid: fast path handles the IP
+    rows on device, KSP2 rows get the batched second pass; both must
+    match the oracle in one RIB."""
+    gen = lambda: topologies.wan(  # noqa: E731
+        regions=2, region_side=4, ksp2_every=5
+    )
+    cpu_db = run_both_fresh("r00-n00-00", gen)
+    algos = {
+        (e.best_prefix_entry.forwarding_algorithm)
+        for e in cpu_db.unicast_routes.values()
+        if e.best_prefix_entry is not None
+    }
+    assert KSP2 in algos and PrefixForwardingAlgorithm.SP_ECMP in algos
+
+
+def test_ksp2_second_pass_runs_on_device_not_host():
+    """The whole point: the TPU build must not run one host Dijkstra per
+    KSP2 destination. run_spf with a non-empty ignore set IS that per-
+    destination pass — count them."""
+    adj_dbs, prefix_dbs = topologies.grid(4, forwarding_algorithm=KSP2)
+    states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    ls = states["0"]
+    calls = {"masked": 0}
+    orig = ls.run_spf
+
+    def counting_run_spf(root, use_link_metric=True, links_to_ignore=()):
+        if links_to_ignore:
+            calls["masked"] += 1
+        return orig(root, use_link_metric, links_to_ignore)
+
+    ls.run_spf = counting_run_spf
+    tpu = TpuSpfSolver("node-0-0")
+    tpu_db = tpu.build_route_db("node-0-0", states, ps)
+    assert calls["masked"] == 0, "second pass fell back to host Dijkstra"
+    assert len(tpu_db.unicast_routes) == 15
+
+    # the oracle on fresh states DOES run them — and still agrees
+    cpu_states, cpu_ps = fresh(
+        lambda: topologies.grid(4, forwarding_algorithm=KSP2)
+    )
+    cpu = SpfSolver("node-0-0")
+    cpu_db = cpu.build_route_db("node-0-0", cpu_states, cpu_ps)
+    assert calls["masked"] == 0  # counting hook was on the TPU states
+    assert_rib_equal(cpu_db, tpu_db, "device-primed vs oracle")
+
+
+def test_ksp2_overloaded_root_still_routes():
+    """run_spf exempts the root from its own transit drain; the device
+    mirror folds drain into out-edge weights, so the KSP2 path must
+    restore the root's out-edges (rare path in _prime_ksp2)."""
+
+    def gen():
+        adj_dbs, prefix_dbs = topologies.grid(
+            3, forwarding_algorithm=KSP2
+        )
+        out = []
+        for db in adj_dbs:
+            if db.this_node_name == "node-0-0":
+                out.append(
+                    AdjacencyDatabase(
+                        this_node_name=db.this_node_name,
+                        adjacencies=db.adjacencies,
+                        node_label=db.node_label,
+                        is_overloaded=True,
+                        area=db.area,
+                    )
+                )
+            else:
+                out.append(db)
+        return out, prefix_dbs
+
+    cpu_db = run_both_fresh("node-0-0", gen)
+    assert cpu_db.unicast_routes  # drained root still originates traffic
+
+
+def test_ksp2_churn_reprimes_cache():
+    """Topology churn clears the k-paths cache; the next build must
+    re-prime from fresh device fields and stay parity-exact."""
+    mk = lambda: topologies.grid(4, forwarding_algorithm=KSP2)  # noqa: E731
+    cpu_states, cpu_ps = fresh(mk)
+    tpu_states, tpu_ps = fresh(mk)
+    cpu = SpfSolver("node-0-0")
+    tpu = TpuSpfSolver("node-0-0")
+    assert_rib_equal(
+        cpu.build_route_db("node-0-0", cpu_states, cpu_ps),
+        tpu.build_route_db("node-0-0", tpu_states, tpu_ps),
+        "initial",
+    )
+    adj_dbs, _ = mk()
+    victim = next(d for d in adj_dbs if d.this_node_name == "node-1-1")
+    for states in (cpu_states, tpu_states):
+        states["0"].update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name="node-1-1",
+                adjacencies=tuple(
+                    Adjacency(**{**a.__dict__, "metric": 5})
+                    for a in victim.adjacencies
+                ),
+                node_label=victim.node_label,
+                area="0",
+            )
+        )
+    assert_rib_equal(
+        cpu.build_route_db("node-0-0", cpu_states, cpu_ps),
+        tpu.build_route_db("node-0-0", tpu_states, tpu_ps),
+        "after churn",
+    )
+
+
+def test_canonical_trace_is_deterministic():
+    """trace_paths_on_dist depends only on distance values: tracing the
+    same dest twice over independent LinkState builds yields identical
+    link sequences (guards against set-iteration-order leaks)."""
+    results = []
+    for _ in range(2):
+        adj_dbs, _ = topologies.grid(4)
+        states, _ = topologies.build_states(adj_dbs, [])
+        ls: LinkState = states["0"]
+        paths = ls.get_kth_paths("node-0-0", "node-3-3", 1)
+        paths += ls.get_kth_paths("node-0-0", "node-3-3", 2)
+        results.append(
+            [
+                [(l.n1, l.if1, l.n2, l.if2) for l in path]
+                for path in paths
+            ]
+        )
+    assert results[0] == results[1]
