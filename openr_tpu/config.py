@@ -144,6 +144,9 @@ class SegmentRoutingConfig:
     sr_adj_label_type: str = "AUTO"  # AUTO | DISABLED
     sr_adj_label_range: tuple[int, int] = (50000, 59999)
     sr_node_label_range: tuple[int, int] = (101, 1100)
+    # this node's static segment-routing node label, advertised in the
+    # adjacency DB; 0 = none (KSP2/SR_MPLS label stacks require one)
+    node_segment_label: int = 0
 
 
 @dataclass

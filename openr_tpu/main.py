@@ -56,6 +56,15 @@ def parse_args(argv=None) -> argparse.Namespace:
     )
     p.add_argument("--ctrl-port", type=int, default=None)
     p.add_argument(
+        "--fib-service",
+        default=None,
+        metavar="HOST:PORT",
+        help="program routes through an out-of-process platform agent "
+        "(openr_tpu.platform.main) instead of the in-memory service; "
+        "startup blocks until the agent answers aliveSince "
+        "(ref waitForFibService, openr/Main.cpp:92-120)",
+    )
+    p.add_argument(
         "--override_drain_state",
         choices=["drained", "undrained"],
         default=None,
@@ -92,6 +101,17 @@ async def run_daemon(args) -> None:
             bind_port = int(port_s)
         iface_specs.append((name, bind_addr, bind_port))
 
+    # -- FibService: out-of-process platform agent, if configured ---------
+    fib_service = None
+    if args.fib_service:
+        from openr_tpu.platform import RemoteFibService, wait_for_fib_service
+
+        host, _, port_s = args.fib_service.rpartition(":")
+        fib_service = RemoteFibService(host or "127.0.0.1", int(port_s))
+        log.info("waiting for FibService at %s ...", args.fib_service)
+        await wait_for_fib_service(fib_service)
+        log.info("FibService is up")
+
     kv_ports: dict[str, int] = {}
     originated = [
         OriginatedPrefix(**op) if isinstance(op, dict) else op
@@ -106,6 +126,7 @@ async def run_daemon(args) -> None:
         kvstore_config=oc.kvstore_config,
         decision_config=oc.decision_config,
         fib_config=oc.fib_config,
+        fib_service=fib_service,
         lm_config=oc.link_monitor_config,
         originated_prefixes=originated,
         solver_backend=oc.decision_config.solver_backend,
@@ -117,6 +138,7 @@ async def run_daemon(args) -> None:
         # neighbors publish their kvstore endpoint in the spark handshake's
         # dedicated kvstore_port field
         kvstore_port_of=lambda ev: ("127.0.0.1", ev.kvstore_port),
+        node_label=oc.segment_routing_config.node_segment_label,
     )
 
     # -- bring up interfaces ----------------------------------------------

@@ -1,0 +1,20 @@
+"""Platform layer: kernel-facing route programming.
+
+Role of the reference's openr/platform/ (NetlinkFibHandler.h:32 serving
+thrift FibService over openr/nl/NetlinkProtocolSocket) and the
+standalone platform_linux binary (LinuxPlatformMain.cpp): a separate
+process owns the dataplane; the daemon's Fib actor programs it through
+the FibService seam (fib/fib_service.py) over runtime/rpc.py.
+
+  netlink.py      async rtnetlink client (the openr/nl layer)
+  fib_handler.py  FibService RPC server over a dataplane backend
+                  (in-memory or netlink) + the daemon-side RemoteFibService
+  main.py         standalone platform agent binary
+"""
+
+from openr_tpu.platform.fib_handler import (  # noqa: F401
+    FibPlatformServer,
+    MemoryDataplane,
+    RemoteFibService,
+    wait_for_fib_service,
+)

@@ -1,0 +1,373 @@
+"""FibService platform server + daemon-side remote client.
+
+Role of the reference's NetlinkFibHandler (openr/platform/
+NetlinkFibHandler.h:32): a standalone agent serving the FibService
+surface (add/delete/sync unicast + MPLS, aliveSince — openr/if/
+Platform.thrift:170) over runtime/rpc.py, translating route entries to a
+dataplane backend:
+
+  MemoryDataplane   in-memory tables (tests, emulation, default)
+  NetlinkDataplane  real kernel routes via platform/netlink.py
+                    (requires CAP_NET_ADMIN; next-hop addresses must be
+                    kernel-resolvable)
+
+RemoteFibService is the daemon half: a FibServiceBase implementation the
+Fib actor programs against, forwarding over an RpcClient — the process
+boundary the reference crosses with thrift (Fib.h:56 createFibClient).
+wait_for_fib_service blocks startup until the agent answers aliveSince
+(ref waitForFibService, openr/Main.cpp:92-120).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+from openr_tpu.decision.rib import RibMplsEntry, RibUnicastEntry
+from openr_tpu.fib.fib_service import FibServiceBase, FibUpdateError
+from openr_tpu.runtime.rpc import RpcClient, RpcServer
+from openr_tpu.serde import to_plain
+
+
+class MemoryDataplane:
+    """In-memory route tables behind the same seam as the kernel-facing
+    backend; supports per-prefix/label failure injection so the Fib
+    actor's dirty-retry machinery can be exercised across the process
+    boundary (role of MockNetlinkFibHandler)."""
+
+    def __init__(self) -> None:
+        self.unicast: dict[str, dict] = {}
+        self.mpls: dict[int, dict] = {}
+        self.fail_prefixes: set[str] = set()
+        self.fail_labels: set[int] = set()
+
+    async def add_unicast(self, routes: dict[str, dict]) -> list[str]:
+        failed = [p for p in routes if p in self.fail_prefixes]
+        for p, r in routes.items():
+            if p not in failed:
+                self.unicast[p] = r
+        return failed
+
+    async def delete_unicast(self, prefixes: list[str]) -> None:
+        for p in prefixes:
+            self.unicast.pop(p, None)
+
+    async def sync_unicast(self, routes: dict[str, dict]) -> list[str]:
+        failed = [p for p in routes if p in self.fail_prefixes]
+        self.unicast = {p: r for p, r in routes.items() if p not in failed}
+        return failed
+
+    async def add_mpls(self, routes: dict[int, dict]) -> list[int]:
+        failed = [l for l in routes if l in self.fail_labels]
+        for label, r in routes.items():
+            if label not in failed:
+                self.mpls[label] = r
+        return failed
+
+    async def delete_mpls(self, labels: list[int]) -> None:
+        for label in labels:
+            self.mpls.pop(label, None)
+
+    async def sync_mpls(self, routes: dict[int, dict]) -> list[int]:
+        self.mpls = dict(routes)
+        return []
+
+    async def dump_unicast(self) -> dict:
+        return self.unicast
+
+
+class NetlinkDataplane:
+    """Kernel dataplane over rtnetlink (ref NetlinkFibHandler ->
+    NetlinkProtocolSocket). Unicast routes program into `table` with the
+    daemon protocol id; next hops resolve gateway/ifindex from the
+    NextHop address + if_name. MPLS label routes are tracked in memory
+    (kernel MPLS needs the mpls_router module; the seam keeps them
+    separate so a label-capable backend can slot in)."""
+
+    def __init__(self, table: int = 254):
+        from openr_tpu.platform.netlink import NetlinkRouteSocket
+
+        self.table = table
+        self.nl = NetlinkRouteSocket()
+        self._opened = False
+        self.mpls: dict[int, dict] = {}
+
+    def _ensure_open(self) -> None:
+        if not self._opened:
+            self.nl.open()
+            self._opened = True
+
+    def _to_nl(self, prefix: str, route: dict):
+        import socket as _socket
+
+        from openr_tpu.platform.netlink import NlNextHop, NlRoute
+
+        nhs = []
+        for nh in route.get("nexthops", []):
+            ifindex = 0
+            if nh.get("if_name"):
+                try:
+                    ifindex = _socket.if_nametoindex(nh["if_name"])
+                except OSError:
+                    ifindex = 0
+            addr = (nh.get("address") or "").split("%")[0]
+            nhs.append(
+                NlNextHop(
+                    gateway=addr or None,
+                    ifindex=ifindex,
+                    weight=nh.get("weight") or 0,
+                )
+            )
+        return NlRoute(
+            prefix=prefix,
+            nexthops=tuple(nhs),
+            metric=route.get("igp_cost") or 0,
+            table=self.table,
+        )
+
+    async def add_unicast(self, routes: dict[str, dict]) -> list[str]:
+        self._ensure_open()
+        failed = []
+        for prefix, route in routes.items():
+            try:
+                await self.nl.add_route(self._to_nl(prefix, route))
+            except OSError:
+                failed.append(prefix)
+        return failed
+
+    async def delete_unicast(self, prefixes: list[str]) -> None:
+        self._ensure_open()
+        for prefix in prefixes:
+            try:
+                await self.nl.delete_route(self._to_nl(prefix, {}))
+            except OSError:
+                pass  # already gone
+
+    async def sync_unicast(self, routes: dict[str, dict]) -> list[str]:
+        import socket as _socket
+
+        from openr_tpu.platform.netlink import PROTO_OPENR
+
+        self._ensure_open()
+        have = set()
+        for family in (_socket.AF_INET, _socket.AF_INET6):
+            for r in await self.nl.get_routes(
+                family, table=self.table, protocol=PROTO_OPENR
+            ):
+                have.add(r.prefix)
+        failed = await self.add_unicast(routes)
+        stale = have - set(routes)
+        await self.delete_unicast(sorted(stale))
+        return failed
+
+    async def add_mpls(self, routes: dict[int, dict]) -> list[int]:
+        self.mpls.update(routes)
+        return []
+
+    async def delete_mpls(self, labels: list[int]) -> None:
+        for label in labels:
+            self.mpls.pop(label, None)
+
+    async def sync_mpls(self, routes: dict[int, dict]) -> list[int]:
+        self.mpls = dict(routes)
+        return []
+
+    async def dump_unicast(self) -> dict:
+        """Kernel-truth dump of the daemon-owned routes (by table +
+        protocol id), so the introspection RPC reflects what is actually
+        programmed rather than an in-memory shadow."""
+        import socket as _socket
+
+        from openr_tpu.platform.netlink import PROTO_OPENR
+
+        self._ensure_open()
+        out: dict[str, dict] = {}
+        for family in (_socket.AF_INET, _socket.AF_INET6):
+            for r in await self.nl.get_routes(
+                family, table=self.table, protocol=PROTO_OPENR
+            ):
+                out[r.prefix] = {
+                    "metric": r.metric,
+                    "nexthops": [
+                        {
+                            "address": nh.gateway or "",
+                            "ifindex": nh.ifindex,
+                            "weight": nh.weight,
+                        }
+                        for nh in r.nexthops
+                    ],
+                }
+        return out
+
+
+class FibPlatformServer:
+    """The platform agent: FibService over RPC, per-client route
+    ownership ready (client_id is carried through like the reference's
+    thrift client-id -> protocol mapping)."""
+
+    def __init__(self, dataplane=None):
+        self.dataplane = dataplane or MemoryDataplane()
+        self.started_at = time.monotonic()
+        self.rpc = RpcServer("platform.fib")
+        r = self.rpc.register
+        r("platform.fib.add_unicast_routes", self._add_unicast)
+        r("platform.fib.delete_unicast_routes", self._del_unicast)
+        r("platform.fib.sync_fib", self._sync_fib)
+        r("platform.fib.add_mpls_routes", self._add_mpls)
+        r("platform.fib.delete_mpls_routes", self._del_mpls)
+        r("platform.fib.sync_mpls_fib", self._sync_mpls)
+        r("platform.fib.alive_since", self._alive_since)
+        r("platform.fib.get_route_table", self._get_route_table)
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        return await self.rpc.start(host, port)
+
+    @property
+    def port(self) -> int:
+        return self.rpc.port
+
+    async def stop(self) -> None:
+        await self.rpc.stop()
+
+    # -- handlers ----------------------------------------------------------
+
+    async def _add_unicast(self, client_id: int, routes: dict) -> dict:
+        failed = await self.dataplane.add_unicast(routes)
+        return {"failed_prefixes": failed}
+
+    async def _del_unicast(self, client_id: int, prefixes: list) -> dict:
+        await self.dataplane.delete_unicast(prefixes)
+        return {}
+
+    async def _sync_fib(self, client_id: int, routes: dict) -> dict:
+        failed = await self.dataplane.sync_unicast(routes)
+        return {"failed_prefixes": failed}
+
+    async def _add_mpls(self, client_id: int, routes: dict) -> dict:
+        failed = await self.dataplane.add_mpls(
+            {int(k): v for k, v in routes.items()}
+        )
+        return {"failed_labels": failed}
+
+    async def _del_mpls(self, client_id: int, labels: list) -> dict:
+        await self.dataplane.delete_mpls([int(x) for x in labels])
+        return {}
+
+    async def _sync_mpls(self, client_id: int, routes: dict) -> dict:
+        failed = await self.dataplane.sync_mpls(
+            {int(k): v for k, v in routes.items()}
+        )
+        return {"failed_labels": failed}
+
+    async def _alive_since(self) -> float:
+        return self.started_at
+
+    async def _get_route_table(self) -> dict:
+        dp = self.dataplane
+        return {
+            "unicast": await dp.dump_unicast(),
+            "mpls": {str(k): v for k, v in getattr(dp, "mpls", {}).items()},
+        }
+
+
+class RemoteFibService(FibServiceBase):
+    """Daemon-side FibService client: the Fib actor programs this exactly
+    like the in-process mock; calls cross to the platform agent over RPC.
+    Partial failures come back as failed-set payloads and re-raise as
+    FibUpdateError so the actor's dirty-route retry path is identical in
+    and out of process."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 60100):
+        self.client = RpcClient(host, port, name="fib-service")
+
+    async def close(self) -> None:
+        await self.client.close()
+
+    @staticmethod
+    def _unicast_payload(routes: list[RibUnicastEntry]) -> dict:
+        return {r.prefix: to_plain(r) for r in routes}
+
+    @staticmethod
+    def _mpls_payload(routes: list[RibMplsEntry]) -> dict:
+        return {str(r.label): to_plain(r) for r in routes}
+
+    @staticmethod
+    def _raise_failed(res: dict) -> None:
+        if res.get("failed_prefixes") or res.get("failed_labels"):
+            raise FibUpdateError(
+                failed_prefixes=res.get("failed_prefixes") or [],
+                failed_labels=[int(x) for x in res.get("failed_labels") or []],
+            )
+
+    async def add_unicast_routes(self, client_id, routes) -> None:
+        res = await self.client.request(
+            "platform.fib.add_unicast_routes",
+            {"client_id": client_id, "routes": self._unicast_payload(routes)},
+        )
+        self._raise_failed(res)
+
+    async def delete_unicast_routes(self, client_id, prefixes) -> None:
+        await self.client.request(
+            "platform.fib.delete_unicast_routes",
+            {"client_id": client_id, "prefixes": list(prefixes)},
+        )
+
+    async def add_mpls_routes(self, client_id, routes) -> None:
+        res = await self.client.request(
+            "platform.fib.add_mpls_routes",
+            {"client_id": client_id, "routes": self._mpls_payload(routes)},
+        )
+        self._raise_failed(res)
+
+    async def delete_mpls_routes(self, client_id, labels) -> None:
+        await self.client.request(
+            "platform.fib.delete_mpls_routes",
+            {"client_id": client_id, "labels": list(labels)},
+        )
+
+    async def sync_fib(self, client_id, routes) -> None:
+        res = await self.client.request(
+            "platform.fib.sync_fib",
+            {"client_id": client_id, "routes": self._unicast_payload(routes)},
+        )
+        self._raise_failed(res)
+
+    async def sync_mpls_fib(self, client_id, routes) -> None:
+        res = await self.client.request(
+            "platform.fib.sync_mpls_fib",
+            {"client_id": client_id, "routes": self._mpls_payload(routes)},
+        )
+        self._raise_failed(res)
+
+    async def alive_since(self) -> float:
+        return await self.client.request("platform.fib.alive_since")
+
+    async def get_route_table(self) -> dict:
+        """Dump (operator/introspection helper; used by the smoke test
+        to verify cross-process programming)."""
+        return await self.client.request("platform.fib.get_route_table")
+
+
+async def wait_for_fib_service(
+    service: RemoteFibService, timeout_s: float = 30.0, poll_s: float = 0.2
+) -> float:
+    """Block until the platform agent answers aliveSince (ref
+    waitForFibService, openr/Main.cpp:92-120)."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        try:
+            return await service.alive_since()
+        except (ConnectionError, OSError):
+            if time.monotonic() >= deadline:
+                raise
+            await asyncio.sleep(poll_s)
+
+
+__all__ = [
+    "FibPlatformServer",
+    "MemoryDataplane",
+    "NetlinkDataplane",
+    "RemoteFibService",
+    "wait_for_fib_service",
+]

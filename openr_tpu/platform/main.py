@@ -1,0 +1,72 @@
+"""Standalone platform agent binary (ref LinuxPlatformMain.cpp: the
+platform_linux process serving FibService separately from the routing
+daemon, so a dataplane-agent restart never takes the protocol down).
+
+    python -m openr_tpu.platform.main --port 60100 --backend memory
+    python -m openr_tpu.platform.main --backend netlink --table 10099
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+
+from openr_tpu.platform.fib_handler import (
+    FibPlatformServer,
+    MemoryDataplane,
+    NetlinkDataplane,
+)
+
+log = logging.getLogger("openr_tpu.platform")
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(description="openr_tpu platform agent")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=60100)
+    p.add_argument(
+        "--backend",
+        choices=["memory", "netlink"],
+        default="memory",
+        help="dataplane: in-memory tables or kernel rtnetlink",
+    )
+    p.add_argument(
+        "--table",
+        type=int,
+        default=254,
+        help="kernel route table for the netlink backend",
+    )
+    p.add_argument("--verbose", action="store_true")
+    return p.parse_args(argv)
+
+
+async def run(args) -> None:
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    dataplane = (
+        NetlinkDataplane(table=args.table)
+        if args.backend == "netlink"
+        else MemoryDataplane()
+    )
+    server = FibPlatformServer(dataplane)
+    port = await server.start(args.host, args.port)
+    log.info("platform agent (%s) on %s:%d", args.backend, args.host, port)
+    print(f"READY fib={port}", flush=True)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await server.stop()
+
+
+def main(argv=None) -> None:
+    asyncio.run(run(parse_args(argv)))
+
+
+if __name__ == "__main__":
+    main()

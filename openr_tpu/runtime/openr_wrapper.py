@@ -67,6 +67,7 @@ class OpenrWrapper:
         ctrl_port: int = 0,
         persistent_store=None,
         kvstore_port_of=None,
+        node_label: int = 0,
     ):
         self.node_name = node_name
         self.kv_ports = kv_ports  # shared node -> kvstore port registry
@@ -111,6 +112,9 @@ class OpenrWrapper:
             interface_updates_queue=self.interface_updates_queue,
             prefix_updates_queue=self.prefix_updates_queue,
             persistent_store=persistent_store,
+            # segment-routing node label advertised in the adjacency DB
+            # (ref enableSegmentRouting + node segment label config)
+            node_label=node_label,
             # default: in-process port registry; the daemon passes a hook
             # that reads the kvstore_port learned via the spark handshake
             kvstore_port_of=kvstore_port_of

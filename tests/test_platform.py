@@ -1,0 +1,390 @@
+"""Platform layer tests: FibService over RPC (in-process and two-process)
+and the rtnetlink client.
+
+Role of the reference's NetlinkFibHandlerTest/Benchmark +
+openr/nl/tests — kernel-mutating cases gate on CAP_NET_ADMIN (README
+"some tests require sudo"); message (de)serialization and the RPC seam
+run everywhere.
+"""
+
+import asyncio
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from openr_tpu.config import FibConfig
+from openr_tpu.decision.rib import (
+    DecisionRouteUpdate,
+    NextHop,
+    RibUnicastEntry,
+    RouteUpdateType,
+)
+from openr_tpu.fib.fib import Fib
+from openr_tpu.fib.fib_service import FibUpdateError
+from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.platform.fib_handler import (
+    FibPlatformServer,
+    MemoryDataplane,
+    RemoteFibService,
+    wait_for_fib_service,
+)
+from tests.conftest import run_async
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def route(prefix, nbr="peer", metric=10):
+    return RibUnicastEntry(
+        prefix=prefix,
+        nexthops=frozenset(
+            {NextHop(address="10.0.0.2", if_name="if0",
+                     neighbor_node_name=nbr, metric=metric)}
+        ),
+        igp_cost=metric,
+    )
+
+
+class TestRemoteFibService:
+    @run_async
+    async def test_program_and_dump_roundtrip(self):
+        server = FibPlatformServer()
+        await server.start()
+        svc = RemoteFibService(port=server.port)
+        try:
+            assert await wait_for_fib_service(svc, timeout_s=5) > 0
+            await svc.add_unicast_routes(
+                0, [route("10.1.0.0/24"), route("10.2.0.0/24")]
+            )
+            await svc.delete_unicast_routes(0, ["10.2.0.0/24"])
+            table = await svc.get_route_table()
+            assert set(table["unicast"]) == {"10.1.0.0/24"}
+            entry = table["unicast"]["10.1.0.0/24"]
+            assert entry["igp_cost"] == 10
+            assert entry["nexthops"][0]["neighbor_node_name"] == "peer"
+
+            await svc.sync_fib(0, [route("10.3.0.0/24")])
+            table = await svc.get_route_table()
+            assert set(table["unicast"]) == {"10.3.0.0/24"}
+        finally:
+            await svc.close()
+            await server.stop()
+
+    @run_async
+    async def test_partial_failure_crosses_process_boundary(self):
+        dp = MemoryDataplane()
+        dp.fail_prefixes.add("10.9.0.0/24")
+        server = FibPlatformServer(dp)
+        await server.start()
+        svc = RemoteFibService(port=server.port)
+        try:
+            with pytest.raises(FibUpdateError) as exc:
+                await svc.add_unicast_routes(
+                    0, [route("10.8.0.0/24"), route("10.9.0.0/24")]
+                )
+            assert exc.value.failed_prefixes == ["10.9.0.0/24"]
+            table = await svc.get_route_table()
+            assert set(table["unicast"]) == {"10.8.0.0/24"}
+        finally:
+            await svc.close()
+            await server.stop()
+
+    @run_async
+    async def test_fib_actor_programs_remote_service(self):
+        """The full Fib actor against the out-of-process seam: initial
+        FULL_SYNC then incremental update, with a partial failure
+        exercising dirty-route retry across the RPC boundary."""
+        dp = MemoryDataplane()
+        server = FibPlatformServer(dp)
+        await server.start()
+        svc = RemoteFibService(port=server.port)
+        routes_q = ReplicateQueue("routes")
+        fib_updates = ReplicateQueue("fibUpdates")
+        fib = Fib(
+            "node-a",
+            FibConfig(route_delete_delay_ms=0),
+            svc,
+            routes_q.get_reader(),
+            fib_updates,
+        )
+        await fib.start()
+        try:
+            upd = DecisionRouteUpdate(type=RouteUpdateType.FULL_SYNC)
+            upd.unicast_routes_to_update["10.1.0.0/24"] = route("10.1.0.0/24")
+            routes_q.push(upd)
+
+            async def programmed():
+                while "10.1.0.0/24" not in dp.unicast:
+                    await asyncio.sleep(0.02)
+
+            await asyncio.wait_for(programmed(), 10)
+
+            inc = DecisionRouteUpdate(type=RouteUpdateType.INCREMENTAL)
+            inc.unicast_routes_to_update["10.2.0.0/24"] = route("10.2.0.0/24")
+            inc.unicast_routes_to_delete.append("10.1.0.0/24")
+            routes_q.push(inc)
+
+            async def updated():
+                while (
+                    "10.2.0.0/24" not in dp.unicast
+                    or "10.1.0.0/24" in dp.unicast
+                ):
+                    await asyncio.sleep(0.02)
+
+            await asyncio.wait_for(updated(), 10)
+        finally:
+            routes_q.close()
+            await fib.stop()
+            await svc.close()
+            await server.stop()
+
+
+class TestNetlinkMessages:
+    def test_route_message_roundtrip_v4_single_nexthop(self):
+        from openr_tpu.platform import netlink as nl
+
+        r = nl.NlRoute(
+            prefix="10.5.0.0/24",
+            nexthops=(nl.NlNextHop(gateway="10.0.0.1", ifindex=3),),
+            metric=20,
+            table=254,
+        )
+        parsed = nl._parse_route_msg(nl._build_route_msg(r))
+        assert parsed.prefix == "10.5.0.0/24"
+        assert parsed.metric == 20
+        assert parsed.table == 254
+        assert parsed.protocol == nl.PROTO_OPENR
+        (nh,) = parsed.nexthops
+        assert nh.gateway == "10.0.0.1" and nh.ifindex == 3
+
+    def test_route_message_roundtrip_v6_ecmp(self):
+        from openr_tpu.platform import netlink as nl
+
+        r = nl.NlRoute(
+            prefix="fd00:1::/64",
+            nexthops=(
+                nl.NlNextHop(gateway="fe80::1", ifindex=2, weight=2),
+                nl.NlNextHop(gateway="fe80::2", ifindex=4, weight=1),
+            ),
+        )
+        parsed = nl._parse_route_msg(nl._build_route_msg(r))
+        assert parsed.prefix == "fd00:1::/64"
+        gws = {(nh.gateway, nh.ifindex, nh.weight) for nh in parsed.nexthops}
+        assert gws == {("fe80::1", 2, 2), ("fe80::2", 4, 1)}
+
+    def test_extended_table_id_attribute(self):
+        from openr_tpu.platform import netlink as nl
+
+        r = nl.NlRoute(prefix="10.0.0.0/8", table=10099)
+        parsed = nl._parse_route_msg(nl._build_route_msg(r))
+        assert parsed.table == 10099
+
+
+def _can_net_admin() -> bool:
+    try:
+        s = socket.socket(
+            socket.AF_NETLINK, socket.SOCK_RAW, socket.NETLINK_ROUTE
+        )
+        s.close()
+    except OSError:
+        return False
+    return os.geteuid() == 0
+
+
+class TestNetlinkKernel:
+    @run_async
+    async def test_dump_main_table(self):
+        """Unprivileged read path: RTM_GETROUTE dump parses."""
+        from openr_tpu.platform import netlink as nl
+
+        sock = nl.NetlinkRouteSocket()
+        try:
+            sock.open()
+        except OSError:
+            pytest.skip("no AF_NETLINK")
+        try:
+            routes = await sock.get_routes(socket.AF_INET)
+            assert isinstance(routes, list)
+        finally:
+            sock.close()
+
+    @pytest.mark.skipif(not _can_net_admin(), reason="needs CAP_NET_ADMIN")
+    @run_async
+    async def test_add_delete_route_in_kernel(self):
+        """Real kernel route programming in a private table, verified by
+        dump, then removed (ref NetlinkProtocolSocketTest)."""
+        from openr_tpu.platform import netlink as nl
+
+        lo = socket.if_nametoindex("lo")
+        sock = nl.NetlinkRouteSocket()
+        sock.open()
+        r = nl.NlRoute(
+            prefix="10.254.253.0/24",
+            nexthops=(nl.NlNextHop(ifindex=lo),),
+            metric=42,
+            table=10099,
+        )
+        try:
+            await sock.add_route(r)
+            got = await sock.get_routes(
+                socket.AF_INET, table=10099, protocol=nl.PROTO_OPENR
+            )
+            assert any(x.prefix == "10.254.253.0/24" for x in got), got
+            await sock.delete_route(r)
+            got = await sock.get_routes(
+                socket.AF_INET, table=10099, protocol=nl.PROTO_OPENR
+            )
+            assert not any(x.prefix == "10.254.253.0/24" for x in got)
+        finally:
+            sock.close()
+
+    @pytest.mark.skipif(not _can_net_admin(), reason="needs CAP_NET_ADMIN")
+    @run_async
+    async def test_netlink_dataplane_sync_semantics(self):
+        """NetlinkDataplane.sync removes stale daemon-owned routes and
+        leaves foreign routes alone."""
+        from openr_tpu.platform.fib_handler import NetlinkDataplane
+
+        dp = NetlinkDataplane(table=10098)
+        nh = [{"address": "", "if_name": "lo", "weight": 0}]
+        try:
+            failed = await dp.sync_unicast(
+                {"10.254.1.0/24": {"nexthops": nh, "igp_cost": 7},
+                 "10.254.2.0/24": {"nexthops": nh, "igp_cost": 7}}
+            )
+            assert not failed
+            failed = await dp.sync_unicast(
+                {"10.254.2.0/24": {"nexthops": nh, "igp_cost": 7}}
+            )
+            assert not failed
+            got = await dp.nl.get_routes(socket.AF_INET, table=10098)
+            prefixes = {r.prefix for r in got}
+            assert "10.254.2.0/24" in prefixes
+            assert "10.254.1.0/24" not in prefixes
+        finally:
+            await dp.delete_unicast(["10.254.2.0/24"])
+            dp.nl.close()
+
+
+FAST_TIMERS = {
+    "hello_time_s": 0.1,
+    "fastinit_hello_time_ms": 30,
+    "keepalive_time_s": 0.1,
+    "hold_time_s": 1.0,
+    "graceful_restart_time_s": 2.0,
+    "handshake_time_ms": 50,
+    "min_packets_per_sec": 0,
+}
+
+
+def test_daemon_with_out_of_process_platform(tmp_path):
+    """Three processes: platform agent + two daemons, daemon A programs
+    its routes into the agent over RPC (ref Main.cpp waitForFibService +
+    platform_linux deployment shape)."""
+    agent = subprocess.Popen(
+        [sys.executable, "-m", "openr_tpu.platform.main", "--port", "0"],
+        cwd=REPO,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    procs = [agent]
+    try:
+        line = ""
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            line = agent.stdout.readline()
+            if line.startswith("READY"):
+                break
+        m = re.match(r"READY fib=(\d+)", line)
+        assert m, f"agent not ready: {line!r}"
+        fib_port = int(m.group(1))
+
+        port_a, port_b = 16671, 16672
+        cfgs = {}
+        for name, udp in (("plat-a", port_a), ("plat-b", port_b)):
+            cfg = {
+                "node_name": name,
+                "openr_ctrl_port": 0,
+                "spark_config": {
+                    **FAST_TIMERS,
+                    "neighbor_discovery_port": udp,
+                },
+                "decision_config": {
+                    "debounce_min_ms": 10, "debounce_max_ms": 50,
+                },
+                "kvstore_config": {},
+                "enable_watchdog": False,
+                "originated_prefixes": [
+                    {"prefix": f"10.77.{1 if name == 'plat-a' else 2}.0/24",
+                     "install_to_fib": False}
+                ],
+            }
+            path = tmp_path / f"{name}.conf"
+            path.write_text(json.dumps(cfg))
+            cfgs[name] = str(path)
+
+        def spawn(name, iface_port, peer_port, extra=()):
+            return subprocess.Popen(
+                [
+                    sys.executable, "-m", "openr_tpu.main",
+                    "--config", cfgs[name],
+                    "--interface", f"if0=127.0.0.1:{iface_port}",
+                    "--peer", f"if0=127.0.0.1:{peer_port}",
+                    "--ctrl-port", "0",
+                    *extra,
+                ],
+                cwd=REPO,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+
+        pa = spawn("plat-a", port_a, port_b,
+                   ("--fib-service", f"127.0.0.1:{fib_port}"))
+        pb = spawn("plat-b", port_b, port_a)
+        procs += [pa, pb]
+
+        for p in (pa, pb):
+            deadline = time.monotonic() + 30
+            ok = False
+            while time.monotonic() < deadline:
+                line = p.stdout.readline()
+                if line.startswith("READY"):
+                    ok = True
+                    break
+            assert ok, "daemon did not report READY"
+
+        # poll the AGENT's table for b's prefix programmed by daemon a
+        async def check():
+            svc = RemoteFibService(port=fib_port)
+            try:
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    table = await svc.get_route_table()
+                    if "10.77.2.0/24" in table["unicast"]:
+                        return table
+                    await asyncio.sleep(0.3)
+                raise AssertionError(f"route never programmed: {table}")
+            finally:
+                await svc.close()
+
+        table = asyncio.run(check())
+        nhs = table["unicast"]["10.77.2.0/24"]["nexthops"]
+        assert nhs and nhs[0]["neighbor_node_name"] == "plat-b"
+
+        for p in (pa, pb):
+            p.send_signal(signal.SIGTERM)
+            assert p.wait(timeout=15) == 0
+        agent.send_signal(signal.SIGTERM)
+        assert agent.wait(timeout=15) == 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
