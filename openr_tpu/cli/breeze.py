@@ -19,13 +19,15 @@ import click
 from openr_tpu.runtime.rpc import RpcClient
 
 
-def _call(ctx, method: str, params: Optional[dict] = None) -> Any:
+def _call(
+    ctx, method: str, params: Optional[dict] = None, timeout_s: float = 30.0
+) -> Any:
     """One-shot RPC against the ctrl server."""
 
     async def run():
         client = RpcClient(ctx.obj["host"], ctx.obj["port"], name="breeze")
         try:
-            return await client.request(method, params or {})
+            return await client.request(method, params or {}, timeout_s)
         finally:
             await client.close()
 
@@ -100,6 +102,41 @@ def peers(ctx, area) -> None:
     _print(_call(ctx, "ctrl.kvstore.peers", {"area": area}))
 
 
+@kvstore.command("long-poll-adj")
+@click.option("--area", default="0")
+@click.option(
+    "--snapshot",
+    default="{}",
+    help='JSON {"adj:node": version, ...} the caller last saw',
+)
+@click.option("--timeout", default=290.0, type=float)
+@click.pass_context
+def long_poll_adj(ctx, area, snapshot, timeout) -> None:
+    """Block until any adjacency key changes vs the snapshot."""
+    _print(
+        _call(
+            ctx,
+            "ctrl.kvstore.long_poll_adj",
+            {
+                "area": area,
+                "snapshot": json.loads(snapshot),
+                "timeout_s": timeout,
+            },
+            timeout_s=timeout + 10,
+        )
+    )
+
+
+@openr.command("dryrun-config")
+@click.argument("config_file", type=click.Path(exists=True))
+@click.pass_context
+def dryrun_config(ctx, config_file) -> None:
+    """Validate a config file against the running node's parser."""
+    with open(config_file) as fh:
+        payload = json.load(fh)
+    _print(_call(ctx, "ctrl.config.dryrun", {"config": payload}))
+
+
 # -- decision ---------------------------------------------------------------
 
 @cli.group()
@@ -146,10 +183,24 @@ def received_routes(ctx) -> None:
 
 @decision.command("rib-policy")
 @click.option("--clear", is_flag=True, help="remove the active policy")
+@click.option(
+    "--set",
+    "set_json",
+    default=None,
+    help="install a policy from JSON (statements + ttl_secs)",
+)
 @click.pass_context
-def rib_policy(ctx, clear) -> None:
+def rib_policy(ctx, clear, set_json) -> None:
     if clear:
         _print(_call(ctx, "ctrl.decision.clear_rib_policy"))
+    elif set_json is not None:
+        _print(
+            _call(
+                ctx,
+                "ctrl.decision.set_rib_policy",
+                {"policy": json.loads(set_json)},
+            )
+        )
     else:
         _print(_call(ctx, "ctrl.decision.get_rib_policy"))
 

@@ -68,6 +68,8 @@ class CtrlServer(Actor):
             s.register("ctrl.kvstore.dump", self._kv_dump)
             s.register("ctrl.kvstore.peers", self._kv_peers)
             s.register("ctrl.kvstore.set", self._kv_set)
+            s.register("ctrl.kvstore.long_poll_adj", self._kv_long_poll_adj)
+        s.register("ctrl.config.dryrun", self._dryrun_config)
         if self.decision is not None:
             s.register("ctrl.decision.routes", self._decision_routes)
             s.register(
@@ -287,6 +289,78 @@ class CtrlServer(Actor):
             p: to_plain(e)
             for p, e in (await self.prefix_manager.get_prefixes()).items()
         }
+
+    async def _kv_long_poll_adj(
+        self,
+        area: str = "0",
+        snapshot: Optional[dict] = None,
+        timeout_s: float = 290.0,
+    ) -> dict:
+        """Long-poll for adjacency-key changes (ref
+        longPollKvStoreAdjArea, OpenrCtrl.thrift:262 + the handler's
+        long-poll fiber bookkeeping): `snapshot` maps adj: key ->
+        version as the client last saw it; the call returns
+        {"changed": true} as soon as any adjacency key in the area is
+        new, bumped, or gone relative to the snapshot, or
+        {"changed": false} at timeout. An empty snapshot returns
+        immediately with the current truth (any adj key counts as
+        changed)."""
+        from openr_tpu.types import ADJ_DB_MARKER
+
+        snap = {k: int(v) for k, v in (snapshot or {}).items()}
+
+        def changed_vs_snapshot(cur: dict) -> bool:
+            for k, ver in cur.items():
+                if snap.get(k, -1) < ver:
+                    return True
+            return any(k not in cur for k in snap)
+
+        def adj_versions(vals: dict) -> dict:
+            return {
+                k: v.version
+                for k, v in vals.items()
+                if k.startswith(ADJ_DB_MARKER)
+            }
+
+        current = adj_versions(await self.kvstore.dump_all(area))
+        if changed_vs_snapshot(current):
+            return {"changed": True}
+        if self._kvstore_updates_q is None:
+            return {"changed": False}
+        reader = self._kvstore_updates_q.get_reader(f"{self.name}.longpoll")
+        try:
+            deadline = time.monotonic() + timeout_s
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return {"changed": False}
+                try:
+                    item = await asyncio.wait_for(reader.get(), remaining)
+                except asyncio.TimeoutError:
+                    return {"changed": False}
+                if not isinstance(item, Publication) or item.area != area:
+                    continue
+                pub_adj = adj_versions(item.key_vals)
+                if changed_vs_snapshot({**current, **pub_adj}):
+                    return {"changed": True}
+                if any(
+                    k.startswith(ADJ_DB_MARKER) for k in item.expired_keys
+                ):
+                    return {"changed": True}
+        finally:
+            self._kvstore_updates_q.remove_reader(reader)
+
+    async def _dryrun_config(self, config: dict) -> dict:
+        """Validate a config payload without applying it (ref
+        dryrunConfig, OpenrCtrl.thrift:269-277): returns the parsed,
+        defaulted config on success or the validation error."""
+        from openr_tpu.config import Config, ConfigError, OpenrConfig
+
+        try:
+            cfg = Config(from_plain(config, OpenrConfig))
+        except (ConfigError, TypeError, ValueError, KeyError) as e:
+            return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        return {"ok": True, "config": to_plain(cfg.raw)}
 
     # -- streaming subscriptions (ref OpenrCtrlHandler.h:351-389) ----------
 

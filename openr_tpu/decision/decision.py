@@ -40,6 +40,7 @@ from openr_tpu.decision.rib_policy import RibPolicy
 from openr_tpu.decision.spf_solver import SpfSolver
 from openr_tpu.messaging import RQueue, ReplicateQueue
 from openr_tpu.runtime.actor import Actor
+from openr_tpu.serde import from_plain, to_plain
 from openr_tpu.runtime.counters import counters
 from openr_tpu.runtime.throttle import AsyncDebounce
 from openr_tpu.serde import deserialize
@@ -125,8 +126,12 @@ class Decision(Actor):
         route_updates_queue: ReplicateQueue,
         solver_backend: Optional[str] = None,
         solver_kwargs: Optional[dict] = None,
+        persistent_store=None,
     ):
         super().__init__(f"decision:{node_name}")
+        # crash-safe RibPolicy home (ref FLAGS_rib_policy_file role;
+        # Decision.cpp:646-728 save/load with absolute-TTL adjustment)
+        self._store = persistent_store
         self.node_name = node_name
         self.cfg = config
         self._kvstore_updates = kvstore_updates_queue
@@ -166,6 +171,7 @@ class Decision(Actor):
         self.add_task(self._kvstore_loop(), name=f"{self.name}.kvstore")
         if self._static_routes is not None:
             self.add_task(self._static_loop(), name=f"{self.name}.static")
+        self._load_saved_rib_policy()
 
     async def on_stop(self) -> None:
         if self._rebuild_debounced is not None:
@@ -421,9 +427,57 @@ class Decision(Actor):
     async def get_received_routes(self):
         return self.prefix_state.received_routes()
 
+    _RIB_POLICY_KEY = "rib-policy"
+
+    def _save_rib_policy(self) -> None:
+        """Persist the active policy with a WALL-clock deadline so a
+        restarted daemon can subtract elapsed downtime (ref
+        saveRibPolicy, Decision.cpp:646-686)."""
+        if self._store is None or not self.cfg.save_rib_policy:
+            return
+        if self.rib_policy is None:
+            self._store.erase(self._RIB_POLICY_KEY)
+            return
+        self._store.store_obj(
+            self._RIB_POLICY_KEY,
+            {
+                "statements": to_plain(self.rib_policy.statements),
+                "ttl_secs": self.rib_policy.ttl_secs,
+                "valid_until_wall": (
+                    time.time() + self.rib_policy.remaining_ttl_secs()
+                ),
+            },
+        )
+
+    def _load_saved_rib_policy(self) -> None:
+        """Re-arm a saved policy with its REMAINING validity; drop it if
+        it expired while the daemon was down (ref readRibPolicy,
+        Decision.cpp:688-728)."""
+        if self._store is None or not self.cfg.save_rib_policy:
+            return
+        saved = self._store.load_obj(self._RIB_POLICY_KEY, dict)
+        if not saved:
+            return
+        remaining = saved.get("valid_until_wall", 0) - time.time()
+        if remaining <= 0:
+            return
+        policy = from_plain(
+            {
+                "statements": saved["statements"],
+                "ttl_secs": saved["ttl_secs"],
+            },
+            RibPolicy,
+        )
+        policy.valid_until = time.monotonic() + remaining
+        self.rib_policy = policy
+        self.pending.needs_full_rebuild = True
+        self._trigger_rebuild()
+        self.schedule(remaining + 0.01, self._on_policy_expiry)
+
     async def set_rib_policy(self, policy: RibPolicy) -> None:
         policy.arm()
         self.rib_policy = policy
+        self._save_rib_policy()
         self.pending.needs_full_rebuild = True
         self._trigger_rebuild()
         # re-arm a rebuild at policy expiry so its effects revert on time
@@ -442,5 +496,6 @@ class Decision(Actor):
 
     async def clear_rib_policy(self) -> None:
         self.rib_policy = None
+        self._save_rib_policy()
         self.pending.needs_full_rebuild = True
         self._trigger_rebuild()

@@ -70,8 +70,9 @@ class MemoryDataplane:
             self.mpls.pop(label, None)
 
     async def sync_mpls(self, routes: dict[int, dict]) -> list[int]:
-        self.mpls = dict(routes)
-        return []
+        failed = [l for l in routes if l in self.fail_labels]
+        self.mpls = {l: r for l, r in routes.items() if l not in failed}
+        return failed
 
     async def dump_unicast(self) -> dict:
         return self.unicast

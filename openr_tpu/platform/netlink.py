@@ -163,8 +163,10 @@ class NetlinkRouteSocket:
             # a timed-out request still holds a window slot (_complete
             # releases only for answered requests) — release it here, or
             # lost kernel replies would leak slots until every _send
-            # deadlocks in acquire()
-            if self._pending.pop(seq, None) is not None and not fut.done():
+            # deadlocks in acquire(). wait_for CANCELS the future on
+            # timeout (a cancelled future reads as done), so the "did
+            # _complete ever run" test is cancelled(), not done().
+            if self._pending.pop(seq, None) is not None and fut.cancelled():
                 self._window.release()
 
     def _on_readable(self) -> None:

@@ -128,6 +128,7 @@ class OpenrWrapper:
             self.static_routes_queue.get_reader(),
             self.route_updates_queue,
             solver_backend=solver_backend,
+            persistent_store=persistent_store,
         )
         self.ctrl: "CtrlServer | None" = None
         self._enable_ctrl = enable_ctrl

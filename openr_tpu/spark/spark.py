@@ -30,6 +30,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -119,6 +120,8 @@ class _NeighborInfo:
     my_last_rcvd_ts_us: int = 0
     rtt_us: int = 0
     reported_rtt_us: int = 0
+    # sliding sample window for the step detector
+    rtt_samples: deque = field(default_factory=deque)
     hold_time_ms: int = 0
     gr_active: bool = False
     restarted: bool = False  # came back through RESTART
@@ -432,13 +435,36 @@ class Spark(Actor):
         if rtt <= 0:
             return
         nb.rtt_us = rtt
-        # step detection: report only significant moves (ref StepDetector)
+        # StepDetector (ref StepDetector.h + config knobs
+        # OpenrConfig.thrift:223): compare the fast-window MEAN against
+        # the last reported value, and report only when the move clears
+        # BOTH the relative threshold and the absolute ads_threshold.
+        # Raw per-hello RTT jitters by far more than 10% on fast links;
+        # advertising every wiggle re-floods the adjacency fabric-wide
+        # and churns every node's SPF.
+        sd = self.cfg.step_detector_conf
+        nb.rtt_samples.append(rtt)
+        while len(nb.rtt_samples) > sd.fast_window_size:
+            nb.rtt_samples.popleft()
+        mean = sum(nb.rtt_samples) / len(nb.rtt_samples)
         if nb.reported_rtt_us == 0:
-            nb.reported_rtt_us = rtt
+            nb.reported_rtt_us = int(mean)
             return
-        change = abs(rtt - nb.reported_rtt_us) / nb.reported_rtt_us
-        if change > 0.1 and nb.state == SparkNeighState.ESTABLISHED:
-            nb.reported_rtt_us = rtt
+        diff = abs(mean - nb.reported_rtt_us)
+        # hysteresis per the reference: increases must clear the upper
+        # threshold, decreases the (tighter) lower one — worse news needs
+        # more evidence than better news reverting
+        pct = (
+            sd.upper_threshold_pct
+            if mean > nb.reported_rtt_us
+            else sd.lower_threshold_pct
+        )
+        if (
+            diff * 100 > nb.reported_rtt_us * pct
+            and diff >= sd.ads_threshold
+            and nb.state == SparkNeighState.ESTABLISHED
+        ):
+            nb.reported_rtt_us = int(mean)
             self._emit(nb, NeighborEventType.NEIGHBOR_RTT_CHANGE)
 
     # -- handshake (ref processHandshakeMsg Spark.h:145) -------------------

@@ -220,3 +220,76 @@ class TestBreezeCli:
         finally:
             loop_holder["loop"].call_soon_threadsafe(stop.set)
             t.join(timeout=30)
+
+
+class TestLongPollAndDryrun:
+    @run_async
+    async def test_long_poll_adj_immediate_and_blocking(self):
+        mesh, a, b = await start_two_node()
+        client = RpcClient("127.0.0.1", a.ctrl.port)
+        try:
+            # stale (empty) snapshot: current adj keys count as changed
+            res = await client.request(
+                "ctrl.kvstore.long_poll_adj", {"area": "0", "snapshot": {}}
+            )
+            assert res["changed"] is True
+
+            # up-to-date snapshot: no change within a short window
+            dump = await client.request("ctrl.kvstore.dump", {"area": "0"})
+            snap = {
+                k: v["version"]
+                for k, v in dump.items()
+                if k.startswith("adj:")
+            }
+            res = await client.request(
+                "ctrl.kvstore.long_poll_adj",
+                {"area": "0", "snapshot": snap, "timeout_s": 0.3},
+            )
+            assert res["changed"] is False
+
+            # blocking poll completes when an adjacency key changes
+            # (link-flap backoff on the lost link bumps the adj db)
+            poll = asyncio.create_task(
+                client.request(
+                    "ctrl.kvstore.long_poll_adj",
+                    {"area": "0", "snapshot": snap, "timeout_s": 10.0},
+                    timeout_s=15.0,
+                )
+            )
+            await asyncio.sleep(0.1)
+            mesh.disconnect("node-a", "if-ab", "node-b", "if-ba")
+            res = await asyncio.wait_for(poll, 15.0)
+            assert res["changed"] is True
+        finally:
+            await client.close()
+            await a.stop()
+            await b.stop()
+
+    @run_async
+    async def test_dryrun_config(self):
+        mesh, a, b = await start_two_node()
+        client = RpcClient("127.0.0.1", a.ctrl.port)
+        try:
+            good = await client.request(
+                "ctrl.config.dryrun",
+                {"config": {"node_name": "candidate", "areas": [
+                    {"area_id": "0"}]}},
+            )
+            assert good["ok"] is True
+            assert good["config"]["node_name"] == "candidate"
+
+            bad = await client.request(
+                "ctrl.config.dryrun",
+                {
+                    "config": {
+                        "node_name": "x",
+                        "decision_config": {"solver_backend": "quantum"},
+                    }
+                },
+            )
+            assert bad["ok"] is False
+            assert "solver_backend" in bad["error"]
+        finally:
+            await client.close()
+            await a.stop()
+            await b.stop()

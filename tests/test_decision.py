@@ -65,18 +65,21 @@ def prefix_db_kv(node: str, prefix: str, version: int = 1, **entry_kw):
 class DecisionHarness:
     """Queues + actor + a reader on the route-updates queue."""
 
-    def __init__(self, node: str = "1", backend: str = "cpu"):
+    def __init__(self, node: str = "1", backend: str = "cpu",
+                 config: "DecisionConfig | None" = None,
+                 persistent_store=None):
         self.kv_q = ReplicateQueue("kvStoreUpdates")
         self.static_q = ReplicateQueue("staticRoutes")
         self.routes_q = ReplicateQueue("routeUpdates")
         self.routes_reader = self.routes_q.get_reader("test")
         self.decision = Decision(
             node,
-            DecisionConfig(debounce_min_ms=5, debounce_max_ms=20),
+            config or DecisionConfig(debounce_min_ms=5, debounce_max_ms=20),
             self.kv_q.get_reader(),
             self.static_q.get_reader(),
             self.routes_q,
             solver_backend=backend,
+            persistent_store=persistent_store,
         )
 
     async def __aenter__(self):
@@ -450,3 +453,96 @@ class TestFabricRouteDbs:
         # equality above ran with enable_lfa=True on both backends, so a
         # fallback that dropped the flag would have diverged
         assert results["cpu"] == results["tpu"]
+
+
+class TestRibPolicyPersistence:
+    @run_async
+    async def test_policy_survives_restart_with_ttl_adjustment(self, tmp=None):
+        """ref Decision.cpp:646-728: a saved policy re-arms on restart
+        with only its REMAINING validity; an expired one is dropped."""
+        import tempfile
+
+        from openr_tpu.runtime.persistent_store import PersistentStore
+
+        with tempfile.TemporaryDirectory() as d:
+            store = PersistentStore(d + "/store.bin")
+            cfg = DecisionConfig(
+                debounce_min_ms=5, debounce_max_ms=20, save_rib_policy=True
+            )
+            policy = RibPolicy(
+                statements=(
+                    RibPolicyStatement(
+                        name="drop-via-2",
+                        prefixes=("10.0.0.2/32",),
+                        action=RibRouteActionWeight(
+                            default_weight=1, neighbor_to_weight={"2": 7}
+                        ),
+                    ),
+                ),
+                ttl_secs=60,
+            )
+            async with DecisionHarness(
+                config=cfg, persistent_store=store
+            ) as h:
+                two_node_mesh(h)
+                h.synced()
+                await h.next_route_update()
+                await h.decision.set_rib_policy(policy)
+                await h.next_route_update()
+
+            # "restart": same store file, fresh actor — policy re-applies
+            store2 = PersistentStore(d + "/store.bin")
+            async with DecisionHarness(
+                config=cfg, persistent_store=store2
+            ) as h2:
+                two_node_mesh(h2)
+                h2.synced()
+                update = await h2.next_route_update()
+                entry = update.unicast_routes_to_update["10.0.0.2/32"]
+                assert all(nh.weight == 7 for nh in entry.nexthops)
+                got = await h2.decision.get_rib_policy()
+                assert got is not None
+                assert got.remaining_ttl_secs() <= 60
+
+                # clearing erases the saved copy
+                await h2.decision.clear_rib_policy()
+                await h2.next_route_update()
+
+            store3 = PersistentStore(d + "/store.bin")
+            async with DecisionHarness(
+                config=cfg, persistent_store=store3
+            ) as h3:
+                two_node_mesh(h3)
+                h3.synced()
+                update = await h3.next_route_update()
+                entry = update.unicast_routes_to_update["10.0.0.2/32"]
+                assert all(nh.weight == 0 for nh in entry.nexthops)
+
+    @run_async
+    async def test_expired_saved_policy_dropped_on_restart(self):
+        import tempfile
+        import time as _t
+
+        from openr_tpu.runtime.persistent_store import PersistentStore
+
+        with tempfile.TemporaryDirectory() as d:
+            store = PersistentStore(d + "/store.bin")
+            store.store_obj(
+                "rib-policy",
+                {
+                    "statements": [],
+                    "ttl_secs": 1,
+                    "valid_until_wall": _t.time() - 5,
+                },
+            )
+            cfg = DecisionConfig(
+                debounce_min_ms=5, debounce_max_ms=20, save_rib_policy=True
+            )
+            store2 = PersistentStore(d + "/store.bin")
+            async with DecisionHarness(
+                config=cfg, persistent_store=store2
+            ) as h:
+                two_node_mesh(h)
+                h.synced()
+                await h.next_route_update()
+                assert await h.decision.get_rib_policy() is None
