@@ -102,6 +102,14 @@ def peers(ctx, area) -> None:
     _print(_call(ctx, "ctrl.kvstore.peers", {"area": area}))
 
 
+@kvstore.command("flood-topo")
+@click.option("--area", default="0")
+@click.pass_context
+def flood_topo(ctx, area) -> None:
+    """DUAL spanning-tree flooding state."""
+    _print(_call(ctx, "ctrl.kvstore.flood_topo", {"area": area}))
+
+
 @kvstore.command("long-poll-adj")
 @click.option("--area", default="0")
 @click.option(

@@ -44,6 +44,9 @@ class KvstoreConfig:
     flood_rate_burst_size: int = 0
     self_adjacency_timeout_warn_ms: int = 10_000
     enable_flood_optimization: bool = False  # DUAL SPT flooding
+    # this node originates a flood-root SPT (ref flood_root_id /
+    # is_flood_root): a few well-connected nodes per area should set it
+    is_flood_root: bool = False
     max_parallel_initial_syncs: int = 32
 
 

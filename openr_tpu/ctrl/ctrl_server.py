@@ -69,6 +69,7 @@ class CtrlServer(Actor):
             s.register("ctrl.kvstore.peers", self._kv_peers)
             s.register("ctrl.kvstore.set", self._kv_set)
             s.register("ctrl.kvstore.long_poll_adj", self._kv_long_poll_adj)
+            s.register("ctrl.kvstore.flood_topo", self._kv_flood_topo)
         s.register("ctrl.config.dryrun", self._dryrun_config)
         if self.decision is not None:
             s.register("ctrl.decision.routes", self._decision_routes)
@@ -288,6 +289,21 @@ class CtrlServer(Actor):
         return {
             p: to_plain(e)
             for p, e in (await self.prefix_manager.get_prefixes()).items()
+        }
+
+    async def _kv_flood_topo(self, area: str = "0") -> dict:
+        """DUAL spanning-tree state (ref getSpmsimFloodTopo-style
+        introspection): per-root state/parent/children, the active SPT
+        peer set, and whether flooding is tree- or mesh-mode."""
+        st = self.kvstore.areas.get(area)
+        if st is None or st.dual is None:
+            return {"enabled": False}
+        spt = st.dual.flood_peers()
+        return {
+            "enabled": True,
+            "mode": "spt" if spt is not None else "full-mesh",
+            "flood_peers": sorted(spt) if spt is not None else None,
+            "roots": st.dual.status(),
         }
 
     async def _kv_long_poll_adj(

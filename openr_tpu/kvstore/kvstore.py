@@ -77,6 +77,7 @@ class Peer:
     spec: PeerSpec
     state: KvStorePeerState = KvStorePeerState.IDLE
     client: Optional[RpcClient] = None
+    last_full_sync: float = 0.0  # monotonic; anti-entropy round-robin key
     backoff: ExponentialBackoff = field(
         default_factory=lambda: ExponentialBackoff(
             _PEER_SYNC_BACKOFF_MIN_S, _PEER_SYNC_BACKOFF_MAX_S
@@ -104,6 +105,8 @@ class KvStoreArea:
         self.self_originated: dict[str, SelfOriginatedValue] = {}
         self.ttl_queue = TtlCountdownQueue()
         self.initial_sync_done = False  # all initial peers INITIALIZED
+        # DUAL SPT flood topology (ref Dual.h; None = full-mesh flooding)
+        self.dual: Optional["Dual"] = None
 
     def hashes(self) -> dict[str, Value]:
         return dump_hash_with_filters(self.area, self.kv).key_vals
@@ -129,6 +132,24 @@ class KvStore(Actor):
         self.areas: dict[str, KvStoreArea] = {
             a: KvStoreArea(a, node_name, config) for a in areas
         }
+        if config.enable_flood_optimization:
+            from openr_tpu.kvstore.dual import Dual
+
+            for st in self.areas.values():
+                st.dual = Dual(
+                    node_name,
+                    send=(
+                        lambda peer, msg, _st=st: self._dual_send(
+                            _st, peer, msg
+                        )
+                    ),
+                    is_root=config.is_flood_root,
+                    on_parent_change=(
+                        lambda root, parent, _st=st: (
+                            self._on_dual_parent_change(_st, root, parent)
+                        )
+                    ),
+                )
         self._peer_updates = peer_updates_queue
         self._kv_requests = kv_request_queue
         self._updates_q = kvstore_updates_queue
@@ -154,12 +175,17 @@ class KvStore(Actor):
         self.server.register("kvstore.set_key_vals", self._rpc_set_key_vals)
         self.server.register("kvstore.dump_filtered", self._rpc_dump_filtered)
         self.server.register("kvstore.dump_hashes", self._rpc_dump_hashes)
+        self.server.register("kvstore.dual", self._rpc_dual)
         self.port = await self.server.start(port=self._listen_port)
         self.add_task(self._peer_updates_loop(), name=f"{self.name}.peers")
         self.add_task(self._kv_requests_loop(), name=f"{self.name}.requests")
         self.add_task(self._sync_loop(), name=f"{self.name}.sync")
         self.add_task(self._ttl_loop(), name=f"{self.name}.ttl")
         self.add_task(self._ttl_refresh_loop(), name=f"{self.name}.ttl-refresh")
+        if self.cfg.sync_interval_s > 0:
+            self.add_task(
+                self._anti_entropy_loop(), name=f"{self.name}.anti-entropy"
+            )
 
     async def on_stop(self) -> None:
         await self.server.stop()
@@ -209,6 +235,63 @@ class KvStore(Actor):
             pub = dump_all_with_filters(area, st.kv, filters)
         self._decrement_out_ttls(pub)
         return to_plain(pub)
+
+    async def _rpc_dual(self, area: str, sender_id: str, msg: dict) -> dict:
+        """DUAL message ingress (ref processDualMessages)."""
+        st = self.areas.get(area)
+        if st is not None and st.dual is not None:
+            st.dual.handle_message(sender_id, msg)
+        return {}
+
+    def _on_dual_parent_change(self, st: KvStoreArea, root, parent) -> None:
+        """Full-sync with a newly adopted SPT parent: publications that
+        flooded over the tree while this node was attaching would
+        otherwise be missed until the periodic anti-entropy sync (ref
+        dual parent-change sync behavior). Only the SELECTED flooding
+        root's tree matters — parent churn on secondary roots must not
+        trigger sync storms."""
+        if parent is None or st.dual is None:
+            return
+        if st.dual.current_root() != root:
+            return
+        peer = st.peers.get(parent)
+        if peer is not None and peer.state == KvStorePeerState.INITIALIZED:
+            peer.state = KvStorePeerState.IDLE
+            self._sync_wakeup.set()
+
+    def _dual_send(self, st: KvStoreArea, peer_name: str, msg: dict) -> None:
+        """Fire-and-forget DUAL egress over the peer's session; transport
+        loss is healed by the next update/peer-FSM round trip."""
+        peer = st.peers.get(peer_name)
+        if peer is None or peer.client is None:
+            return
+
+        async def send(client=peer.client):
+            try:
+                await client.request(
+                    "kvstore.dual",
+                    {
+                        "area": st.area,
+                        "sender_id": self.node_name,
+                        "msg": msg,
+                    },
+                )
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # a lost DUAL message on a "healthy" session would leave
+                # permanently divergent tree state (missing child claim,
+                # querier stuck ACTIVE). Treat transport failure like a
+                # flood failure: reset the session — the peer_down/up
+                # cycle discards pending replies and re-introduces state
+                # on both sides.
+                log.info(
+                    "%s: dual send to %s failed; resetting peer",
+                    self.name, peer_name,
+                )
+                self._reset_peer(st, peer)
+
+        self.add_task(send(), name=f"{self.name}.dual:{peer_name}")
 
     async def _rpc_dump_hashes(self, area: str, prefix: str = "") -> dict:
         st = self.areas[area]
@@ -289,7 +372,19 @@ class KvStore(Actor):
         self._decrement_out_ttls(flood)
         if not flood.key_vals:
             return
+        # DUAL flood optimization: restrict the fan-out to the spanning
+        # tree (parent + children) when one is converged; None falls back
+        # to full mesh (no reachable root / mid-diffusion), and KvStore's
+        # periodic full sync heals any reconvergence-window gaps
+        # (ref Dual.h:27-100 + floodPublication's SPT peer selection)
+        spt = st.dual.flood_peers() if st.dual is not None else None
+        if spt is not None:
+            counters.increment(
+                f"kvstore.{self.node_name}.flood_spt", len(spt)
+            )
         for peer in st.peers.values():
+            if spt is not None and peer.node_name not in spt:
+                continue
             # Flood to INITIALIZED peers, and to SYNCING peers with a live
             # session: a merge landing between a peer's dump-request and our
             # sync completion would otherwise never reach it (the 3-way
@@ -375,6 +470,8 @@ class KvStore(Actor):
                 self.add_task(
                     peer.client.close(), name=f"{self.name}.close:{name}"
                 )
+            if peer is not None and st.dual is not None:
+                st.dual.peer_down(name)
         for name, spec in ev.peers_to_add.items():
             existing = st.peers.get(name)
             if existing is not None and existing.spec == spec:
@@ -383,6 +480,10 @@ class KvStore(Actor):
                 self.add_task(
                     existing.client.close(), name=f"{self.name}.close:{name}"
                 )
+            if existing is not None and st.dual is not None:
+                # spec change = new incarnation: the old one's distances/
+                # child role must not survive into the new session
+                st.dual.peer_down(name)
             st.peers[name] = Peer(node_name=name, spec=spec)
             counters.increment(f"kvstore.{self.node_name}.peers_added")
         self._initial_peers_received = True
@@ -394,12 +495,40 @@ class KvStore(Actor):
             return
         peer.state = KvStorePeerState.IDLE
         peer.backoff.report_error()
+        if st.dual is not None:
+            st.dual.peer_down(peer.node_name)
         if peer.client is not None:
             client, peer.client = peer.client, None
             self.add_task(
                 client.close(), name=f"{self.name}.close:{peer.node_name}"
             )
         self._sync_wakeup.set()
+
+    async def _anti_entropy_loop(self) -> None:
+        """Periodic full-sync round robin over INITIALIZED peers
+        (cfg.sync_interval_s; role of the reference's periodic KvStore
+        sync): bounds how long ANY flood gap can persist — an SPT
+        reconvergence window, or a message lost without a transport
+        error. One stalest peer per area per tick keeps the overhead
+        O(1); every peer is re-synced within peers*interval."""
+        while True:
+            await asyncio.sleep(self.cfg.sync_interval_s)
+            now = time.monotonic()
+            for st in self.areas.values():
+                cands = [
+                    p
+                    for p in st.peers.values()
+                    if p.state == KvStorePeerState.INITIALIZED
+                ]
+                if not cands:
+                    continue
+                stalest = min(cands, key=lambda p: p.last_full_sync)
+                if now - stalest.last_full_sync >= self.cfg.sync_interval_s:
+                    stalest.state = KvStorePeerState.IDLE
+                    counters.increment(
+                        f"kvstore.{self.node_name}.anti_entropy_syncs"
+                    )
+                    self._sync_wakeup.set()
 
     async def _sync_loop(self) -> None:
         """Drive IDLE peers through full sync, bounded by the parallel-sync
@@ -523,6 +652,9 @@ class KvStore(Actor):
             return
         peer.state = KvStorePeerState.INITIALIZED
         peer.backoff.report_success()
+        peer.last_full_sync = time.monotonic()
+        if st.dual is not None:
+            st.dual.peer_up(peer.node_name)
         self._parallel_sync_limit = min(
             self.cfg.max_parallel_initial_syncs, self._parallel_sync_limit * 2
         )
