@@ -1,0 +1,59 @@
+"""Embed a KvStore client agent next to a running node (role of the
+reference's examples/KvStoreAgent.{h,cpp}: persist an app key, watch
+deltas).
+
+    python examples/kvstore_agent.py --port <ctrl-port> --key app:demo
+"""
+
+import argparse
+import asyncio
+import json
+
+from openr_tpu.runtime.rpc import RpcClient
+from openr_tpu.serde import to_plain
+from openr_tpu.types import Value
+
+
+async def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--key", default="app:kvstore-agent")
+    ap.add_argument("--value", default="hello")
+    ap.add_argument("--area", default="0")
+    args = ap.parse_args()
+
+    client = RpcClient("127.0.0.1", args.port, name="kvstore-agent")
+    # persist our key (the node floods it area-wide)
+    await client.request(
+        "ctrl.kvstore.set",
+        {
+            "area": args.area,
+            "key": args.key,
+            "value": to_plain(
+                Value(
+                    version=1,
+                    originator_id="kvstore-agent",
+                    value=args.value.encode(),
+                    ttl_ms=60_000,
+                )
+            ),
+        },
+    )
+    print(f"persisted {args.key}")
+
+    # watch deltas (snapshot + live) — ref KvStoreAgent subscription
+    queue = await client.subscribe(
+        "ctrl.kvstore.subscribe", {"area": args.area}
+    )
+    while True:
+        item = await queue.get()
+        if item is None or isinstance(item, Exception):
+            break
+        if "snapshot" in item:
+            print(f"snapshot: {len(item['snapshot'])} keys")
+        else:
+            print("delta:", json.dumps(item["delta"]["key_vals"], default=str))
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,37 @@
+"""Poll several nodes' stores and diff them (role of the reference's
+examples/KvStorePoller.*).
+
+    python examples/kvstore_poller.py --ports 2018 2019 2020
+"""
+
+import argparse
+import asyncio
+
+from openr_tpu.runtime.rpc import RpcClient
+
+
+async def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ports", type=int, nargs="+", required=True)
+    ap.add_argument("--area", default="0")
+    args = ap.parse_args()
+
+    dumps = {}
+    for port in args.ports:
+        client = RpcClient("127.0.0.1", port, name=f"poller:{port}")
+        try:
+            dumps[port] = await client.request(
+                "ctrl.kvstore.dump", {"area": args.area}
+            )
+        finally:
+            await client.close()
+    all_keys = sorted({k for d in dumps.values() for k in d})
+    print(f"{len(all_keys)} keys across {len(dumps)} stores")
+    for key in all_keys:
+        versions = {p: d.get(key, {}).get("version") for p, d in dumps.items()}
+        mark = "" if len(set(versions.values())) == 1 else "  <-- DIVERGED"
+        print(f"{key}: {versions}{mark}")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,49 @@
+"""Push a weight-steering RibPolicy into a running node's Decision
+(role of the reference's examples/SetRibPolicyExample.cpp).
+
+    python examples/set_rib_policy.py --port <ctrl-port> \
+        --prefix 10.0.0.2/32 --neighbor node-b --weight 9
+"""
+
+import argparse
+import asyncio
+
+from openr_tpu.runtime.rpc import RpcClient
+
+
+async def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--prefix", required=True)
+    ap.add_argument("--neighbor", required=True)
+    ap.add_argument("--weight", type=int, default=2)
+    ap.add_argument("--ttl-secs", type=int, default=300)
+    args = ap.parse_args()
+
+    policy = {
+        "statements": [
+            {
+                "name": "steer",
+                "prefixes": [args.prefix],
+                "action": {
+                    "default_weight": 1,
+                    "neighbor_to_weight": {args.neighbor: args.weight},
+                },
+            }
+        ],
+        "ttl_secs": args.ttl_secs,
+    }
+    client = RpcClient("127.0.0.1", args.port, name="set-rib-policy")
+    try:
+        await client.request(
+            "ctrl.decision.set_rib_policy", {"policy": policy}
+        )
+        print("policy installed:", await client.request(
+            "ctrl.decision.get_rib_policy"
+        ))
+    finally:
+        await client.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,47 @@
+"""VIP injection plugin (role of the reference's vipPluginStart,
+openr/plugin/Plugin.h:30-44): advertise anycast service prefixes into
+PrefixManager through the plugin queue boundary.
+
+Load via config:  "plugins": ["examples.vip_plugin:plugin"]
+VIPs come from the config extras or the VIPS constant below.
+"""
+
+from openr_tpu.plugins import PluginArgs
+from openr_tpu.types import (
+    PrefixEntry,
+    PrefixEvent,
+    PrefixEventType,
+    PrefixType,
+)
+
+VIPS = ["192.0.2.100/32"]
+
+
+class VipPlugin:
+    def __init__(self, args: PluginArgs):
+        self.args = args
+        self.vips = list(args.extras.get("vips", VIPS))
+
+    async def start(self) -> None:
+        self.args.prefix_updates_queue.push(
+            PrefixEvent(
+                event_type=PrefixEventType.ADD_PREFIXES,
+                type=PrefixType.VIP,
+                prefixes=[
+                    PrefixEntry(prefix=vip, type=PrefixType.VIP)
+                    for vip in self.vips
+                ],
+            )
+        )
+
+    async def stop(self) -> None:
+        self.args.prefix_updates_queue.push(
+            PrefixEvent(
+                event_type=PrefixEventType.WITHDRAW_PREFIXES_BY_TYPE,
+                type=PrefixType.VIP,
+            )
+        )
+
+
+def plugin(args: PluginArgs) -> VipPlugin:
+    return VipPlugin(args)

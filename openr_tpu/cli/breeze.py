@@ -135,6 +135,20 @@ def long_poll_adj(ctx, area, snapshot, timeout) -> None:
     )
 
 
+@openr.command("config")
+@click.pass_context
+def running_config(ctx) -> None:
+    """The node's running config (ref getRunningConfig)."""
+    _print(_call(ctx, "ctrl.config.get"))
+
+
+@openr.command("drain-state")
+@click.pass_context
+def drain_state(ctx) -> None:
+    """Node drain + per-link overrides (ref getDrainState)."""
+    _print(_call(ctx, "openr.drain_state"))
+
+
 @openr.command("dryrun-config")
 @click.argument("config_file", type=click.Path(exists=True))
 @click.pass_context

@@ -192,6 +192,14 @@ class OpenrConfig:
     enable_prefix_allocation: bool = False
     persistent_store_path: str = ""
     originated_prefixes: list[dict] = field(default_factory=list)
+    # origination policy (ref PolicyManager + config-sourced policies):
+    # named policy definitions, and the one PrefixManager applies to
+    # every prefix it advertises ("" = no policy)
+    policies: dict = field(default_factory=dict)
+    origination_policy: str = ""
+    # plugin factories "pkg.module:factory" started after link-monitor
+    # (ref Plugin.h extension points; openr_tpu/plugins)
+    plugins: list[str] = field(default_factory=list)
 
     assume_drained: bool = False
     undrained_flag_path: str = ""
@@ -314,6 +322,58 @@ class Config:
             lo, hi = sr.sr_node_label_range
             if lo >= hi:
                 raise ConfigError("bad node label range")
+        if cfg.origination_policy and cfg.origination_policy not in cfg.policies:
+            raise ConfigError(
+                f"origination_policy {cfg.origination_policy!r} is not in "
+                "policies"
+            )
+        self._validate_policies(cfg)
+
+    @staticmethod
+    def _validate_policies(cfg: OpenrConfig) -> None:
+        """Strict policy validation at load time: the wire codec is
+        forward-compatible (unknown keys are dropped), which for POLICY
+        would turn a typo'd 'accept' into silent accept-all — so here
+        every key is checked against the schema and cover prefixes are
+        parsed, surfacing errors in dryrunConfig and at startup instead
+        of at first advertisement."""
+        if not cfg.policies:
+            return
+        import dataclasses
+
+        from openr_tpu.policy import (
+            Policy,
+            PolicyAction,
+            PolicyMatch,
+            PolicyStatement,
+        )
+
+        def check_keys(value: dict, tp, where: str) -> None:
+            known = {f.name for f in dataclasses.fields(tp)}
+            for key in value:
+                if key not in known:
+                    raise ConfigError(
+                        f"unknown key {key!r} in {where} "
+                        f"(expected one of {sorted(known)})"
+                    )
+
+        for name, pol in cfg.policies.items():
+            if not isinstance(pol, dict):
+                continue  # already a Policy object
+            check_keys(pol, Policy, f"policies[{name!r}]")
+            for i, stmt in enumerate(pol.get("statements", ())):
+                where = f"policies[{name!r}].statements[{i}]"
+                check_keys(stmt, PolicyStatement, where)
+                check_keys(stmt.get("match", {}), PolicyMatch, f"{where}.match")
+                check_keys(
+                    stmt.get("action", {}), PolicyAction, f"{where}.action"
+                )
+                try:
+                    PolicyMatch(
+                        prefixes=tuple(stmt.get("match", {}).get("prefixes", ()))
+                    )
+                except ValueError as e:
+                    raise ConfigError(f"{where}.match.prefixes: {e}") from e
 
     # loading ------------------------------------------------------------
 

@@ -40,6 +40,7 @@ class CtrlServer(Actor):
         kvstore_updates_queue: Optional[ReplicateQueue] = None,
         fib_updates_queue: Optional[ReplicateQueue] = None,
         listen_port: int = 0,
+        config=None,
     ):
         super().__init__(f"ctrl:{node_name}")
         self.node_name = node_name
@@ -52,6 +53,7 @@ class CtrlServer(Actor):
         self._kvstore_updates_q = kvstore_updates_queue
         self._fib_updates_q = fib_updates_queue
         self._listen_port = listen_port
+        self.config = config
         self.server = RpcServer(self.name)
         self.port: int = 0
         self.start_time = time.time()
@@ -71,6 +73,8 @@ class CtrlServer(Actor):
             s.register("ctrl.kvstore.long_poll_adj", self._kv_long_poll_adj)
             s.register("ctrl.kvstore.flood_topo", self._kv_flood_topo)
         s.register("ctrl.config.dryrun", self._dryrun_config)
+        s.register("ctrl.config.get", self._get_config)
+        s.register("openr.drain_state", self._drain_state)
         if self.decision is not None:
             s.register("ctrl.decision.routes", self._decision_routes)
             s.register(
@@ -289,6 +293,23 @@ class CtrlServer(Actor):
         return {
             p: to_plain(e)
             for p, e in (await self.prefix_manager.get_prefixes()).items()
+        }
+
+    async def _get_config(self) -> dict:
+        """Running config dump (ref getRunningConfig)."""
+        if self.config is None:
+            return {}
+        return to_plain(self.config.raw)
+
+    async def _drain_state(self) -> dict:
+        """ref getDrainState: node-level drain plus per-link overrides."""
+        if self.link_monitor is None:
+            return {}
+        st = self.link_monitor.state
+        return {
+            "is_drained": st.is_overloaded,
+            "overloaded_links": sorted(st.overloaded_links),
+            "link_metric_overrides": dict(st.link_metric_overrides),
         }
 
     async def _kv_flood_topo(self, area: str = "0") -> dict:

@@ -73,6 +73,22 @@ def parse_args(argv=None) -> argparse.Namespace:
     return p.parse_args(argv)
 
 
+def _build_policy_manager(oc):
+    """Config policies dict -> PolicyManager (ref PolicyManager built
+    from config areaPolicies, Main.cpp plugin args)."""
+    if not oc.policies:
+        return None
+    from openr_tpu.policy import Policy, PolicyManager
+    from openr_tpu.serde import from_plain
+
+    return PolicyManager(
+        {
+            name: from_plain(p, Policy) if isinstance(p, dict) else p
+            for name, p in oc.policies.items()
+        }
+    )
+
+
 async def run_daemon(args) -> None:
     cfg = Config.from_file(args.config)
     oc = cfg.raw
@@ -139,6 +155,10 @@ async def run_daemon(args) -> None:
         # dedicated kvstore_port field
         kvstore_port_of=lambda ev: ("127.0.0.1", ev.kvstore_port),
         node_label=oc.segment_routing_config.node_segment_label,
+        policy_manager=_build_policy_manager(oc),
+        origination_policy=oc.origination_policy,
+        plugins=oc.plugins,
+        running_config=cfg,
     )
 
     # -- bring up interfaces ----------------------------------------------

@@ -1,0 +1,83 @@
+"""Plugin extension boundary.
+
+Role of the reference's openr/plugin/Plugin.{h,cpp} (:19-44): the
+link-time hooks `pluginStart(PluginArgs)` / `vipPluginStart(...)` that
+closed-source integrations (BGP speaker, VIP injection) attach to. The
+open-source reference ships no-op stubs; the EXTENSION POINT is the
+deliverable — queue handles + config, passed to externally-provided
+code, started after the core modules and stopped before teardown.
+
+Here plugins are named in config (`plugins: ["pkg.module:factory"]`).
+Each factory is called with PluginArgs and returns an object with
+`async start()` / `async stop()`. PluginArgs carries the same
+capabilities the reference's struct does (Plugin.h PluginArgs: queues +
+config):
+
+  prefix_updates_queue   inject/withdraw prefixes (VIP plugin role)
+  static_routes_queue    push static routes into Decision (BGP role)
+  route_updates_reader() fan-out reader over computed route deltas
+  kv_request_queue       persist keys into KvStore
+
+The TPU solver intentionally does NOT live behind this boundary: it is
+a Decision backend (decision.make_solver), not a queue-attached
+sidecar — plugins extend the CONTROL plane.
+"""
+
+from __future__ import annotations
+
+import importlib
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class PluginArgs:
+    """ref Plugin.h PluginArgs{queues, config, ssl} — minus ssl (the
+    RPC layer is plaintext-loopback in this build)."""
+
+    node_name: str
+    config: Any = None  # openr_tpu.config.Config when started by main
+    prefix_updates_queue: Any = None
+    static_routes_queue: Any = None
+    kv_request_queue: Any = None
+    # factory: call to get a fresh reader over computed route updates
+    route_updates_reader: Optional[Callable[[], Any]] = None
+    extras: dict = field(default_factory=dict)
+
+
+def resolve_plugin(spec: str) -> Callable[[PluginArgs], Any]:
+    """'package.module:factory' -> callable."""
+    mod_name, _, attr = spec.partition(":")
+    if not attr:
+        attr = "plugin"
+    mod = importlib.import_module(mod_name)
+    return getattr(mod, attr)
+
+
+class PluginHost:
+    """Owns plugin lifecycles (ref pluginStart/pluginStop call sites in
+    Main.cpp:485-509: start after link-monitor, stop before teardown)."""
+
+    def __init__(self, args: PluginArgs, specs: Optional[list[str]] = None):
+        self.args = args
+        self.specs = list(specs or [])
+        self.plugins: list[Any] = []
+
+    async def start(self) -> None:
+        for spec in self.specs:
+            factory = resolve_plugin(spec)
+            plugin = factory(self.args)
+            await plugin.start()
+            self.plugins.append(plugin)
+            log.info("plugin %s started", spec)
+
+    async def stop(self) -> None:
+        for plugin in reversed(self.plugins):
+            try:
+                await plugin.stop()
+            except Exception:  # noqa: BLE001 — teardown must not cascade
+                log.exception("plugin stop failed")
+        self.plugins.clear()

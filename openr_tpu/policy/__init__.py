@@ -1,0 +1,9 @@
+"""Policy layer (role of openr/policy/ — ref PolicyManager.h:1)."""
+
+from openr_tpu.policy.policy_manager import (  # noqa: F401
+    Policy,
+    PolicyAction,
+    PolicyManager,
+    PolicyMatch,
+    PolicyStatement,
+)

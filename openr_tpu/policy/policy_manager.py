@@ -1,0 +1,151 @@
+"""Origination policy engine.
+
+Role of the reference's openr/policy/PolicyManager.{h,cpp} +
+PolicyStructs.h: the hook PrefixManager calls on every prefix it is
+about to advertise. The reference wraps a closed-source policy library
+behind `applyPolicy(policyName, prefixEntries)`; this is an open,
+declarative engine with the same seam: named policies, ordered
+statements of match (prefix-space / type / tag, AND-combined) ->
+action (deny, or accept with attribute transforms), first match wins,
+configurable default disposition.
+
+Policies live in config (OpenrConfig.policies +
+origination_policy naming the one PrefixManager applies), mirroring the
+reference's config-sourced area/origination policies.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field, replace
+from typing import Optional
+
+from openr_tpu.types import PrefixEntry, parse_prefix
+
+
+@functools.lru_cache(maxsize=65536)
+def _parse_entry_prefix(prefix: str):
+    """None for malformed prefixes (a bad entry from a plugin/CLI source
+    must not crash the PrefixManager event loop)."""
+    try:
+        return parse_prefix(prefix)
+    except ValueError:
+        return None
+
+
+@dataclass(frozen=True)
+class PolicyMatch:
+    """All specified conditions must hold; unspecified = wildcard.
+
+    Cover networks are parsed ONCE at construction (policies are applied
+    per advertised entry — re-parsing per evaluation is O(entries x
+    covers) waste); a malformed cover raises ValueError here, which
+    config validation surfaces as ConfigError at load time."""
+
+    # prefix is matched if it falls within ANY of these networks
+    prefixes: tuple[str, ...] = ()
+    types: tuple[int, ...] = ()  # PrefixType values
+    tags: tuple[str, ...] = ()  # ANY shared tag
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "_covers", tuple(parse_prefix(p) for p in self.prefixes)
+        )
+
+    def matches(self, entry: PrefixEntry) -> bool:
+        if self._covers:
+            net = _parse_entry_prefix(entry.prefix)
+            if net is None or not any(
+                net.version == cover.version and net.subnet_of(cover)
+                for cover in self._covers
+            ):
+                return False
+        if self.types and int(entry.type) not in self.types:
+            return False
+        if self.tags and not (set(self.tags) & set(entry.tags)):
+            return False
+        return True
+
+
+@dataclass(frozen=True)
+class PolicyAction:
+    accept: bool = True
+    set_tags: tuple[str, ...] = ()  # added to the entry's tags
+    set_path_preference: Optional[int] = None
+    set_source_preference: Optional[int] = None
+    set_prepend_label: Optional[int] = None
+
+    def apply(self, entry: PrefixEntry) -> Optional[PrefixEntry]:
+        if not self.accept:
+            return None
+        kw = {}
+        if self.set_tags:
+            kw["tags"] = tuple(sorted(set(entry.tags) | set(self.set_tags)))
+        metrics = entry.metrics
+        if self.set_path_preference is not None:
+            metrics = replace(metrics, path_preference=self.set_path_preference)
+        if self.set_source_preference is not None:
+            metrics = replace(
+                metrics, source_preference=self.set_source_preference
+            )
+        if metrics is not entry.metrics:
+            kw["metrics"] = metrics
+        if self.set_prepend_label is not None:
+            kw["prepend_label"] = self.set_prepend_label
+        return replace(entry, **kw) if kw else entry
+
+
+@dataclass(frozen=True)
+class PolicyStatement:
+    name: str = ""
+    match: PolicyMatch = field(default_factory=PolicyMatch)
+    action: PolicyAction = field(default_factory=PolicyAction)
+
+
+@dataclass(frozen=True)
+class Policy:
+    statements: tuple[PolicyStatement, ...] = ()
+    default_accept: bool = True
+
+
+class PolicyManager:
+    """ref PolicyManager.h — applyPolicy by name."""
+
+    def __init__(self, policies: Optional[dict[str, Policy]] = None):
+        self.policies = dict(policies or {})
+        # (policy, statement-or-"default") -> hit count, for introspection
+        self.hit_counts: dict[tuple[str, str], int] = {}
+
+    def apply(
+        self, policy_name: str, entry: PrefixEntry
+    ) -> Optional[PrefixEntry]:
+        """Transformed entry, or None when denied. An unknown policy name
+        accepts unchanged (a config listing a policy that was removed
+        must not silently black-hole origination; the mismatch is
+        surfaced by config validation)."""
+        policy = self.policies.get(policy_name)
+        if policy is None:
+            return entry
+        for i, stmt in enumerate(policy.statements):
+            if stmt.match.matches(entry):
+                key = (policy_name, stmt.name or f"#{i}")
+                self.hit_counts[key] = self.hit_counts.get(key, 0) + 1
+                return stmt.action.apply(entry)
+        key = (policy_name, "default")
+        self.hit_counts[key] = self.hit_counts.get(key, 0) + 1
+        return entry if policy.default_accept else None
+
+    def apply_all(
+        self, policy_name: str, entries: list[PrefixEntry]
+    ) -> tuple[list[PrefixEntry], list[str]]:
+        """(accepted transformed entries, denied prefixes) — the
+        reference's applyPolicy shape."""
+        accepted: list[PrefixEntry] = []
+        denied: list[str] = []
+        for entry in entries:
+            out = self.apply(policy_name, entry)
+            if out is None:
+                denied.append(entry.prefix)
+            else:
+                accepted.append(out)
+        return accepted, denied

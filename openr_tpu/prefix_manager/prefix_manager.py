@@ -79,6 +79,10 @@ class _OriginatedState:
     conf: OriginatedPrefix
     supporting: set[str] = field(default_factory=set)
     advertised: bool = False
+    # verdict cached: policies are config-static, and _evaluate_originated
+    # re-runs on every FIB delta — without this a denied prefix re-bumps
+    # the deny counters forever
+    policy_denied: bool = False
 
 
 class PrefixManager(Actor):
@@ -95,10 +99,16 @@ class PrefixManager(Actor):
         kvstore_updates_queue: Optional[ReplicateQueue] = None,
         originated_prefixes: Optional[list[OriginatedPrefix]] = None,
         sync_throttle_s: float = 0.005,
+        policy_manager=None,
+        origination_policy: str = "",
     ):
         super().__init__(f"prefix-manager:{node_name}")
         self.node_name = node_name
         self.areas = areas
+        # origination-policy hook (ref PolicyManager wiring,
+        # PrefixManager.cpp policy application on advertisement ingress)
+        self.policy_manager = policy_manager
+        self.origination_policy = origination_policy
         self._prefix_updates = prefix_updates_queue
         self._fib_updates = fib_route_updates_queue
         self._kv_request_q = kv_request_queue
@@ -146,21 +156,50 @@ class PrefixManager(Actor):
         elif ev.event_type == PrefixEventType.SYNC_PREFIXES_BY_TYPE:
             self.sync_prefixes_by_type(ev.prefixes, ev.type)
 
+    def _apply_origination_policy(
+        self, entry: PrefixEntry
+    ) -> Optional[PrefixEntry]:
+        """None = denied by policy (the entry is not advertised)."""
+        if self.policy_manager is None or not self.origination_policy:
+            return entry
+        out = self.policy_manager.apply(self.origination_policy, entry)
+        if out is None:
+            counters.increment("prefix_manager.policy_denied")
+        return out
+
+    def _admit(
+        self, prefixes: list[PrefixEntry], ptype: PrefixType
+    ) -> list[PrefixEntry]:
+        """Type-stamp + origination policy, applied exactly once per
+        entry; denied entries drop out here."""
+        out = []
+        for entry in prefixes:
+            if entry.type != ptype:
+                entry = replace(entry, type=ptype)
+            entry = self._apply_origination_policy(entry)
+            if entry is not None:
+                out.append(entry)
+        return out
+
+    def _store_entries(
+        self, admitted: list[PrefixEntry], dest_areas: tuple[str, ...]
+    ) -> None:
+        for entry in admitted:
+            self.prefix_map.setdefault(entry.prefix, {})[entry.type] = entry
+            if dest_areas:
+                self._dest_areas[(entry.prefix, entry.type)] = tuple(dest_areas)
+            else:
+                self._dest_areas.pop((entry.prefix, entry.type), None)
+
     def advertise_prefixes(
         self,
         prefixes: list[PrefixEntry],
         ptype: PrefixType,
         dest_areas: tuple[str, ...] = (),
     ) -> None:
-        for entry in prefixes:
-            if entry.type != ptype:
-                entry = replace(entry, type=ptype)
-            self.prefix_map.setdefault(entry.prefix, {})[ptype] = entry
-            if dest_areas:
-                self._dest_areas[(entry.prefix, ptype)] = tuple(dest_areas)
-            else:
-                self._dest_areas.pop((entry.prefix, ptype), None)
-        counters.increment("prefix_manager.advertised", len(prefixes))
+        admitted = self._admit(prefixes, ptype)
+        self._store_entries(admitted, dest_areas)
+        counters.increment("prefix_manager.advertised", len(admitted))
         self._sync_throttled()
 
     def withdraw_prefixes(
@@ -188,15 +227,21 @@ class PrefixManager(Actor):
     def sync_prefixes_by_type(
         self, prefixes: list[PrefixEntry], ptype: PrefixType
     ) -> None:
-        """Replace the full set for a type (ref syncPrefixesByType)."""
-        keep = {p.prefix for p in prefixes}
+        """Replace the full set for a type (ref syncPrefixesByType).
+        Policy runs BEFORE the keep-set: an entry the policy now denies
+        must be withdrawn, not left at its stale previously-accepted
+        version."""
+        admitted = self._admit(prefixes, ptype)
+        keep = {p.prefix for p in admitted}
         for prefix in list(self.prefix_map):
             types = self.prefix_map[prefix]
             if ptype in types and prefix not in keep:
                 types.pop(ptype)
                 if not types:
                     del self.prefix_map[prefix]
-        self.advertise_prefixes(prefixes, ptype)
+        self._store_entries(admitted, ())
+        counters.increment("prefix_manager.advertised", len(admitted))
+        self._sync_throttled()
 
     # -- FIB-ACK redistribution + supernode aggregation --------------------
 
@@ -244,9 +289,19 @@ class PrefixManager(Actor):
             conf = ostate.conf
             should = len(ostate.supporting) >= conf.minimum_supporting_routes
             if should and not ostate.advertised:
+                if ostate.policy_denied:
+                    continue
+                entry = self._apply_origination_policy(
+                    PrefixEntry(
+                        prefix=conf.prefix,
+                        type=PrefixType.CONFIG,
+                        tags=conf.tags,
+                    )
+                )
+                if entry is None:
+                    ostate.policy_denied = True
+                    continue  # policy-denied: stays unadvertised
                 ostate.advertised = True
-                entry = PrefixEntry(prefix=conf.prefix, type=PrefixType.CONFIG,
-                                    tags=conf.tags)
                 self.prefix_map.setdefault(conf.prefix, {})[
                     PrefixType.CONFIG
                 ] = entry

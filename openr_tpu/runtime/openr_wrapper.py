@@ -68,6 +68,10 @@ class OpenrWrapper:
         persistent_store=None,
         kvstore_port_of=None,
         node_label: int = 0,
+        policy_manager=None,
+        origination_policy: str = "",
+        plugins: Optional[list[str]] = None,
+        running_config=None,
     ):
         self.node_name = node_name
         self.kv_ports = kv_ports  # shared node -> kvstore port registry
@@ -133,6 +137,22 @@ class OpenrWrapper:
         self.ctrl: "CtrlServer | None" = None
         self._enable_ctrl = enable_ctrl
         self._ctrl_port = ctrl_port
+        self._running_config = running_config
+        self.plugin_host = None
+        if plugins:
+            from openr_tpu.plugins import PluginArgs, PluginHost
+
+            self.plugin_host = PluginHost(
+                PluginArgs(
+                    node_name=node_name,
+                    config=running_config,
+                    prefix_updates_queue=self.prefix_updates_queue,
+                    static_routes_queue=self.static_routes_queue,
+                    kv_request_queue=self.kv_request_queue,
+                    route_updates_reader=self.route_updates_queue.get_reader,
+                ),
+                plugins,
+            )
         self.prefix_manager = PrefixManager(
             node_name,
             areas,
@@ -143,6 +163,8 @@ class OpenrWrapper:
             kvstore_updates_queue=self.kvstore_updates_queue,
             originated_prefixes=originated_prefixes or [],
             sync_throttle_s=0.002,
+            policy_manager=policy_manager,
+            origination_policy=origination_policy,
         )
         self.fib_service = fib_service or MockFibService()
         self.fib = Fib(
@@ -167,6 +189,10 @@ class OpenrWrapper:
             self.spark.add_interface(iface)
         await self.prefix_manager.start()
         await self.link_monitor.start()
+        # plugins attach after link-monitor, before decision/fib start
+        # consuming their injections (ref Main.cpp:485-509)
+        if self.plugin_host is not None:
+            await self.plugin_host.start()
         await self.decision.start()
         await self.fib.start()
         await self.spark.start()
@@ -184,6 +210,7 @@ class OpenrWrapper:
                 kvstore_updates_queue=self.kvstore_updates_queue,
                 fib_updates_queue=self.fib_updates_queue,
                 listen_port=self._ctrl_port,
+                config=self._running_config,
             )
             await self.ctrl.start()
 
@@ -191,6 +218,8 @@ class OpenrWrapper:
         """Reverse teardown (ref Main.cpp:592-599)."""
         if self.ctrl is not None:
             await self.ctrl.stop()
+        if self.plugin_host is not None:
+            await self.plugin_host.stop()
         for q in (
             self.kvstore_updates_queue,
             self.kvstore_events_queue,

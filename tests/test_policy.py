@@ -1,0 +1,190 @@
+"""Origination-policy tests (ref openr/policy/PolicyManager.h role):
+the declarative engine, and the PrefixManager advertisement hook."""
+
+import asyncio
+
+from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.policy import (
+    Policy,
+    PolicyAction,
+    PolicyManager,
+    PolicyMatch,
+    PolicyStatement,
+)
+from openr_tpu.prefix_manager.prefix_manager import (
+    OriginatedPrefix,
+    PrefixManager,
+)
+from openr_tpu.types import (
+    KeyValueRequest,
+    PrefixEntry,
+    PrefixEvent,
+    PrefixEventType,
+    PrefixType,
+)
+from tests.conftest import run_async
+
+
+def entry(prefix, ptype=PrefixType.BREEZE, tags=()):
+    return PrefixEntry(prefix=prefix, type=ptype, tags=tuple(tags))
+
+
+DENY_PRIVATE = Policy(
+    statements=(
+        PolicyStatement(
+            name="deny-private-v4",
+            match=PolicyMatch(prefixes=("10.0.0.0/8",)),
+            action=PolicyAction(accept=False),
+        ),
+        PolicyStatement(
+            name="tag-loopbacks",
+            match=PolicyMatch(types=(int(PrefixType.LOOPBACK),)),
+            action=PolicyAction(
+                set_tags=("loopback",), set_path_preference=900
+            ),
+        ),
+    ),
+)
+
+
+class TestPolicyEngine:
+    def test_first_match_wins_and_denies(self):
+        pm = PolicyManager({"orig": DENY_PRIVATE})
+        assert pm.apply("orig", entry("10.1.2.0/24")) is None
+        out = pm.apply("orig", entry("192.168.1.0/24"))
+        assert out is not None and out.tags == ()  # default accept
+
+    def test_transform_action(self):
+        pm = PolicyManager({"orig": DENY_PRIVATE})
+        out = pm.apply(
+            "orig", entry("192.0.2.1/32", ptype=PrefixType.LOOPBACK)
+        )
+        assert out.tags == ("loopback",)
+        assert out.metrics.path_preference == 900
+
+    def test_default_deny(self):
+        pol = Policy(
+            statements=(
+                PolicyStatement(
+                    match=PolicyMatch(tags=("allowed",)),
+                    action=PolicyAction(accept=True),
+                ),
+            ),
+            default_accept=False,
+        )
+        pm = PolicyManager({"p": pol})
+        assert pm.apply("p", entry("1.2.3.0/24")) is None
+        assert pm.apply("p", entry("1.2.3.0/24", tags=("allowed",))) is not None
+
+    def test_unknown_policy_accepts(self):
+        pm = PolicyManager({})
+        e = entry("1.2.3.0/24")
+        assert pm.apply("ghost", e) is e
+
+    def test_v6_prefix_space_match(self):
+        pol = Policy(
+            statements=(
+                PolicyStatement(
+                    match=PolicyMatch(prefixes=("fd00::/8",)),
+                    action=PolicyAction(accept=False),
+                ),
+            ),
+        )
+        pm = PolicyManager({"p": pol})
+        assert pm.apply("p", entry("fd00:1::/64")) is None
+        assert pm.apply("p", entry("2001:db8::/64")) is not None
+
+    def test_apply_all_shape(self):
+        pm = PolicyManager({"orig": DENY_PRIVATE})
+        accepted, denied = pm.apply_all(
+            "orig", [entry("10.0.0.0/24"), entry("192.0.2.0/24")]
+        )
+        assert denied == ["10.0.0.0/24"]
+        assert [e.prefix for e in accepted] == ["192.0.2.0/24"]
+
+
+class TestPrefixManagerPolicyHook:
+    @run_async
+    async def test_denied_prefix_not_advertised(self):
+        prefix_q = ReplicateQueue("prefixUpdates")
+        kv_q = ReplicateQueue("kvRequests")
+        kv_reader = kv_q.get_reader("test")
+        pm = PrefixManager(
+            "node-a",
+            ["0"],
+            prefix_q.get_reader(),
+            None,
+            kv_q,
+            policy_manager=PolicyManager({"orig": DENY_PRIVATE}),
+            origination_policy="orig",
+            originated_prefixes=[
+                OriginatedPrefix(prefix="10.50.0.0/16")  # policy-denied
+            ],
+        )
+        await pm.start()
+        try:
+            prefix_q.push(
+                PrefixEvent(
+                    event_type=PrefixEventType.ADD_PREFIXES,
+                    type=PrefixType.BREEZE,
+                    prefixes=[
+                        entry("10.9.0.0/24"),  # denied
+                        entry("198.51.100.0/24"),  # accepted
+                    ],
+                )
+            )
+
+            async def next_persist():
+                while True:
+                    item = await kv_reader.get()
+                    if isinstance(item, KeyValueRequest):
+                        return item
+
+            req = await asyncio.wait_for(next_persist(), 5)
+            assert "198.51.100.0/24" in req.key
+            assert "10.9.0.0/24" not in (await pm.get_prefixes())
+            assert "10.50.0.0/16" not in (await pm.get_prefixes())
+            advertised = await pm.get_prefixes()
+            assert set(advertised) == {"198.51.100.0/24"}
+        finally:
+            prefix_q.close()
+            kv_q.close()
+            await pm.stop()
+
+    @run_async
+    async def test_policy_transform_applied_to_advertisement(self):
+        prefix_q = ReplicateQueue("prefixUpdates")
+        kv_q = ReplicateQueue("kvRequests")
+        pm = PrefixManager(
+            "node-a",
+            ["0"],
+            prefix_q.get_reader(),
+            None,
+            kv_q,
+            policy_manager=PolicyManager({"orig": DENY_PRIVATE}),
+            origination_policy="orig",
+        )
+        await pm.start()
+        try:
+            prefix_q.push(
+                PrefixEvent(
+                    event_type=PrefixEventType.ADD_PREFIXES,
+                    type=PrefixType.LOOPBACK,
+                    prefixes=[entry("192.0.2.1/32", PrefixType.LOOPBACK)],
+                )
+            )
+
+            async def advertised():
+                while True:
+                    got = await pm.get_prefixes()
+                    if "192.0.2.1/32" in got:
+                        return got["192.0.2.1/32"]
+                    await asyncio.sleep(0.01)
+
+            e = await asyncio.wait_for(advertised(), 5)
+            assert e.tags == ("loopback",)
+            assert e.metrics.path_preference == 900
+        finally:
+            prefix_q.close()
+            kv_q.close()
+            await pm.stop()
