@@ -216,6 +216,8 @@ def main() -> None:
     run("tg1k", lambda: topologies.grid(32, node_labels=False), "node-16-16")
 
     if quick:
+        if not configs:
+            sys.exit(f"--only={only} matched no config")
         name = "tg1k" if "tg1k" in configs else next(iter(configs))
         out = configs[name]
         print(json.dumps({

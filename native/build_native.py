@@ -1,0 +1,29 @@
+"""Build the native extension in place at the REPO ROOT (so plain
+`import openr_tpu_native` works for the daemon and tests):
+
+    python native/build_native.py
+
+(role of the reference's cmake openrlib target for openr/nl). The
+platform layer auto-detects the built module and uses it for large
+syncs; everything works without it (pure-Python fallback)."""
+
+import os
+import sys
+
+from setuptools import Extension, setup
+
+root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+os.chdir(root)
+sys.argv[1:] = []
+
+setup(
+    name="openr-tpu-native",
+    ext_modules=[
+        Extension(
+            "openr_tpu_native",
+            sources=["native/netlink_bulk.cpp"],
+            extra_compile_args=["-O2", "-std=c++17"],
+        )
+    ],
+    script_args=["build_ext", "--inplace"],
+)

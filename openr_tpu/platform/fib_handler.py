@@ -127,21 +127,64 @@ class NetlinkDataplane:
             table=self.table,
         )
 
+    # batches at least this large go through the C++ bulk programmer
+    # when built (native/netlink_bulk.cpp); smaller ones stay on the
+    # asyncio client, which interleaves with other platform work
+    BULK_THRESHOLD = 64
+
+    async def _bulk(self, op: int, nl_routes) -> Optional[tuple[int, int]]:
+        from openr_tpu.platform import netlink as nlmod
+
+        if (
+            len(nl_routes) < self.BULK_THRESHOLD
+            or not nlmod.native_bulk_available()
+        ):
+            return None
+        from openr_tpu.platform.netlink import PROTO_OPENR
+
+        try:
+            packed = nlmod.pack_bulk_routes(nl_routes)
+        except ValueError:
+            # family-mismatched gateway the bulk format can't encode:
+            # the per-route path reports those properly
+            return None
+        import openr_tpu_native
+
+        # the C++ pipeline releases the GIL but would still block THIS
+        # event loop (which serves every platform RPC) for the whole
+        # program — run it on a worker thread
+        return await asyncio.get_running_loop().run_in_executor(
+            None,
+            openr_tpu_native.bulk_route_op,
+            op, self.table, PROTO_OPENR, packed,
+        )
+
     async def add_unicast(self, routes: dict[str, dict]) -> list[str]:
         self._ensure_open()
+        nl_routes = [self._to_nl(p, r) for p, r in routes.items()]
+        bulk = await self._bulk(0, nl_routes)
+        if bulk is not None:
+            ok, err = bulk
+            if err == 0:
+                return []
+            # rare: re-walk per-route on the asyncio client to learn
+            # WHICH prefixes failed (the native path returns counts)
         failed = []
-        for prefix, route in routes.items():
+        for r in nl_routes:
             try:
-                await self.nl.add_route(self._to_nl(prefix, route))
+                await self.nl.add_route(r)
             except OSError:
-                failed.append(prefix)
+                failed.append(r.prefix)
         return failed
 
     async def delete_unicast(self, prefixes: list[str]) -> None:
         self._ensure_open()
-        for prefix in prefixes:
+        nl_routes = [self._to_nl(p, {}) for p in prefixes]
+        if await self._bulk(1, nl_routes) is not None:
+            return
+        for r in nl_routes:
             try:
-                await self.nl.delete_route(self._to_nl(prefix, {}))
+                await self.nl.delete_route(r)
             except OSError:
                 pass  # already gone
 

@@ -249,6 +249,63 @@ class NetlinkRouteSocket:
         ]
 
 
+def native_bulk_available() -> bool:
+    """True when the C++ bulk programmer (native/netlink_bulk.cpp, built
+    via native/build_native.py) is importable."""
+    try:
+        import openr_tpu_native  # noqa: F401
+    except ImportError:
+        return False
+    return True
+
+
+def pack_bulk_routes(routes: list[NlRoute]) -> bytes:
+    """Pack NlRoutes into the native module's record format (see
+    native/netlink_bulk.cpp header comment).
+
+    Raises ValueError when a gateway's family differs from the route's:
+    the native encoder sizes RTA_GATEWAY from the ROUTE family, and a
+    truncated v6 gateway on a v4 route would be ACCEPTED by the kernel
+    as a garbage v4 gateway (silent black hole) — the caller falls back
+    to the per-route path, which reports such routes as failed."""
+    out = bytearray()
+    for r in routes:
+        net = ipaddress.ip_network(r.prefix, strict=False)
+        family = socket.AF_INET if net.version == 4 else socket.AF_INET6
+        nhs = r.nexthops or (NlNextHop(),)
+        out += struct.pack(
+            "<BBBBI", family, net.prefixlen, len(nhs), 0, r.metric
+        )
+        out += net.network_address.packed.ljust(16, b"\0")
+        for nh in nhs:
+            gw = b""
+            if nh.gateway:
+                addr = ipaddress.ip_address(nh.gateway)
+                if addr.version != net.version:
+                    raise ValueError(
+                        f"{r.prefix}: gateway {nh.gateway} family differs "
+                        "from route family (bulk path cannot encode it)"
+                    )
+                gw = addr.packed
+            out += struct.pack("<II", nh.ifindex, nh.weight)
+            out += gw.ljust(16, b"\0")
+    return bytes(out)
+
+
+def bulk_route_op(
+    op: int, table: int, protocol: int, routes: list[NlRoute]
+) -> tuple[int, int]:
+    """(ok, err) — whole pipeline (encode, pipelined send, ack harvest)
+    in C++ (role of openr/nl's native fast path; measured ~150k routes/s
+    vs the reference's stated 100k < 2s, NetlinkProtocolSocket.h:69-70).
+    op: 0 = add/replace, 1 = delete."""
+    import openr_tpu_native
+
+    return openr_tpu_native.bulk_route_op(
+        op, table, protocol, pack_bulk_routes(routes)
+    )
+
+
 def _build_route_msg(route: NlRoute, for_delete: bool = False) -> bytes:
     net = ipaddress.ip_network(route.prefix, strict=False)
     family = socket.AF_INET if net.version == 4 else socket.AF_INET6

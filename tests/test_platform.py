@@ -388,3 +388,79 @@ def test_daemon_with_out_of_process_platform(tmp_path):
         for p in procs:
             if p.poll() is None:
                 p.kill()
+
+
+class TestNativeBulk:
+    def test_pack_format_lengths(self):
+        from openr_tpu.platform import netlink as nl
+
+        buf = nl.pack_bulk_routes(
+            [
+                nl.NlRoute(
+                    prefix="10.0.0.0/24",
+                    nexthops=(
+                        nl.NlNextHop(gateway="10.0.0.1", ifindex=2),
+                        nl.NlNextHop(ifindex=3, weight=2),
+                    ),
+                    metric=5,
+                )
+            ]
+        )
+        # header (8 + 16) + 2 nexthops x (8 + 16)
+        assert len(buf) == 24 + 2 * 24
+
+    @pytest.mark.skipif(not _can_net_admin(), reason="needs CAP_NET_ADMIN")
+    @run_async
+    async def test_bulk_programs_and_deletes_in_kernel(self):
+        from openr_tpu.platform import netlink as nl
+
+        if not nl.native_bulk_available():
+            pytest.skip("native module not built (python native/build_native.py)")
+        lo = socket.if_nametoindex("lo")
+        routes = [
+            nl.NlRoute(
+                prefix=f"10.253.{i >> 8}.{i & 0xFF}/32",
+                nexthops=(nl.NlNextHop(ifindex=lo),),
+                metric=3,
+                table=10095,
+            )
+            for i in range(2000)
+        ]
+        ok, err = nl.bulk_route_op(0, 10095, nl.PROTO_OPENR, routes)
+        assert (ok, err) == (2000, 0)
+        sock = nl.NetlinkRouteSocket()
+        sock.open()
+        try:
+            got = await sock.get_routes(
+                socket.AF_INET, table=10095, protocol=nl.PROTO_OPENR
+            )
+            assert len(got) == 2000
+        finally:
+            sock.close()
+        ok, err = nl.bulk_route_op(1, 10095, nl.PROTO_OPENR, routes)
+        assert (ok, err) == (2000, 0)
+
+    @pytest.mark.skipif(not _can_net_admin(), reason="needs CAP_NET_ADMIN")
+    @run_async
+    async def test_dataplane_uses_bulk_for_large_sync(self):
+        from openr_tpu.platform import netlink as nl
+        from openr_tpu.platform.fib_handler import NetlinkDataplane
+
+        if not nl.native_bulk_available():
+            pytest.skip("native module not built")
+        dp = NetlinkDataplane(table=10094)
+        nh = [{"address": "", "if_name": "lo", "weight": 0}]
+        routes = {
+            f"10.252.{i >> 8}.{i & 0xFF}/32": {"nexthops": nh, "igp_cost": 2}
+            for i in range(500)
+        }
+        try:
+            failed = await dp.sync_unicast(routes)
+            assert not failed
+            got = await dp.nl.get_routes(
+                socket.AF_INET, table=10094, protocol=nl.PROTO_OPENR
+            )
+            assert len(got) == 500
+        finally:
+            await dp.delete_unicast(sorted(routes))
+            dp.nl.close()
