@@ -120,10 +120,19 @@ PyObject* bulk_route_op(PyObject*, PyObject* args) {
     PyBuffer_Release(&view);
     return PyErr_SetFromErrno(PyExc_OSError);
   }
-  // big socket buffers: we pipeline hard
+  // big socket buffers: we pipeline hard. RCVBUFFORCE bypasses the
+  // rmem_max clamp when CAP_NET_ADMIN (which route programming needs
+  // anyway); plain RCVBUF is the fallback.
   int sz = 1 << 21;
   setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &sz, sizeof(sz));
-  setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &sz, sizeof(sz));
+  if (setsockopt(fd, SOL_SOCKET, SO_RCVBUFFORCE, &sz, sizeof(sz)) < 0) {
+    setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &sz, sizeof(sz));
+  }
+  // error acks must not echo the whole original request: 256 in-flight
+  // NACKs of multipath messages could overflow the ack queue and abort
+  // the run mid-stream
+  int one = 1;
+  setsockopt(fd, SOL_NETLINK, NETLINK_CAP_ACK, &one, sizeof(one));
   sockaddr_nl addr{};
   addr.nl_family = AF_NETLINK;
   if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {

@@ -34,12 +34,23 @@ Graph updates ride LinkState's changelog as device scatter writes
 (ops/edgeplan.py apply_events / drain_dirty) — a metric flap is a
 handful of int32 stores, not a mirror rebuild.
 
-Scope: single-area LSDBs with IP/SP_ECMP prefixes run on device; KSP2 /
-UCMP / SR_MPLS / prepend-label prefixes and multi-area LSDBs fall back
-to the CPU oracle (decision/spf_solver.py) per prefix — behavior is
-identical by construction and enforced by differential tests
-(tests/test_tpu_solver.py). MPLS label routes are host-built (they are
-O(adjacent links), not hot).
+Scope: single-area LSDBs with IP/SP_ECMP prefixes (with optional LFA
+backup next-hops) run the fused device pipeline; KSP2 (SR_MPLS +
+KSP2_ED_ECMP) prefixes are device-ASSISTED — the per-destination
+masked second-pass SSSPs batch on device (ops/ksp2.py) while the
+oracle's selection/trace/label assembly stays host-side, primed through
+the k-paths cache. What remains host-only, deliberately:
+  - UCMP weight resolution (resolve_ucmp_weights): the per-node
+    gcd-normalized leaf-to-root propagation is order-dependent and
+    sequential along the DAG — a hardware-hostile shape the reference
+    also computes per-prefix on CPU (LinkState.cpp:913-1033); prefixes
+    using it fall back to the oracle per prefix.
+  - multi-area LSDBs: best-route selection is global across areas while
+    distance fields are per-area; the whole build delegates to the
+    oracle (build_route_db's first branch).
+Behavior is identical by construction and enforced by differential
+tests (tests/test_tpu_solver.py, test_lfa.py, test_ksp2.py). MPLS label
+routes are host-built (they are O(adjacent links), not hot).
 """
 
 from __future__ import annotations
@@ -73,6 +84,25 @@ from openr_tpu.types import (
 INF = int(INF32)
 INF_E = int(INF32E)
 _NEG = -(2**31)
+_entry_new = object.__new__
+
+
+def _entry_defaults() -> dict:
+    """Default field values of RibUnicastEntry, derived from the
+    dataclass itself so the fast constructor below cannot silently
+    desynchronize when a defaulted field is added to the schema."""
+    import dataclasses
+
+    out = {}
+    for f in dataclasses.fields(RibUnicastEntry):
+        if f.default is not dataclasses.MISSING:
+            out[f.name] = f.default
+        elif f.default_factory is not dataclasses.MISSING:  # type: ignore[misc]
+            out[f.name] = f.default_factory()  # type: ignore[misc]
+    return out
+
+
+_ENTRY_DEFAULTS = _entry_defaults()
 
 # rows shipped per delta pull; bursts changing more fall back to a full
 # pull (one extra round trip, still a single buffer)
@@ -251,10 +281,26 @@ def _pack_words(bits):
 
 
 def unpack_words(words: np.ndarray, x: int) -> np.ndarray:
-    """host inverse of _pack_words: int32 [R, W] -> bool [R, x]."""
+    """host inverse of _pack_words: int32 [R, W] -> bool [R, x].
+
+    Bit extraction runs through np.unpackbits over the low two bytes of
+    each little-endian word (C speed) — the shift-and-mask formulation
+    materialized a [R, W, 16] int32 temporary and cost ~0.3s per 100k-row
+    full pull."""
     r, wn = words.shape
-    bits = (words[:, :, None] >> np.arange(16)) & 1
-    return bits.reshape(r, wn * 16)[:, :x].astype(bool)
+    if r == 0 or wn == 0:
+        return np.zeros((r, x), bool)
+    low2 = (
+        np.ascontiguousarray(words.astype("<i4"))
+        .view(np.uint8)
+        .reshape(r, wn, 4)[:, :, :2]
+    )
+    bits = np.unpackbits(
+        np.ascontiguousarray(low2).reshape(r, wn * 2),
+        axis=1,
+        bitorder="little",
+    )
+    return bits[:, :x].astype(bool)
 
 
 def _plan_sssp(deltas, shift_w, res_rows, res_nbr, res_w, root,
@@ -1293,11 +1339,17 @@ class TpuSpfSolver:
                 )
                 ba = next(a for a, na in sel if na == best)
             prefix = prefix_list[p]
-            routes[prefix] = RibUnicastEntry(
-                prefix=prefix,
-                nexthops=nexthops,
-                best_prefix_entry=entry_refs[p][ba],
-                best_node_area=best,
-                igp_cost=m,
-                lfa_nexthops=lfa_nexthops,
-            )
+            # bypass the dataclass __init__ (per-field object.__setattr__
+            # x9) — this loop constructs one entry per route on a cold
+            # 100k rebuild; equality/hash read the same attributes either
+            # way, and unset fields come from the schema-derived defaults
+            entry = _entry_new(RibUnicastEntry)
+            d = dict(_ENTRY_DEFAULTS)
+            d["prefix"] = prefix
+            d["nexthops"] = nexthops
+            d["best_prefix_entry"] = entry_refs[p][ba]
+            d["best_node_area"] = best
+            d["igp_cost"] = m
+            d["lfa_nexthops"] = lfa_nexthops
+            entry.__dict__.update(d)
+            routes[prefix] = entry

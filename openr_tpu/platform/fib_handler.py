@@ -142,11 +142,14 @@ class NetlinkDataplane:
             return None
         from openr_tpu.platform.netlink import PROTO_OPENR
 
+        import struct as _struct
+
         try:
             packed = nlmod.pack_bulk_routes(nl_routes)
-        except ValueError:
-            # family-mismatched gateway the bulk format can't encode:
-            # the per-route path reports those properly
+        except (ValueError, _struct.error):
+            # family-mismatched gateway, >255 nexthops, out-of-range
+            # metric — anything the packed format can't encode goes
+            # through the per-route path, which reports failures properly
             return None
         import openr_tpu_native
 
@@ -165,10 +168,14 @@ class NetlinkDataplane:
         bulk = await self._bulk(0, nl_routes)
         if bulk is not None:
             ok, err = bulk
-            if err == 0:
+            # success requires EVERY route acked ok — a mid-stream
+            # transport abort shows up as ok < len with err == 0, and
+            # must not be mistaken for full success
+            if err == 0 and ok == len(nl_routes):
                 return []
             # rare: re-walk per-route on the asyncio client to learn
-            # WHICH prefixes failed (the native path returns counts)
+            # WHICH prefixes failed (the native path returns counts);
+            # adds use NLM_F_REPLACE so re-adding acked routes is safe
         failed = []
         for r in nl_routes:
             try:

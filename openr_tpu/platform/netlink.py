@@ -273,6 +273,11 @@ def pack_bulk_routes(routes: list[NlRoute]) -> bytes:
         net = ipaddress.ip_network(r.prefix, strict=False)
         family = socket.AF_INET if net.version == 4 else socket.AF_INET6
         nhs = r.nexthops or (NlNextHop(),)
+        if len(nhs) > 255:
+            raise ValueError(
+                f"{r.prefix}: {len(nhs)} nexthops exceed the bulk "
+                "format's u8 count"
+            )
         out += struct.pack(
             "<BBBBI", family, net.prefixlen, len(nhs), 0, r.metric
         )
