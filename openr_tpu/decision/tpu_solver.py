@@ -45,9 +45,13 @@ the k-paths cache. What remains host-only, deliberately:
     sequential along the DAG — a hardware-hostile shape the reference
     also computes per-prefix on CPU (LinkState.cpp:913-1033); prefixes
     using it fall back to the oracle per prefix.
-  - multi-area LSDBs: best-route selection is global across areas while
-    distance fields are per-area; the whole build delegates to the
-    oracle (build_route_db's first branch).
+  - cross-area-announced prefixes: selection and the min-metric
+    next-hop union are global across areas; these go to the oracle.
+    Multi-area LSDBs otherwise run on device — a prefix announced in
+    exactly ONE area (the overwhelmingly common case: loopbacks) is
+    dispatched to that area's per-area pipeline, whose answer equals
+    the global one because other areas' reachability filters remove
+    nothing from its announcer set.
 Behavior is identical by construction and enforced by differential
 tests (tests/test_tpu_solver.py, test_lfa.py, test_ksp2.py). MPLS label
 routes are host-built (they are O(adjacent links), not hot).
@@ -87,22 +91,38 @@ _NEG = -(2**31)
 _entry_new = object.__new__
 
 
-def _entry_defaults() -> dict:
-    """Default field values of RibUnicastEntry, derived from the
-    dataclass itself so the fast constructor below cannot silently
-    desynchronize when a defaulted field is added to the schema."""
+# fields the fast-construction loop in _build_entries always sets itself
+_ENTRY_SET_FIELDS = frozenset(
+    {
+        "prefix", "nexthops", "best_prefix_entry", "best_node_area",
+        "igp_cost", "lfa_nexthops",
+    }
+)
+
+
+def _entry_defaults() -> tuple[dict, list]:
+    """(plain defaults, per-entry default factories) of RibUnicastEntry,
+    derived from the dataclass itself so the fast constructor below
+    cannot silently desynchronize when a defaulted field is added to the
+    schema. Factory-defaulted fields the loop does not overwrite are
+    CALLED PER ENTRY — sharing one factory product across all entries
+    would alias a future mutable default."""
     import dataclasses
 
-    out = {}
+    plain = {}
+    factories = []
     for f in dataclasses.fields(RibUnicastEntry):
         if f.default is not dataclasses.MISSING:
-            out[f.name] = f.default
+            plain[f.name] = f.default
         elif f.default_factory is not dataclasses.MISSING:  # type: ignore[misc]
-            out[f.name] = f.default_factory()  # type: ignore[misc]
-    return out
+            if f.name in _ENTRY_SET_FIELDS:
+                plain[f.name] = None  # placeholder; always overwritten
+            else:
+                factories.append((f.name, f.default_factory))  # type: ignore[misc]
+    return plain, factories
 
 
-_ENTRY_DEFAULTS = _entry_defaults()
+_ENTRY_DEFAULTS, _ENTRY_FACTORIES = _entry_defaults()
 
 # rows shipped per delta pull; bursts changing more fall back to a full
 # pull (one extra round trip, still a single buffer)
@@ -660,60 +680,112 @@ class TpuSpfSolver:
         area_link_states: dict[str, LinkState],
         prefix_state: PrefixState,
     ) -> Optional[DecisionRouteDb]:
-        # multi-area: selection must be global across areas — CPU path
-        if len(area_link_states) != 1:
-            return self.cpu.build_route_db(
-                my_node_name, area_link_states, prefix_state
-            )
-        area, link_state = next(iter(area_link_states.items()))
-        if not link_state.has_node(my_node_name):
+        if not any(
+            ls.has_node(my_node_name) for ls in area_link_states.values()
+        ):
             return None
-        if link_state.node_count() < self.small_graph_nodes:
+        if all(
+            ls.node_count() < self.small_graph_nodes
+            for ls in area_link_states.values()
+        ):
             return self.cpu.build_route_db(
                 my_node_name, area_link_states, prefix_state
             )
 
-        fast, slow, ksp2 = self._partition_prefixes(prefix_state)
+        fast_by_area, slow, ksp2, ksp2_by_area = self._partition_prefixes(
+            prefix_state, area_link_states
+        )
 
         route_db = DecisionRouteDb()
-        finish_fast = None
-        if fast:
-            # dispatch the device pipeline and START the async result
-            # copy; the host-side slow path below runs while the result
-            # buffer is in flight (on tunneled rigs the copy RTT is the
-            # dominant per-solve cost — overlap hides it behind real work)
-            finish_fast = self._solve_fast(
-                my_node_name, area, link_state, prefix_state, fast
+        finishes = []
+        # per-area device dispatch: a prefix announced in exactly one
+        # area selects over that area's announcers only (the other
+        # areas' reachability filters remove nothing), so the per-area
+        # pipeline computes the oracle's answer verbatim. Prefixes
+        # spanning areas — where selection and the min-metric next-hop
+        # union are genuinely global — go through the oracle below.
+        # All dispatches START before any result is consumed: the device
+        # round trips overlap each other AND the host slow path.
+        small: list[str] = []
+        for area, plist in fast_by_area.items():
+            link_state = area_link_states[area]
+            if not link_state.has_node(my_node_name):
+                continue  # unreachable area for this vantage: no routes
+            if link_state.node_count() < self.small_graph_nodes:
+                # a tiny area (e.g. a hub-only backbone) solves faster on
+                # the oracle than one device round trip
+                small.extend(plist)
+                continue
+            finishes.append(
+                self._solve_fast(
+                    my_node_name, area, link_state, prefix_state, plist
+                )
             )
-        if ksp2:
-            # batch the per-destination second-pass SSSPs on device and
-            # prime the k-paths cache; the oracle loop below then
-            # assembles KSP2 routes through its unchanged code path
+        # batch the per-destination second-pass SSSPs on device and prime
+        # the k-paths cache; the oracle loop below then assembles KSP2
+        # routes through its unchanged code path. Like the fast path,
+        # a KSP2 prefix announced in a single area primes that area.
+        for area, plist in ksp2_by_area.items():
+            link_state = area_link_states[area]
+            if not link_state.has_node(my_node_name):
+                continue
+            if link_state.node_count() < self.small_graph_nodes:
+                continue  # host Dijkstras beat a device batch here
             self._prime_ksp2(
-                my_node_name, area, link_state, prefix_state, ksp2, fast
+                my_node_name, area, link_state, prefix_state, plist,
+                fast_by_area.get(area, []),
             )
 
         self._host_routes(
             my_node_name, area_link_states, prefix_state,
-            slow + ksp2, route_db,
+            slow + ksp2 + small, route_db,
         )
-        if finish_fast is not None:
-            finish_fast(route_db)
+        for finish in finishes:
+            finish(route_db)
         return route_db
 
-    def _partition_prefixes(self, prefix_state: PrefixState):
-        if self._partition is not None and self._partition[0] == prefix_state.generation:
-            return self._partition[1], self._partition[2], self._partition[3]
-        fast, slow, ksp2 = [], [], []
+    def _partition_prefixes(
+        self,
+        prefix_state: PrefixState,
+        area_link_states: dict[str, LinkState],
+    ):
+        """-> (fast prefixes grouped by their single announcer area,
+        slow prefixes for the oracle — ineligible attributes OR announcers
+        spanning areas, all ksp2 prefixes, ksp2 prefixes grouped by
+        single announcer area for device priming). Cached per
+        (prefix generation, area set)."""
+        areas_key = tuple(sorted(area_link_states))
+        if (
+            self._partition is not None
+            and self._partition[0] == (prefix_state.generation, areas_key)
+        ):
+            return self._partition[1:]
+        fast_by_area: dict[str, list] = {}
+        ksp2_by_area: dict[str, list] = {}
+        slow, ksp2 = [], []
         for prefix, entries in prefix_state.prefixes().items():
+            areas = {a for _, a in entries}
+            single = (
+                next(iter(areas))
+                if len(areas) == 1 and next(iter(areas)) in area_link_states
+                else None
+            )
             if _fast_path_eligible(entries):
-                fast.append(prefix)
+                if single is not None:
+                    fast_by_area.setdefault(single, []).append(prefix)
+                else:
+                    slow.append(prefix)
             elif _ksp2_eligible(entries):
                 ksp2.append(prefix)
+                if single is not None:
+                    ksp2_by_area.setdefault(single, []).append(prefix)
             else:
                 slow.append(prefix)
-        self._partition = (prefix_state.generation, fast, slow, ksp2)
-        return fast, slow, ksp2
+        self._partition = (
+            (prefix_state.generation, areas_key),
+            fast_by_area, slow, ksp2, ksp2_by_area,
+        )
+        return fast_by_area, slow, ksp2, ksp2_by_area
 
     def _host_routes(
         self, my_node_name, area_link_states, prefix_state, slow, route_db
@@ -778,7 +850,10 @@ class TpuSpfSolver:
             }
         area, link_state = next(iter(area_link_states.items()))
 
-        fast, slow, ksp2 = self._partition_prefixes(prefix_state)
+        fast_by_area, slow, ksp2, _ksp2_by_area = self._partition_prefixes(
+            prefix_state, area_link_states
+        )
+        fast = fast_by_area.get(area, [])
 
         result: dict[str, Optional[DecisionRouteDb]] = {}
         known = [r for r in root_names if link_state.has_node(r)]
@@ -1345,6 +1420,8 @@ class TpuSpfSolver:
             # way, and unset fields come from the schema-derived defaults
             entry = _entry_new(RibUnicastEntry)
             d = dict(_ENTRY_DEFAULTS)
+            for fname, factory in _ENTRY_FACTORIES:
+                d[fname] = factory()
             d["prefix"] = prefix
             d["nexthops"] = nexthops
             d["best_prefix_entry"] = entry_refs[p][ba]

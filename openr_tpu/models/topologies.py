@@ -330,3 +330,86 @@ def random_mesh(
         for i, neighbors in adjacency.items()
     }
     return _mk_dbs(nodes, area, forwarding_algorithm, node_labels)
+
+
+def multi_area(
+    regions: int = 3,
+    side: int = 4,
+    backbone_area: str = "bb",
+    forwarding_algorithm: PrefixForwardingAlgorithm = PrefixForwardingAlgorithm.SP_ECMP,
+) -> tuple[list[AdjacencyDatabase], list[PrefixDatabase]]:
+    """Multi-area topology (ref openr/docs/Features/Area.md; per-area
+    KvStoreDb/LinkState): each region is its own flooding domain (area
+    "r<i>") of a side x side grid; the region hubs additionally belong
+    to a backbone area ring. Hub nodes therefore carry TWO adjacency
+    databases (one per area) — the shape Decision's per-area LinkState
+    map models. Loopbacks announce in the node's region area; hubs also
+    announce a backbone-scoped prefix in the backbone area."""
+    adj_dbs: list[AdjacencyDatabase] = []
+    prefix_dbs: list[PrefixDatabase] = []
+    name = lambda g, r, c: f"r{g:02d}-n{r:02d}-{c:02d}"  # noqa: E731
+    mid = side // 2
+    hub = lambda g: name(g, mid, mid)  # noqa: E731
+
+    idx = 0
+    for g in range(regions):
+        area = f"r{g}"
+        for r in range(side):
+            for c in range(side):
+                adjs = []
+                for dr, dc in ((-1, 0), (1, 0), (0, -1), (0, 1)):
+                    rr, cc = r + dr, c + dc
+                    if 0 <= rr < side and 0 <= cc < side:
+                        adjs.append(_adj(name(g, r, c), name(g, rr, cc)))
+                idx += 1
+                adj_dbs.append(
+                    AdjacencyDatabase(
+                        this_node_name=name(g, r, c),
+                        adjacencies=tuple(adjs),
+                        node_label=100 + idx,
+                        area=area,
+                    )
+                )
+                prefix_dbs.append(
+                    PrefixDatabase(
+                        this_node_name=name(g, r, c),
+                        prefix_entries=(
+                            PrefixEntry(
+                                prefix=_loopback_prefix(idx),
+                                type=PrefixType.LOOPBACK,
+                                forwarding_type=PrefixForwardingType.IP,
+                                forwarding_algorithm=forwarding_algorithm,
+                            ),
+                        ),
+                        area=area,
+                    )
+                )
+    # backbone: hub ring with long-haul metrics + hub backbone prefixes
+    for g in range(regions):
+        nbrs = []
+        for other in ((g - 1) % regions, (g + 1) % regions):
+            if other != g:
+                nbrs.append(_adj(hub(g), hub(other), metric=10))
+        adj_dbs.append(
+            AdjacencyDatabase(
+                this_node_name=hub(g),
+                adjacencies=tuple(dict.fromkeys(nbrs)),
+                node_label=5000 + g,
+                area=backbone_area,
+            )
+        )
+        prefix_dbs.append(
+            PrefixDatabase(
+                this_node_name=hub(g),
+                prefix_entries=(
+                    PrefixEntry(
+                        prefix=f"fd00:bb::{g:x}/128",
+                        type=PrefixType.LOOPBACK,
+                        forwarding_type=PrefixForwardingType.IP,
+                        forwarding_algorithm=forwarding_algorithm,
+                    ),
+                ),
+                area=backbone_area,
+            )
+        )
+    return adj_dbs, prefix_dbs

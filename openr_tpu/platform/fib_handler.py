@@ -187,8 +187,14 @@ class NetlinkDataplane:
     async def delete_unicast(self, prefixes: list[str]) -> None:
         self._ensure_open()
         nl_routes = [self._to_nl(p, {}) for p in prefixes]
-        if await self._bulk(1, nl_routes) is not None:
-            return
+        bulk = await self._bulk(1, nl_routes)
+        if bulk is not None:
+            ok, err = bulk
+            # same mid-stream-abort rule as adds: only a fully-acked run
+            # counts (per-route NACKs (ENOENT) are fine for deletes, but
+            # UNSENT tails are not) — otherwise fall through and re-walk
+            if ok + err == len(nl_routes):
+                return
         for r in nl_routes:
             try:
                 await self.nl.delete_route(r)

@@ -42,22 +42,24 @@ def adj(a: str, b: str, metric: int = 1, **kw) -> Adjacency:
     )
 
 
-def adj_db_kv(node: str, adjs: list[Adjacency], version: int = 1, **kw):
+def adj_db_kv(node: str, adjs: list[Adjacency], version: int = 1,
+              area: str = AREA, **kw):
     db = AdjacencyDatabase(
-        this_node_name=node, adjacencies=tuple(adjs), area=AREA, **kw
+        this_node_name=node, adjacencies=tuple(adjs), area=area, **kw
     )
     return adj_key(node), Value(
         version=version, originator_id=node, value=serialize(db)
     )
 
 
-def prefix_db_kv(node: str, prefix: str, version: int = 1, **entry_kw):
+def prefix_db_kv(node: str, prefix: str, version: int = 1,
+                 area: str = AREA, **entry_kw):
     db = PrefixDatabase(
         this_node_name=node,
         prefix_entries=(PrefixEntry(prefix=prefix, **entry_kw),),
-        area=AREA,
+        area=area,
     )
-    return prefix_key(node, AREA, prefix), Value(
+    return prefix_key(node, area, prefix), Value(
         version=version, originator_id=node, value=serialize(db)
     )
 

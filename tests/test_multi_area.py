@@ -1,0 +1,245 @@
+"""Multi-area solver tests (ref per-area LinkState/KvStoreDb,
+openr/docs/Features/Area.md + Decision.h:302).
+
+The TPU backend now dispatches single-area-announced fast prefixes to
+their area's device pipeline (selection over one area's announcers is
+exactly the single-area problem) and routes genuinely-global prefixes —
+announcers spanning areas — through the oracle. Both must match the
+CPU oracle exactly, from hub vantages (member of region + backbone) and
+non-hub vantages alike.
+"""
+
+from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.decision.tpu_solver import TpuSpfSolver
+from openr_tpu.models import topologies
+from openr_tpu.types import (
+    Adjacency,
+    AdjacencyDatabase,
+    PrefixDatabase,
+    PrefixEntry,
+    PrefixType,
+)
+from tests.test_tpu_solver import assert_rib_equal
+
+
+def run_both(me, states, ps, **kw):
+    cpu_db = SpfSolver(me, **kw).build_route_db(me, states, ps)
+    tpu_db = TpuSpfSolver(me, **kw).build_route_db(me, states, ps)
+    if cpu_db is None:
+        assert tpu_db is None
+        return None
+    assert_rib_equal(cpu_db, tpu_db, me)
+    return cpu_db
+
+
+def test_multi_area_hub_vantage_parity():
+    adj, pfx = topologies.multi_area(regions=3, side=4)
+    states, ps = topologies.build_states(adj, pfx)
+    # hub r00-n02-02 is in areas r0 AND bb: it must see its region's
+    # loopbacks and every hub's backbone prefix
+    db = run_both("r00-n02-02", states, ps)
+    assert "fd00:bb::1/128" in db.unicast_routes  # other hub, via bb
+    assert "fd00::2/128" in db.unicast_routes  # own region loopback
+    # non-hub region nodes' prefixes from OTHER regions are unreachable
+    # (no cross-area redistribution at the solver layer)
+    assert "fd00::11/128" not in db.unicast_routes
+
+
+def test_multi_area_non_hub_vantage_parity():
+    adj, pfx = topologies.multi_area(regions=3, side=4)
+    states, ps = topologies.build_states(adj, pfx)
+    db = run_both("r01-n00-00", states, ps)
+    # sees only its region's prefixes (it is not in the backbone area)
+    assert any(p.startswith("fd00::") for p in db.unicast_routes)
+    assert not any(p.startswith("fd00:bb::") for p in db.unicast_routes)
+
+
+def test_multi_area_lfa_parity():
+    adj, pfx = topologies.multi_area(regions=3, side=4)
+    states, ps = topologies.build_states(adj, pfx)
+    run_both("r00-n02-02", states, ps, enable_lfa=True)
+    run_both("r02-n01-01", states, ps, enable_lfa=True)
+
+
+def test_cross_area_anycast_goes_global():
+    """A prefix announced in TWO areas needs global selection — the
+    device path must hand it to the oracle and still match."""
+    adj, pfx = topologies.multi_area(regions=2, side=4)
+    anycast = "fd00:77::1/128"
+    pfx = list(pfx) + [
+        PrefixDatabase(
+            this_node_name="r00-n00-00",
+            prefix_entries=(
+                PrefixEntry(prefix=anycast, type=PrefixType.LOOPBACK),
+            ),
+            area="r0",
+        ),
+        PrefixDatabase(
+            this_node_name="r01-n02-02",  # the r1 hub, also in bb
+            prefix_entries=(
+                PrefixEntry(prefix=anycast, type=PrefixType.LOOPBACK),
+            ),
+            area="bb",
+        ),
+    ]
+    states, ps = topologies.build_states(adj, pfx)
+    # r0's hub is in (r0, bb): reaches BOTH announcers; min metric wins
+    db = run_both("r00-n02-02", states, ps)
+    assert anycast in db.unicast_routes
+
+
+def test_multi_area_churn_parity():
+    adj, pfx = topologies.multi_area(regions=3, side=4)
+    states, ps = topologies.build_states(adj, pfx)
+    cpu = SpfSolver("r00-n02-02")
+    tpu = TpuSpfSolver("r00-n02-02")
+    assert_rib_equal(
+        cpu.build_route_db("r00-n02-02", states, ps),
+        tpu.build_route_db("r00-n02-02", states, ps),
+        "initial",
+    )
+    # flap a backbone link metric: only the bb area's pipeline refreshes
+    hub_db = next(
+        d
+        for d in adj
+        if d.this_node_name == "r01-n02-02" and d.area == "bb"
+    )
+    states["bb"].update_adjacency_database(
+        AdjacencyDatabase(
+            this_node_name="r01-n02-02",
+            adjacencies=tuple(
+                Adjacency(**{**a.__dict__, "metric": 50})
+                for a in hub_db.adjacencies
+            ),
+            node_label=hub_db.node_label,
+            area="bb",
+        )
+    )
+    assert_rib_equal(
+        cpu.build_route_db("r00-n02-02", states, ps),
+        tpu.build_route_db("r00-n02-02", states, ps),
+        "after bb churn",
+    )
+    # and a region flap
+    n_db = next(
+        d for d in adj if d.this_node_name == "r00-n01-01" and d.area == "r0"
+    )
+    states["r0"].update_adjacency_database(
+        AdjacencyDatabase(
+            this_node_name="r00-n01-01",
+            adjacencies=tuple(
+                Adjacency(**{**a.__dict__, "metric": 3})
+                for a in n_db.adjacencies
+            ),
+            node_label=n_db.node_label,
+            area="r0",
+        )
+    )
+    assert_rib_equal(
+        cpu.build_route_db("r00-n02-02", states, ps),
+        tpu.build_route_db("r00-n02-02", states, ps),
+        "after region churn",
+    )
+
+
+class TestDecisionActorMultiArea:
+    def test_publications_across_areas(self):
+        """Decision builds per-area LinkStates from publications' area
+        field and the solver merges routes across them (actor-level
+        seam; ref per-area LsdbDb handling in processPublication)."""
+        import asyncio
+
+        from tests.conftest import run_async
+        from tests.test_decision import (
+            DecisionHarness,
+            adj,
+            adj_db_kv,
+            prefix_db_kv,
+        )
+        from openr_tpu.types import Publication
+
+        @run_async
+        async def scenario():
+            async with DecisionHarness(node="hub") as h:
+                # area r0: hub -- a ; area bb: hub -- other-hub
+                h.kv_q.push(
+                    Publication(
+                        key_vals=dict(
+                            [
+                                adj_db_kv("hub", [adj("hub", "a")]),
+                                adj_db_kv("a", [adj("a", "hub")]),
+                                prefix_db_kv("a", "10.1.0.1/32"),
+                            ]
+                        ),
+                        area="0",
+                    )
+                )
+                kv_adj_hub = adj_db_kv(
+                    "hub", [adj("hub", "bbpeer")], area="bb"
+                )
+                kv_adj_peer = adj_db_kv(
+                    "bbpeer", [adj("bbpeer", "hub")], area="bb"
+                )
+                kv_pfx = prefix_db_kv("bbpeer", "10.2.0.1/32", area="bb")
+                h.kv_q.push(
+                    Publication(
+                        key_vals=dict([kv_adj_hub, kv_adj_peer, kv_pfx]),
+                        area="bb",
+                    )
+                )
+                h.synced()
+                update = await h.next_route_update()
+                got = set(update.unicast_routes_to_update)
+                assert got == {"10.1.0.1/32", "10.2.0.1/32"}, got
+                assert set(h.decision.area_link_states) == {"0", "bb"}
+
+        scenario()
+
+
+def test_multi_area_ksp2_primes_on_device():
+    """KSP2 prefixes announced in one region area get the batched device
+    second pass there — no per-destination masked host Dijkstras."""
+    from openr_tpu.types import (
+        PrefixForwardingAlgorithm,
+        PrefixForwardingType,
+    )
+
+    adj, pfx = topologies.multi_area(regions=2, side=4)
+    ksp2_pfx = "fd00:a2::1/128"
+    pfx = list(pfx) + [
+        PrefixDatabase(
+            this_node_name="r00-n03-03",
+            prefix_entries=(
+                PrefixEntry(
+                    prefix=ksp2_pfx,
+                    type=PrefixType.LOOPBACK,
+                    forwarding_type=PrefixForwardingType.SR_MPLS,
+                    forwarding_algorithm=PrefixForwardingAlgorithm.KSP2_ED_ECMP,
+                ),
+            ),
+            area="r0",
+        )
+    ]
+    states, ps = topologies.build_states(adj, pfx)
+    tpu_states, tpu_ps = topologies.build_states(adj, pfx)
+
+    calls = {"masked": 0}
+    ls = tpu_states["r0"]
+    orig = ls.run_spf
+
+    def counting(root, use_link_metric=True, links_to_ignore=()):
+        if links_to_ignore:
+            calls["masked"] += 1
+        return orig(root, use_link_metric, links_to_ignore)
+
+    ls.run_spf = counting
+    # small_graph_nodes=0 so the 16-node region still uses the device
+    tpu_db = TpuSpfSolver("r00-n00-00").build_route_db(
+        "r00-n00-00", tpu_states, tpu_ps
+    )
+    assert calls["masked"] == 0, "KSP2 second pass fell back to host"
+    cpu_db = SpfSolver("r00-n00-00").build_route_db(
+        "r00-n00-00", states, ps
+    )
+    assert_rib_equal(cpu_db, tpu_db, "multi-area ksp2")
+    assert ksp2_pfx in tpu_db.unicast_routes
