@@ -25,7 +25,10 @@ def _call(
     """One-shot RPC against the ctrl server."""
 
     async def run():
-        client = RpcClient(ctx.obj["host"], ctx.obj["port"], name="breeze")
+        client = RpcClient(
+            ctx.obj["host"], ctx.obj["port"], name="breeze",
+            ssl=ctx.obj.get("ssl"),
+        )
         try:
             return await client.request(method, params or {}, timeout_s)
         finally:
@@ -41,12 +44,20 @@ def _print(obj: Any) -> None:
 @click.group()
 @click.option("--host", default="127.0.0.1", help="ctrl server host")
 @click.option("--port", default=2018, type=int, help="ctrl server port")
+@click.option("--cacert", default="", help="CA bundle: verify + TLS on")
+@click.option("--cert", default="", help="client certificate (mutual TLS)")
+@click.option("--key", default="", help="client private key")
 @click.pass_context
-def cli(ctx, host: str, port: int) -> None:
+def cli(ctx, host: str, port: int, cacert: str, cert: str, key: str) -> None:
     """breeze — operate an openr_tpu node (ref breeze.py:32)."""
     ctx.ensure_object(dict)
     ctx.obj["host"] = host
     ctx.obj["port"] = port
+    ctx.obj["ssl"] = None
+    if cacert or cert or key:
+        from openr_tpu.config import build_client_ssl_context
+
+        ctx.obj["ssl"] = build_client_ssl_context(cacert, cert, key)
 
 
 # -- openr ------------------------------------------------------------------

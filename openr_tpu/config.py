@@ -154,9 +154,68 @@ class SegmentRoutingConfig:
 
 @dataclass
 class ThriftServerConfig:
+    """ref OpenrConfig.thrift thrift_server + the secure-server option
+    (OpenrThriftCtrlServer SSL with acceptable peers)."""
+
     openr_ctrl_port: int = 2018
     listen_addr: str = "::1"
     enable_secure_thrift_server: bool = False
+    x509_cert_path: str = ""
+    x509_key_path: str = ""
+    # CA bundle: the server VERIFIES CLIENT certs against it (mutual
+    # TLS, the reference's acceptable-peers role) and clients verify the
+    # server against it
+    x509_ca_path: str = ""
+
+
+def build_server_ssl_context(ts: ThriftServerConfig):
+    """TLS context for the ctrl RPC server; requires cert+key, and
+    enforces client certificates when a CA bundle is configured."""
+    import ssl as _ssl
+
+    if not (ts.x509_cert_path and ts.x509_key_path):
+        raise ConfigError(
+            "enable_secure_thrift_server requires x509_cert_path and "
+            "x509_key_path"
+        )
+    ctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(ts.x509_cert_path, ts.x509_key_path)
+    if ts.x509_ca_path:
+        ctx.load_verify_locations(ts.x509_ca_path)
+        ctx.verify_mode = _ssl.CERT_REQUIRED
+    return ctx
+
+
+def build_client_ssl_context(
+    ca_path: str = "", cert_path: str = "", key_path: str = ""
+):
+    """TLS context for ctrl RPC clients (breeze, agents).
+
+    A client certificate REQUIRES a CA bundle: authenticating ourselves
+    to a server we refuse to verify hands the credential to any
+    man-in-the-middle. cert without key treats the cert file as a
+    combined PEM; key without cert is a mistake."""
+    import ssl as _ssl
+
+    if key_path and not cert_path:
+        raise ConfigError("client TLS key given without a certificate")
+    if cert_path and not ca_path:
+        raise ConfigError(
+            "client certificate requires a CA bundle to verify the "
+            "server (mutual TLS against an unverified peer leaks the "
+            "credential)"
+        )
+    ctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_CLIENT)
+    if ca_path:
+        ctx.load_verify_locations(ca_path)
+        # host certs are identified by node name, not DNS
+        ctx.check_hostname = False
+    else:
+        ctx.check_hostname = False
+        ctx.verify_mode = _ssl.CERT_NONE
+    if cert_path:
+        ctx.load_cert_chain(cert_path, key_path or None)
+    return ctx
 
 
 @dataclass
@@ -322,6 +381,23 @@ class Config:
             lo, hi = sr.sr_node_label_range
             if lo >= hi:
                 raise ConfigError("bad node label range")
+        ts = cfg.thrift_server
+        if ts.enable_secure_thrift_server:
+            # fail at LOAD time, not after half the actors started
+            if not (ts.x509_cert_path and ts.x509_key_path):
+                raise ConfigError(
+                    "enable_secure_thrift_server requires x509_cert_path "
+                    "and x509_key_path"
+                )
+            import os as _os
+
+            for what, path in (
+                ("x509_cert_path", ts.x509_cert_path),
+                ("x509_key_path", ts.x509_key_path),
+                ("x509_ca_path", ts.x509_ca_path),
+            ):
+                if path and not _os.path.isfile(path):
+                    raise ConfigError(f"{what} {path!r} is not readable")
         if cfg.origination_policy and cfg.origination_policy not in cfg.policies:
             raise ConfigError(
                 f"origination_policy {cfg.origination_policy!r} is not in "

@@ -116,7 +116,14 @@ class CtrlServer(Actor):
                 self._watch_initialization(self._fib_updates_q),
                 name=f"{self.name}.init-watch-fib",
             )
-        self.port = await s.start(port=self._listen_port)
+        ssl_ctx = None
+        if self.config is not None:
+            ts = self.config.raw.thrift_server
+            if ts.enable_secure_thrift_server:
+                from openr_tpu.config import build_server_ssl_context
+
+                ssl_ctx = build_server_ssl_context(ts)
+        self.port = await s.start(port=self._listen_port, ssl=ssl_ctx)
 
     async def on_stop(self) -> None:
         await self.server.stop()

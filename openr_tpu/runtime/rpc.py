@@ -83,9 +83,14 @@ class RpcServer:
     def register(self, method: str, handler: Handler) -> None:
         self._handlers[method] = handler
 
-    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+    async def start(
+        self, host: str = "127.0.0.1", port: int = 0, ssl=None
+    ) -> int:
+        """ssl: an ssl.SSLContext for TLS service (role of the
+        reference's secure thrift server option,
+        OpenrThriftCtrlServer SSL + acceptable-peers)."""
         self._server = await asyncio.start_server(
-            self._handle_conn, host, port, limit=_MAX_FRAME
+            self._handle_conn, host, port, limit=_MAX_FRAME, ssl=ssl
         )
         return self._server.sockets[0].getsockname()[1]
 
@@ -189,10 +194,11 @@ class RpcClient:
     it by id. Connection failures surface as RpcConnectionError — the
     caller's FSM/backoff owns retry policy (ref KvStore.cpp:2134-2141)."""
 
-    def __init__(self, host: str, port: int, name: str = ""):
+    def __init__(self, host: str, port: int, name: str = "", ssl=None):
         self.host = host
         self.port = port
         self.name = name or f"{host}:{port}"
+        self.ssl = ssl  # ssl.SSLContext for TLS clients
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._pending: dict[int, asyncio.Future] = {}
@@ -212,7 +218,7 @@ class RpcClient:
             try:
                 self._reader, self._writer = await asyncio.wait_for(
                     asyncio.open_connection(
-                        self.host, self.port, limit=_MAX_FRAME
+                        self.host, self.port, limit=_MAX_FRAME, ssl=self.ssl
                     ),
                     timeout_s,
                 )

@@ -1,0 +1,102 @@
+"""TLS on the ctrl RPC plane (role of the reference's secure thrift
+server with acceptable peers — OpenrThriftCtrlServer SSL option)."""
+
+import subprocess
+
+import pytest
+
+from openr_tpu.config import (
+    Config,
+    OpenrConfig,
+    ThriftServerConfig,
+    build_client_ssl_context,
+)
+from openr_tpu.ctrl.ctrl_server import CtrlServer
+from openr_tpu.runtime.rpc import RpcClient, RpcConnectionError
+from tests.conftest import run_async
+
+
+@pytest.fixture(scope="module")
+def pki(tmp_path_factory):
+    """Self-signed CA + server cert + client cert via the openssl CLI."""
+    d = tmp_path_factory.mktemp("pki")
+
+    def sh(*args):
+        subprocess.run(args, check=True, capture_output=True)
+
+    ca_key, ca_crt = d / "ca.key", d / "ca.crt"
+    sh("openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+       "-keyout", str(ca_key), "-out", str(ca_crt), "-days", "1",
+       "-subj", "/CN=openr-test-ca")
+    for name in ("server", "client"):
+        key, csr, crt = d / f"{name}.key", d / f"{name}.csr", d / f"{name}.crt"
+        sh("openssl", "req", "-newkey", "rsa:2048", "-nodes",
+           "-keyout", str(key), "-out", str(csr), "-subj", f"/CN={name}")
+        sh("openssl", "x509", "-req", "-in", str(csr), "-CA", str(ca_crt),
+           "-CAkey", str(ca_key), "-CAcreateserial", "-out", str(crt),
+           "-days", "1")
+    return d
+
+
+def secure_config(pki, mutual: bool) -> Config:
+    return Config(
+        OpenrConfig(
+            node_name="tls-node",
+            thrift_server=ThriftServerConfig(
+                enable_secure_thrift_server=True,
+                x509_cert_path=str(pki / "server.crt"),
+                x509_key_path=str(pki / "server.key"),
+                x509_ca_path=str(pki / "ca.crt") if mutual else "",
+            ),
+        )
+    )
+
+
+@run_async
+async def test_tls_server_rejects_plaintext_and_serves_tls(pki):
+    server = CtrlServer("tls-node", config=secure_config(pki, mutual=False))
+    await server.start()
+    try:
+        plain = RpcClient("127.0.0.1", server.port, name="plain")
+        with pytest.raises((RpcConnectionError, Exception)):
+            await plain.request("openr.version", timeout_s=2.0)
+        await plain.close()
+
+        ctx = build_client_ssl_context(ca_path=str(pki / "ca.crt"))
+        tls = RpcClient("127.0.0.1", server.port, name="tls", ssl=ctx)
+        try:
+            version = await tls.request("openr.version")
+            assert version["node"] == "tls-node"
+        finally:
+            await tls.close()
+    finally:
+        await server.stop()
+
+
+@run_async
+async def test_mutual_tls_requires_client_cert(pki):
+    server = CtrlServer("tls-node", config=secure_config(pki, mutual=True))
+    await server.start()
+    try:
+        # CA-verified but certless client: handshake must fail
+        bare = RpcClient(
+            "127.0.0.1", server.port, name="bare",
+            ssl=build_client_ssl_context(ca_path=str(pki / "ca.crt")),
+        )
+        with pytest.raises((RpcConnectionError, Exception)):
+            await bare.request("openr.version", timeout_s=2.0)
+        await bare.close()
+
+        ctx = build_client_ssl_context(
+            ca_path=str(pki / "ca.crt"),
+            cert_path=str(pki / "client.crt"),
+            key_path=str(pki / "client.key"),
+        )
+        authed = RpcClient("127.0.0.1", server.port, name="authed", ssl=ctx)
+        try:
+            version = await authed.request("openr.version")
+            assert version["node"] == "tls-node"
+        finally:
+            await authed.close()
+    finally:
+        await server.stop()
