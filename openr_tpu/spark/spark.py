@@ -364,6 +364,12 @@ class Spark(Actor):
 
     def _process_hello(self, pkt: ReceivedPacket) -> None:
         hello = pkt.packet.hello
+        if not hello.node_name:
+            # sanity check (ref sanityCheckMsg): a nameless hello must
+            # not create neighbor state — WARM sessions have no hold
+            # timer, so a hostile sender could grow permanent entries
+            counters.increment("spark.hello.invalid")
+            return
         if hello.node_name == self.node_name:
             return  # our own multicast echo
         counters.increment("spark.hello.packets_recv")
@@ -487,6 +493,9 @@ class Spark(Actor):
 
     async def _process_handshake(self, pkt: ReceivedPacket) -> None:
         msg = pkt.packet.handshake
+        if not msg.node_name:
+            counters.increment("spark.handshake.invalid")
+            return  # sanity: nameless sender must not create state
         if msg.node_name == self.node_name:
             return
         if msg.neighbor_node_name and msg.neighbor_node_name != self.node_name:

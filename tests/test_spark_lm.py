@@ -410,3 +410,113 @@ class TestLinkMonitor:
             if ok:
                 out.append(item)
         return out
+
+
+class TestSparkRobustness:
+    """Malformed/hostile input must not wedge the FSM (the reference
+    keeps an explicit fuzzer seam — Spark.h:84-85 setThrowParserErrors;
+    here the parse boundary is the serde deserialize in IoProvider and
+    the per-message handlers' exception isolation)."""
+
+    @run_async
+    async def test_garbage_datagrams_dont_break_discovery(self):
+        """Blast raw garbage at a live UDP provider port while two real
+        sparks establish — discovery must still converge."""
+        import socket as _socket
+
+        from openr_tpu.spark.io_provider import UdpIoProvider
+
+        io_a = UdpIoProvider(0)
+        io_b = UdpIoProvider(0)
+        addr_a = await io_a.add_interface("if0", "127.0.0.1", None)
+        addr_b = await io_b.add_interface("if0", "127.0.0.1", None)
+        io_a.set_peers("if0", [addr_b])
+        io_b.set_peers("if0", [addr_a])
+
+        qa = ReplicateQueue("a.nbr")
+        events = qa.get_reader("test")
+        a = Spark("a", FAST, io_a, qa)
+        qb = ReplicateQueue("b.nbr")
+        b = Spark("b", FAST, io_b, qb)
+        a.add_interface("if0")
+        b.add_interface("if0")
+        await a.start()
+        await b.start()
+        try:
+            # hostile traffic straight at a's socket: junk bytes, empty
+            # JSON, truncated frames
+            s = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+            for payload in (b"\x00\xff" * 50, b"{}", b'{"hello":', b""):
+                for _ in range(20):
+                    s.sendto(payload, addr_a)
+            s.close()
+
+            async def established():
+                while True:
+                    ev = await events.get()
+                    if (
+                        isinstance(ev, NeighborEvent)
+                        and ev.event_type == NeighborEventType.NEIGHBOR_UP
+                    ):
+                        return ev
+
+            ev = await asyncio.wait_for(established(), 10)
+            assert ev.node_name == "b"
+        finally:
+            qa.close()
+            qb.close()
+            await a.stop()
+            await b.stop()
+
+    @run_async
+    async def test_hostile_field_values_are_isolated(self):
+        """Well-formed packets with absurd field values (negative seq,
+        empty node name, unknown-neighbor heartbeat) are dropped or
+        ignored without killing the recv loop."""
+        from openr_tpu.types import (
+            SparkHeartbeatMsg,
+            SparkHelloMsg,
+            SparkPacket,
+        )
+
+        mesh = MockIoMesh()
+        a = SparkNode(mesh, "a")
+        b = SparkNode(mesh, "b")
+        mesh.connect("a", "if-ab", "b", "if-ba")
+        evil = mesh.provider("evil")
+        mesh.connect("evil", "if-ea", "a", "if-ab")
+        await a.start("if-ab")
+        await b.start("if-ba")
+        try:
+            await evil.send(
+                "if-ea",
+                SparkPacket(
+                    heartbeat=SparkHeartbeatMsg(
+                        node_name="ghost", seq_num=-5
+                    )
+                ),
+            )
+            await evil.send(
+                "if-ea",
+                SparkPacket(
+                    hello=SparkHelloMsg(
+                        domain_name="", node_name="", if_name="",
+                        seq_num=-1, sent_ts_us=-99,
+                    )
+                ),
+            )
+            await wait_until(
+                lambda: a.spark.neighbors.get(("if-ab", "b")) is not None
+                and a.spark.neighbors[("if-ab", "b")].state
+                == SparkNeighState.ESTABLISHED,
+                timeout_s=10,
+            )
+            # the hostile senders created NO neighbor state: a nameless
+            # hello would otherwise live forever (WARM sessions have no
+            # hold timer) and 'ghost' never completed the FSM handshake
+            assert ("if-ab", "") not in a.spark.neighbors
+            assert ("if-ab", "ghost") not in a.spark.neighbors
+            assert set(a.spark.neighbors) == {("if-ab", "b")}
+        finally:
+            await a.stop()
+            await b.stop()
