@@ -382,7 +382,10 @@ def tech_support(ctx) -> None:
     for title, method, params in [
         ("VERSION", "openr.version", {}),
         ("INITIALIZATION", "openr.initialization_events", {}),
+        ("RUNNING CONFIG", "ctrl.config.get", {}),
+        ("DRAIN STATE", "openr.drain_state", {}),
         ("KVSTORE PEERS", "ctrl.kvstore.peers", {}),
+        ("FLOOD TOPOLOGY", "ctrl.kvstore.flood_topo", {}),
         ("KVSTORE DUMP", "ctrl.kvstore.dump", {}),
         ("ADJACENCIES", "ctrl.decision.adj_dbs", {}),
         ("COMPUTED ROUTES", "ctrl.decision.routes", {}),

@@ -122,6 +122,9 @@ class _NeighborInfo:
     reported_rtt_us: int = 0
     # sliding sample window for the step detector
     rtt_samples: deque = field(default_factory=deque)
+    # last message receipt; pre-ESTABLISHED sessions idle past the sweep
+    # TTL are reaped (they have no hold timer of their own)
+    last_msg_ts: float = 0.0
     hold_time_ms: int = 0
     gr_active: bool = False
     restarted: bool = False  # came back through RESTART
@@ -220,6 +223,7 @@ class Spark(Actor):
     async def _hello_loop(self) -> None:
         while True:
             now = time.monotonic()
+            self._sweep_stale_sessions(now)
             fast = now < self._fast_init_until
             await self._send_hellos(solicit=fast)
             if not fast and not self._init_event_sent:
@@ -350,7 +354,27 @@ class Spark(Actor):
             )
             area = self._resolve_area(node, if_name)
             nb.area = area if area is not None else ""
+        nb.last_msg_ts = time.monotonic()
         return nb
+
+    def _sweep_stale_sessions(self, now: float) -> None:
+        """Age out pre-ESTABLISHED sessions that stopped talking.
+        IDLE/WARM/NEGOTIATE entries carry no hold timer, so without this
+        a sender spoofing a fresh node_name per packet would grow
+        permanent neighbor state; a real neighbor mid-discovery keeps
+        its entry alive with every hello and re-forms instantly anyway
+        (runs on the hello cadence; ESTABLISHED/RESTART lifetimes belong
+        to the hold/GR timers)."""
+        ttl = max(self.cfg.hold_time_s, 3 * self.cfg.hello_time_s)
+        for key, nb in list(self.neighbors.items()):
+            if nb.state in (
+                SparkNeighState.ESTABLISHED,
+                SparkNeighState.RESTART,
+            ):
+                continue
+            if now - nb.last_msg_ts > ttl:
+                self._drop_neighbor(key)
+                counters.increment("spark.stale_sessions_swept")
 
     def _drop_neighbor(self, key: tuple[str, str]) -> None:
         nb = self.neighbors.pop(key, None)

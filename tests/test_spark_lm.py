@@ -520,3 +520,50 @@ class TestSparkRobustness:
         finally:
             await a.stop()
             await b.stop()
+
+    @run_async
+    async def test_spoofed_names_are_swept(self):
+        """Distinct spoofed node_names create transient WARM entries at
+        most: the stale-session sweep reaps pre-ESTABLISHED state that
+        stops talking, while the real neighbor survives."""
+        from openr_tpu.kvstore.wrapper import wait_until
+        from openr_tpu.types import SparkHelloMsg, SparkPacket
+
+        mesh = MockIoMesh()
+        a = SparkNode(mesh, "a")
+        b = SparkNode(mesh, "b")
+        mesh.connect("a", "if-ab", "b", "if-ba")
+        evil = mesh.provider("evil")
+        mesh.connect("evil", "if-ea", "a", "if-ab")
+        await a.start("if-ab")
+        await b.start("if-ba")
+        try:
+            for i in range(50):
+                await evil.send(
+                    "if-ea",
+                    SparkPacket(
+                        hello=SparkHelloMsg(
+                            domain_name="", node_name=f"spoof-{i}",
+                            if_name="x", seq_num=1, sent_ts_us=1,
+                        )
+                    ),
+                )
+            await wait_until(
+                lambda: a.spark.neighbors.get(("if-ab", "b")) is not None
+                and a.spark.neighbors[("if-ab", "b")].state
+                == SparkNeighState.ESTABLISHED,
+                timeout_s=10,
+            )
+            # ttl = max(hold 0.3s, 3*hello 0.24s); sweep rides the hello
+            # cadence — all spoofed WARM entries must be gone shortly
+            await wait_until(
+                lambda: set(a.spark.neighbors) == {("if-ab", "b")},
+                timeout_s=5,
+            )
+            assert (
+                a.spark.neighbors[("if-ab", "b")].state
+                == SparkNeighState.ESTABLISHED
+            )
+        finally:
+            await a.stop()
+            await b.stop()
