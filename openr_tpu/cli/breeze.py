@@ -28,6 +28,7 @@ def _call(
         client = RpcClient(
             ctx.obj["host"], ctx.obj["port"], name="breeze",
             ssl=ctx.obj.get("ssl"),
+            expected_peer=ctx.obj.get("peer_name", ""),
         )
         try:
             return await client.request(method, params or {}, timeout_s)
@@ -47,13 +48,21 @@ def _print(obj: Any) -> None:
 @click.option("--cacert", default="", help="CA bundle: verify + TLS on")
 @click.option("--cert", default="", help="client certificate (mutual TLS)")
 @click.option("--key", default="", help="client private key")
+@click.option(
+    "--peer-name", default="",
+    help="node name the server cert must claim (CN/SAN identity pin)",
+)
 @click.pass_context
-def cli(ctx, host: str, port: int, cacert: str, cert: str, key: str) -> None:
+def cli(
+    ctx, host: str, port: int, cacert: str, cert: str, key: str,
+    peer_name: str,
+) -> None:
     """breeze — operate an openr_tpu node (ref breeze.py:32)."""
     ctx.ensure_object(dict)
     ctx.obj["host"] = host
     ctx.obj["port"] = port
     ctx.obj["ssl"] = None
+    ctx.obj["peer_name"] = peer_name
     if cacert or cert or key:
         from openr_tpu.config import build_client_ssl_context
 

@@ -166,6 +166,45 @@ class ThriftServerConfig:
     # TLS, the reference's acceptable-peers role) and clients verify the
     # server against it
     x509_ca_path: str = ""
+    # comma-separated CNs the server accepts from client certs (ref's
+    # acceptable-peers list); empty = any cert signed by the CA. CA
+    # membership alone lets any node impersonate any other, so deployments
+    # with per-role certs should set this.
+    acceptable_peers: str = ""
+
+
+def cert_peer_names(cert) -> set:
+    """Names a peer certificate claims: subject CNs + SAN DNS entries.
+
+    Host certs in an openr deployment identify the *node* (CN=node-name),
+    not a DNS host, so identity checks compare against this set rather
+    than using ssl's hostname matching."""
+    names = set()
+    if not cert:
+        return names
+    for rdn in cert.get("subject", ()):  # ((('commonName','x'),),...)
+        for key, val in rdn:
+            if key == "commonName":
+                names.add(val)
+    for typ, val in cert.get("subjectAltName", ()):
+        if typ in ("DNS", "IP Address"):
+            names.add(val)
+    return names
+
+
+def make_peer_verifier(acceptable_peers: str):
+    """Server-side identity check for mutual TLS (role of the reference's
+    acceptable-peers list on its secure thrift server): returns a callable
+    fed the client's cert dict post-handshake, or None when no constraint
+    is configured (any CA-signed cert accepted)."""
+    allowed = {p.strip() for p in acceptable_peers.split(",") if p.strip()}
+    if not allowed:
+        return None
+
+    def verify(cert) -> bool:
+        return bool(cert_peer_names(cert) & allowed)
+
+    return verify
 
 
 def build_server_ssl_context(ts: ThriftServerConfig):
@@ -177,6 +216,15 @@ def build_server_ssl_context(ts: ThriftServerConfig):
         raise ConfigError(
             "enable_secure_thrift_server requires x509_cert_path and "
             "x509_key_path"
+        )
+    if ts.acceptable_peers and not ts.x509_ca_path:
+        # without a CA the server never requests client certs, so the
+        # verifier would see no cert and reject every connection —
+        # surface the misconfiguration at startup, not as a bricked
+        # ctrl plane
+        raise ConfigError(
+            "acceptable_peers requires x509_ca_path (client certs are "
+            "only requested when a CA bundle is configured)"
         )
     ctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_SERVER)
     ctx.load_cert_chain(ts.x509_cert_path, ts.x509_key_path)

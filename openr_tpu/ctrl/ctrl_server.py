@@ -117,13 +117,20 @@ class CtrlServer(Actor):
                 name=f"{self.name}.init-watch-fib",
             )
         ssl_ctx = None
+        peer_verifier = None
         if self.config is not None:
             ts = self.config.raw.thrift_server
             if ts.enable_secure_thrift_server:
-                from openr_tpu.config import build_server_ssl_context
+                from openr_tpu.config import (
+                    build_server_ssl_context,
+                    make_peer_verifier,
+                )
 
                 ssl_ctx = build_server_ssl_context(ts)
-        self.port = await s.start(port=self._listen_port, ssl=ssl_ctx)
+                peer_verifier = make_peer_verifier(ts.acceptable_peers)
+        self.port = await s.start(
+            port=self._listen_port, ssl=ssl_ctx, peer_verifier=peer_verifier
+        )
 
     async def on_stop(self) -> None:
         await self.server.stop()
@@ -366,13 +373,19 @@ class CtrlServer(Actor):
                 if k.startswith(ADJ_DB_MARKER)
             }
 
-        current = adj_versions(await self.kvstore.dump_all(area))
-        if changed_vs_snapshot(current):
-            return {"changed": True}
-        if self._kvstore_updates_q is None:
-            return {"changed": False}
-        reader = self._kvstore_updates_q.get_reader(f"{self.name}.longpoll")
+        # Register the reader BEFORE taking the snapshot: a publication
+        # landing between dump_all and reader creation would otherwise be
+        # missed and the poll sleeps its full timeout (ref installs the
+        # kvstore callback before snapshotting for the same reason).
+        reader = None
+        if self._kvstore_updates_q is not None:
+            reader = self._kvstore_updates_q.get_reader(f"{self.name}.longpoll")
         try:
+            current = adj_versions(await self.kvstore.dump_all(area))
+            if changed_vs_snapshot(current):
+                return {"changed": True}
+            if reader is None:
+                return {"changed": False}
             deadline = time.monotonic() + timeout_s
             while True:
                 remaining = deadline - time.monotonic()
@@ -392,7 +405,8 @@ class CtrlServer(Actor):
                 ):
                     return {"changed": True}
         finally:
-            self._kvstore_updates_q.remove_reader(reader)
+            if reader is not None:
+                self._kvstore_updates_q.remove_reader(reader)
 
     async def _dryrun_config(self, config: dict) -> dict:
         """Validate a config payload without applying it (ref

@@ -362,6 +362,15 @@ class Fib(Actor):
             for p in del_prefixes:
                 rs.dirty_prefixes.pop(p, None)
                 programmed.unicast_routes_to_delete.append(p)
+        except FibUpdateError as e:
+            # partial failure: successfully-deleted prefixes leave the
+            # dirty set and publish their FIB-ACK now; only the failed
+            # ones stay dirty for retry (mirrors the add path above)
+            ok = False
+            for p in del_prefixes:
+                if p not in e.failed_prefixes:
+                    rs.dirty_prefixes.pop(p, None)
+                    programmed.unicast_routes_to_delete.append(p)
         except Exception as e:
             log.warning("%s: delete_unicast failed: %s", self.name, e)
             ok = False

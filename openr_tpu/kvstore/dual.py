@@ -165,19 +165,22 @@ class Dual:
     def handle_message(self, sender: str, msg: dict) -> None:
         mtype = msg.get("type")
         root = msg.get("root", "")
+        if sender not in self.peers:
+            # message from a peer we don't (or no longer) track — e.g.
+            # one in flight across a peer deletion. Adopting it would
+            # resurrect a ghost that no lifecycle event ever removes (and
+            # that flooding can't reach); drop it — the sender's next
+            # peer_up re-introduces state on both sides. This covers
+            # topo_set too: an in-flight child claim from a removed peer
+            # would leak a ghost child forever (peer_up re-sends the
+            # claim, so dropping loses nothing).
+            return
         if mtype == "topo_set":
             rs = self._root_state(root)
             if msg.get("child"):
                 rs.children.add(sender)
             else:
                 rs.children.discard(sender)
-            return
-        if sender not in self.peers:
-            # message from a peer we don't (or no longer) track — e.g.
-            # one in flight across a peer deletion. Adopting it would
-            # resurrect a ghost that no lifecycle event ever removes (and
-            # that flooding can't reach); drop it — the sender's next
-            # peer_up re-introduces state on both sides.
             return
         rs = self._root_state(root)
         dist = int(msg.get("dist", INF))

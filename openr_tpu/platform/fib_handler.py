@@ -21,6 +21,7 @@ wait_for_fib_service blocks startup until the agent answers aliveSince
 from __future__ import annotations
 
 import asyncio
+import logging
 import time
 from typing import Optional
 
@@ -49,9 +50,10 @@ class MemoryDataplane:
                 self.unicast[p] = r
         return failed
 
-    async def delete_unicast(self, prefixes: list[str]) -> None:
+    async def delete_unicast(self, prefixes: list[str]) -> list[str]:
         for p in prefixes:
             self.unicast.pop(p, None)
+        return []
 
     async def sync_unicast(self, routes: dict[str, dict]) -> list[str]:
         failed = [p for p in routes if p in self.fail_prefixes]
@@ -184,22 +186,39 @@ class NetlinkDataplane:
                 failed.append(r.prefix)
         return failed
 
-    async def delete_unicast(self, prefixes: list[str]) -> None:
+    async def delete_unicast(self, prefixes: list[str]) -> list[str]:
+        import errno as _errno
+
+        from openr_tpu.runtime.counters import counters
+
         self._ensure_open()
         nl_routes = [self._to_nl(p, {}) for p in prefixes]
         bulk = await self._bulk(1, nl_routes)
         if bulk is not None:
             ok, err = bulk
-            # same mid-stream-abort rule as adds: only a fully-acked run
-            # counts (per-route NACKs (ENOENT) are fine for deletes, but
-            # UNSENT tails are not) — otherwise fall through and re-walk
-            if ok + err == len(nl_routes):
-                return
+            # only a fully-acked run with zero NACKs counts as clean: a
+            # mid-stream abort leaves an UNSENT tail, and a NACK may be a
+            # benign ENOENT or a real EPERM/EBUSY — the bulk path returns
+            # counts, not errnos, so any NACK falls through to the
+            # per-route walk to be classified
+            if err == 0 and ok == len(nl_routes):
+                return []
+        failed = []
         for r in nl_routes:
             try:
                 await self.nl.delete_route(r)
-            except OSError:
-                pass  # already gone
+            except OSError as e:
+                # already-gone is success for a delete; anything else
+                # (EPERM, EBUSY, ...) left a stale kernel route — surface
+                # it so sync/retry logic doesn't report a clean table
+                if e.errno in (_errno.ENOENT, _errno.ESRCH):
+                    continue
+                counters.increment("platform.fib.delete_failure")
+                logging.getLogger(__name__).warning(
+                    "delete_unicast: %s failed: %s", r.prefix, e
+                )
+                failed.append(r.prefix)
+        return failed
 
     async def sync_unicast(self, routes: dict[str, dict]) -> list[str]:
         import socket as _socket
@@ -294,8 +313,8 @@ class FibPlatformServer:
         return {"failed_prefixes": failed}
 
     async def _del_unicast(self, client_id: int, prefixes: list) -> dict:
-        await self.dataplane.delete_unicast(prefixes)
-        return {}
+        failed = await self.dataplane.delete_unicast(prefixes)
+        return {"failed_prefixes": failed}
 
     async def _sync_fib(self, client_id: int, routes: dict) -> dict:
         failed = await self.dataplane.sync_unicast(routes)
@@ -365,10 +384,11 @@ class RemoteFibService(FibServiceBase):
         self._raise_failed(res)
 
     async def delete_unicast_routes(self, client_id, prefixes) -> None:
-        await self.client.request(
+        res = await self.client.request(
             "platform.fib.delete_unicast_routes",
             {"client_id": client_id, "prefixes": list(prefixes)},
         )
+        self._raise_failed(res or {})
 
     async def add_mpls_routes(self, client_id, routes) -> None:
         res = await self.client.request(

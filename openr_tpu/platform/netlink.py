@@ -134,8 +134,21 @@ class NetlinkRouteSocket:
             self._sock.close()
             self._sock = None
         for p in self._pending.values():
+            # _complete() releases a window slot per answered request;
+            # failing un-answered ones here bypasses it, and without a
+            # matching release a close with in-flight requests permanently
+            # shrinks the window if the socket is reopened. Already-done
+            # futures (answered, not yet reaped by _send) released theirs
+            # in _complete — skip them or the slot double-releases.
             if not p.future.done():
                 p.future.set_exception(ConnectionError("netlink closed"))
+                self._window.release()
+            elif p.future.cancelled():
+                # timed-out request whose _send finally hasn't run yet:
+                # _complete never released its slot, and after we clear
+                # _pending the finally's pop comes back empty so IT won't
+                # release either — do it here
+                self._window.release()
         self._pending.clear()
 
     # -- request plumbing --------------------------------------------------

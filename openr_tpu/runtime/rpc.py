@@ -79,16 +79,24 @@ class RpcServer:
         self._handlers: dict[str, Handler] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self._conn_tasks: set[asyncio.Task] = set()
+        self._peer_verifier: Optional[Callable[[Any], bool]] = None
 
     def register(self, method: str, handler: Handler) -> None:
         self._handlers[method] = handler
 
     async def start(
-        self, host: str = "127.0.0.1", port: int = 0, ssl=None
+        self, host: str = "127.0.0.1", port: int = 0, ssl=None,
+        peer_verifier: Optional[Callable[[Any], bool]] = None,
     ) -> int:
         """ssl: an ssl.SSLContext for TLS service (role of the
         reference's secure thrift server option,
-        OpenrThriftCtrlServer SSL + acceptable-peers)."""
+        OpenrThriftCtrlServer SSL + acceptable-peers).
+
+        peer_verifier: called with the client's cert dict (ssl
+        getpeercert) after the handshake; returning False drops the
+        connection — the reference's acceptable-peers identity check,
+        which CA membership alone does not provide."""
+        self._peer_verifier = peer_verifier
         self._server = await asyncio.start_server(
             self._handle_conn, host, port, limit=_MAX_FRAME, ssl=ssl
         )
@@ -123,6 +131,16 @@ class RpcServer:
         if task is not None:
             self._conn_tasks.add(task)
             task.add_done_callback(self._conn_tasks.discard)
+        if self._peer_verifier is not None:
+            ssl_obj = writer.get_extra_info("ssl_object")
+            cert = ssl_obj.getpeercert() if ssl_obj is not None else None
+            if not self._peer_verifier(cert):
+                log.warning(
+                    "%s: rejecting connection — peer cert not in "
+                    "acceptable peers", self.name,
+                )
+                writer.close()
+                return
         streams: set[asyncio.Task] = set()
         try:
             while True:
@@ -194,11 +212,18 @@ class RpcClient:
     it by id. Connection failures surface as RpcConnectionError — the
     caller's FSM/backoff owns retry policy (ref KvStore.cpp:2134-2141)."""
 
-    def __init__(self, host: str, port: int, name: str = "", ssl=None):
+    def __init__(
+        self, host: str, port: int, name: str = "", ssl=None,
+        expected_peer: str = "",
+    ):
         self.host = host
         self.port = port
         self.name = name or f"{host}:{port}"
         self.ssl = ssl  # ssl.SSLContext for TLS clients
+        # node name the server's cert must claim (CN/SAN); empty = any
+        # CA-verified cert. Host certs identify nodes, not DNS names, so
+        # this replaces ssl's hostname check.
+        self.expected_peer = expected_peer
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._pending: dict[int, asyncio.Future] = {}
@@ -224,6 +249,29 @@ class RpcClient:
                 )
             except (OSError, asyncio.TimeoutError) as e:
                 raise RpcConnectionError(f"{self.name}: connect failed: {e}")
+            if self.expected_peer and self.ssl is None:
+                # fail closed: a pin without TLS would silently yield an
+                # unverified plaintext connection the caller believes is
+                # identity-checked
+                self._writer.close()
+                self._reader = self._writer = None
+                raise RpcConnectionError(
+                    f"{self.name}: expected_peer set but no TLS context — "
+                    "identity cannot be verified over plaintext"
+                )
+            if self.expected_peer and self.ssl is not None:
+                from openr_tpu.config import cert_peer_names
+
+                ssl_obj = self._writer.get_extra_info("ssl_object")
+                cert = ssl_obj.getpeercert() if ssl_obj is not None else None
+                if self.expected_peer not in cert_peer_names(cert):
+                    self._writer.close()
+                    self._reader = self._writer = None
+                    raise RpcConnectionError(
+                        f"{self.name}: server cert names "
+                        f"{sorted(cert_peer_names(cert))} do not include "
+                        f"expected peer {self.expected_peer!r}"
+                    )
             self._read_task = asyncio.get_running_loop().create_task(
                 self._read_loop(), name=f"rpc-client:{self.name}"
             )

@@ -38,7 +38,7 @@ def pki(tmp_path_factory):
     return d
 
 
-def secure_config(pki, mutual: bool) -> Config:
+def secure_config(pki, mutual: bool, acceptable_peers: str = "") -> Config:
     return Config(
         OpenrConfig(
             node_name="tls-node",
@@ -47,6 +47,7 @@ def secure_config(pki, mutual: bool) -> Config:
                 x509_cert_path=str(pki / "server.crt"),
                 x509_key_path=str(pki / "server.key"),
                 x509_ca_path=str(pki / "ca.crt") if mutual else "",
+                acceptable_peers=acceptable_peers,
             ),
         )
     )
@@ -98,5 +99,89 @@ async def test_mutual_tls_requires_client_cert(pki):
             assert version["node"] == "tls-node"
         finally:
             await authed.close()
+    finally:
+        await server.stop()
+
+
+@run_async
+async def test_acceptable_peers_enforces_client_identity(pki):
+    """CA membership alone must not be enough when acceptable_peers is
+    set (role of the reference's acceptable-peers list on its secure
+    thrift server)."""
+
+    def client_ctx():
+        return build_client_ssl_context(
+            ca_path=str(pki / "ca.crt"),
+            cert_path=str(pki / "client.crt"),
+            key_path=str(pki / "client.key"),
+        )
+
+    # our client cert has CN=client; a server allowing only "other-node"
+    # must reject it even though the CA signed it
+    server = CtrlServer(
+        "tls-node",
+        config=secure_config(pki, mutual=True, acceptable_peers="other-node"),
+    )
+    await server.start()
+    try:
+        denied = RpcClient(
+            "127.0.0.1", server.port, name="denied", ssl=client_ctx()
+        )
+        # the server drops the connection post-handshake, so the client
+        # sees a transport failure, not a TLS error
+        with pytest.raises((RpcConnectionError, ConnectionError, OSError)):
+            await denied.request("openr.version", timeout_s=2.0)
+        await denied.close()
+    finally:
+        await server.stop()
+
+    server = CtrlServer(
+        "tls-node",
+        config=secure_config(
+            pki, mutual=True, acceptable_peers="other-node, client"
+        ),
+    )
+    await server.start()
+    try:
+        allowed = RpcClient(
+            "127.0.0.1", server.port, name="allowed", ssl=client_ctx()
+        )
+        try:
+            version = await allowed.request("openr.version")
+            assert version["node"] == "tls-node"
+        finally:
+            await allowed.close()
+    finally:
+        await server.stop()
+
+
+@run_async
+async def test_client_pins_server_identity(pki):
+    """A client given expected_peer must reject a CA-valid server whose
+    cert claims a different node name (CN/SAN pinning — CA membership
+    alone would let any node impersonate any other)."""
+    server = CtrlServer("tls-node", config=secure_config(pki, mutual=False))
+    await server.start()
+    try:
+        # server cert has CN=server
+        pinned_wrong = RpcClient(
+            "127.0.0.1", server.port, name="pin-wrong",
+            ssl=build_client_ssl_context(ca_path=str(pki / "ca.crt")),
+            expected_peer="some-other-node",
+        )
+        with pytest.raises(RpcConnectionError, match="expected peer"):
+            await pinned_wrong.request("openr.version", timeout_s=2.0)
+        await pinned_wrong.close()
+
+        pinned_right = RpcClient(
+            "127.0.0.1", server.port, name="pin-right",
+            ssl=build_client_ssl_context(ca_path=str(pki / "ca.crt")),
+            expected_peer="server",
+        )
+        try:
+            version = await pinned_right.request("openr.version")
+            assert version["node"] == "tls-node"
+        finally:
+            await pinned_right.close()
     finally:
         await server.stop()
