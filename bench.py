@@ -140,21 +140,23 @@ def bench_config(name, gen, me, runs=5, flap_victims=0, cpu_baseline=True,
 
     # steady-state full recompute through real churn (changelog path)
     victims = list(range(1, (flap_victims or 1) + 1))
-    samples, mat, ex, sy = [], [], [], []
+    samples, phases = [], {}
     for i in range(runs):
         _flap(states, adj_dbs, victims, i, area)
         t0 = time.perf_counter()
         tpu.build_route_db(me, states, ps)
         samples.append((time.perf_counter() - t0) * 1e3)
-        tm = getattr(tpu, "last_timing", {})
-        sy.append(tm.get("sync_ms", 0))
-        ex.append(tm.get("exec_ms", 0))
-        mat.append(tm.get("mat_ms", 0))
+        for k, v in getattr(tpu, "last_timing", {}).items():
+            phases.setdefault(k, []).append(v)
     tpu_ms = statistics.median(samples)
     res["tpu_ms"] = round(tpu_ms, 1)
-    res["sync_ms"] = round(statistics.median(sy), 1)
-    res["exec_ms"] = round(statistics.median(ex), 1)
-    res["mat_ms"] = round(statistics.median(mat), 1)
+    for k in ("sync_ms", "exec_ms", "mat_ms"):
+        phases.setdefault(k, [])
+    for k, vals in phases.items():
+        # a phase absent from a run contributed 0 to it — backfill so
+        # medians aren't computed over only the runs where it fired
+        vals = vals + [0] * (runs - len(vals))
+        res[k] = round(statistics.median(vals), 1)
     res["changed_rows"] = tpu.last_device_stats.get("changed_rows")
     # device-only: chained dispatches, one blocking sync amortized —
     # what the chip does per solve, with the rig's fixed transfer RTT
@@ -183,8 +185,10 @@ def main() -> None:
     import numpy as np
 
     from openr_tpu.models import topologies
+    from openr_tpu.ops.xla_cache import enable_compilation_cache
 
-    log(f"devices: {jax.devices()}")
+    cache_dir = enable_compilation_cache()
+    log(f"devices: {jax.devices()}  xla-cache: {cache_dir}")
     # measure the rig's fixed device round trip (a pull of 8 bytes):
     # everything below pays it once per recompute
     x = jax.device_put(np.zeros(2, np.int32))

@@ -95,6 +95,10 @@ class DecisionConfig:
     # openr_tpu extension: compute rfc5286 loop-free-alternate backup
     # next hops for SP_ECMP/IP prefixes (RibUnicastEntry.lfa_nexthops)
     enable_lfa: bool = False
+    # persistent XLA compilation cache directory so daemon restarts skip
+    # recompilation (ops/xla_cache.py). "" = default resolution
+    # ($OPENR_TPU_XLA_CACHE, then ~/.cache/openr_tpu/xla); "off" disables.
+    xla_cache_dir: str = ""
     # capacity classes for static-shape padding (ops/csr.py)
     max_nodes_hint: int = 0  # 0 = grow on demand
 

@@ -98,6 +98,7 @@ def make_solver(
     CPU oracle (a device launch + result pull has a fixed cost that
     dwarfs small solves)."""
     if backend == "cpu":
+        kwargs.pop("xla_cache_dir", None)
         return SpfSolver(node_name, **kwargs)
     if backend in ("tpu", "auto"):
         try:
@@ -110,6 +111,8 @@ def make_solver(
             if backend == "tpu":
                 raise
             log.warning("tpu solver unavailable; falling back to cpu")
+            kwargs.pop("xla_cache_dir", None)
+            kwargs.pop("small_graph_nodes", None)
             return SpfSolver(node_name, **kwargs)
     raise ValueError(f"unknown solver backend {backend!r}")
 
@@ -144,6 +147,10 @@ class Decision(Actor):
         skw = dict(solver_kwargs or {})
         if config.enable_lfa:
             skw.setdefault("enable_lfa", True)
+        if backend != "cpu":
+            # "" -> default resolution (env var, then ~/.cache); "off"
+            # disables (ops/xla_cache.py)
+            skw.setdefault("xla_cache_dir", config.xla_cache_dir or None)
         self.solver = make_solver(
             node_name,
             backend,

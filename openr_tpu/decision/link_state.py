@@ -282,6 +282,104 @@ class NodeSpfResult:
 # SpfResult: destination node name -> NodeSpfResult
 SpfResult = dict
 
+
+class _LazySpfNode:
+    """Node view of a LazySpfResult: metric answers from the device
+    field; structural fields (next_hops/path_links) force the real host
+    Dijkstra once and delegate."""
+
+    __slots__ = ("_owner", "_name")
+
+    def __init__(self, owner: "LazySpfResult", name: str):
+        self._owner = owner
+        self._name = name
+
+    @property
+    def metric(self) -> int:
+        return self._owner._metric(self._name)
+
+    @property
+    def next_hops(self):
+        return self._owner._force()[self._name].next_hops
+
+    @property
+    def path_links(self):
+        return self._owner._force()[self._name].path_links
+
+
+class LazySpfResult:
+    """SpfResult backed by a device-computed distance field.
+
+    The TPU KSP2 path needs get_spf_result(root) only for membership
+    (reachability filter, SpfSolver.cpp:230-244) and metrics (k-path
+    traces) — both pure functions of distance values the device already
+    computed. This satisfies those from the field with zero host
+    Dijkstras, while any consumer needing SPF *structure* (ECMP
+    next_hops, path_links, iteration) transparently forces the real
+    run_spf and the memo entry replaces itself — correctness never
+    depends on who asks."""
+
+    def __init__(self, link_state: "LinkState", root: str,
+                 use_link_metric: bool, metric_of):
+        self._ls = link_state
+        self._root = root
+        self._use_link_metric = use_link_metric
+        self._metric_of = metric_of  # name -> int | None (unreachable)
+        self._real: Optional[SpfResult] = None
+
+    def _metric(self, name: str) -> int:
+        if self._real is not None:
+            return self._real[name].metric
+        m = self._metric_of(name)
+        if m is None:
+            raise KeyError(name)
+        return m
+
+    def _force(self) -> SpfResult:
+        if self._real is None:
+            self._real = self._ls.run_spf(self._root, self._use_link_metric)
+            # replace the memo so later callers skip the lazy wrapper
+            self._ls._spf_results[(self._root, self._use_link_metric)] = (
+                self._real
+            )
+        return self._real
+
+    # -- dict-protocol surface used by SpfSolver/LinkState ----------------
+
+    def __contains__(self, name: str) -> bool:
+        if self._real is not None:
+            return name in self._real
+        return self._metric_of(name) is not None
+
+    def get(self, name: str, default=None):
+        if self._real is not None:
+            return self._real.get(name, default)
+        if self._metric_of(name) is None:
+            return default
+        return _LazySpfNode(self, name)
+
+    def __getitem__(self, name: str):
+        node = self.get(name)
+        if node is None:
+            raise KeyError(name)
+        return node
+
+    # structural iteration: force
+    def __iter__(self):
+        return iter(self._force())
+
+    def __len__(self):
+        return len(self._force())
+
+    def keys(self):
+        return self._force().keys()
+
+    def values(self):
+        return self._force().values()
+
+    def items(self):
+        return self._force().items()
+
 # Path: list of Links from src to dst
 Path = list
 
@@ -584,6 +682,19 @@ class LinkState:
             res = self.run_spf(root, use_link_metric)
             self._spf_results[key] = res
         return res
+
+    def prime_spf_metrics(
+        self, root: str, metric_of, use_link_metric: bool = True
+    ) -> None:
+        """Install a device-field-backed lazy result into the SPF memo
+        (TPU solver: the unmasked KSP2 base field). No-op when a result
+        — real or lazy — is already memoized; cleared with the memo on
+        any topology change."""
+        key = (root, use_link_metric)
+        if key not in self._spf_results:
+            self._spf_results[key] = LazySpfResult(
+                self, root, use_link_metric, metric_of
+            )
 
     def run_spf(
         self,

@@ -610,8 +610,14 @@ class TpuSpfSolver:
     on device. Differentially tested against the CPU oracle."""
 
     def __init__(
-        self, my_node_name: str, small_graph_nodes: int = 0, **solver_kwargs
+        self, my_node_name: str, small_graph_nodes: int = 0,
+        xla_cache_dir: str | None = None, **solver_kwargs
     ):
+        # a restarting daemon must not pay the ~80s 100k-node compile
+        # again — load executables from the persistent cache
+        from openr_tpu.ops.xla_cache import enable_compilation_cache
+
+        enable_compilation_cache(xla_cache_dir)
         self.my_node_name = my_node_name
         # graphs below this node count solve entirely on the CPU oracle:
         # the fixed device dispatch + result-pull round trip exceeds the
@@ -625,6 +631,16 @@ class TpuSpfSolver:
         self.last_device_stats: dict = {}
         # wall-time breakdown of the last fast-path solve (bench.py)
         self.last_timing: dict = {}
+        self._ksp2_timing: dict = {}
+        # (area, vantage) -> (generation, plan, device base field, np
+        # base field): the unmasked KSP2 base, reused across solves at
+        # the same topology generation
+        self._ksp2_base: dict[tuple, tuple] = {}
+        # (area, vantage) -> resident masked-row state (ops/ksp2.py)
+        self._ksp2_rows: dict[tuple, object] = {}
+        # (area, vantage) -> trace-reuse certificates: per-dest read
+        # sets + paths from the last prime (see _prime_ksp2)
+        self._ksp2_certs: dict[tuple, dict] = {}
         # unrolled while_loop trips of the last device SSSP — a measured
         # diameter bound the sharded fabric path reuses
         self.last_trips: int = 0
@@ -1136,7 +1152,9 @@ class TpuSpfSolver:
                 "sync_ms": (t1 - t0) * 1e3,
                 "exec_ms": (t2 - t1) * 1e3,
                 "mat_ms": (t3 - t2) * 1e3,
+                **self._ksp2_timing,
             }
+            self._ksp2_timing = {}
 
         return finish
 
@@ -1145,23 +1163,35 @@ class TpuSpfSolver:
     def _prime_ksp2(
         self, my_node_name, area, link_state, prefix_state, prefixes, fast
     ) -> None:
-        """Batch the k=2 masked SSSPs for every KSP2 destination in one
-        device pass and prime LinkState's k-paths cache, so the oracle's
-        unchanged KSP2 assembly (selection, canonical trace, label
-        stacks — spf_solver._select_best_paths_ksp2) consumes device
-        distance fields instead of one host Dijkstra per destination.
-        Parity is structural: the masked fields equal run_spf's metrics
-        (SSSP has unique values), and the canonical trace depends only on
+        """Prime LinkState's SPF + k-paths caches from device distance
+        fields so the oracle's unchanged KSP2 assembly (selection,
+        canonical trace, label stacks — spf_solver._select_best_paths_ksp2)
+        runs with ZERO host Dijkstras:
+
+          1. The unmasked base field (ops/ksp2.base_dist) is pulled once
+             per topology generation; it backs a LazySpfResult (the
+             reachability filter + k=1 trace metric source) — replacing
+             the 50k-node host Dijkstra that dominated steady-state KSP2.
+          2. The per-destination masked second-pass fields batch on
+             device and ship as sparse deltas against the base
+             (masked_sssp_delta_batch): a masked row deviates only where
+             every shortest path used a removed first-path edge.
+
+        Parity is structural: the fields equal run_spf's metrics (SSSP
+        has unique values), and the canonical trace depends only on
         those values. Ref hot loop replaced:
         openr/decision/LinkState.cpp:790-819."""
+        import time as _time
+
         from openr_tpu.ops.edgeplan import _ensure_edge_loc
-        from openr_tpu.ops.ksp2 import masked_sssp_batch
+        from openr_tpu.ops.ksp2 import (
+            MaskedRowsState,
+            base_dist,
+            masked_rows_dispatch,
+            masked_rows_update,
+        )
 
         import jax
-
-        ad = self._sync_area(area, link_state, prefix_state, fast)
-        plan = ad.plan
-        edge_loc = _ensure_edge_loc(plan)
 
         dests = sorted({
             node
@@ -1171,27 +1201,21 @@ class TpuSpfSolver:
             and node != my_node_name
             and link_state.has_node(node)
         })
-        jobs = []  # (dest, ignore_set, mask_locs)
-        for dest in dests:
-            if (my_node_name, dest, 2) in link_state._kth_paths:
-                continue
-            # k=1 from the shared memoized SPF (one host Dijkstra total,
-            # which the oracle's reachability filter needs anyway)
-            first = link_state.get_kth_paths(my_node_name, dest, 1)
-            if not first:
-                link_state.prime_kth_paths(my_node_name, dest, 2, [])
-                continue
-            ignore = link_state.kth_paths_ignore_set(my_node_name, dest, 2)
-            locs = []
-            for link in ignore:
-                locs.append(edge_loc[(link, link.n1)])
-                locs.append(edge_loc[(link, link.n2)])
-            jobs.append((dest, ignore, locs))
-        if not jobs:
-            return
+        if all(
+            (my_node_name, d, 2) in link_state._kth_paths for d in dests
+        ) and (my_node_name, True) in link_state._spf_results:
+            return  # warm: nothing to prime, skip all device work
+
+        _t0 = _time.perf_counter()
+        ad = self._sync_area(area, link_state, prefix_state, fast)
+        plan = ad.plan
+        edge_loc = _ensure_edge_loc(plan)
+        root_idx = plan.node_index[my_node_name]
+        node_index = plan.node_index
 
         d_shift_w, d_res_w = ad.d_shift_w, ad.d_res_w
-        if link_state.is_node_overloaded(my_node_name):
+        root_overloaded = link_state.is_node_overloaded(my_node_name)
+        if root_overloaded:
             # run_spf exempts the root from its own transit drain; the
             # mirror folded the drain into the root's out-edge weights,
             # so restore them for this (rare) case
@@ -1209,26 +1233,193 @@ class TpuSpfSolver:
             d_shift_w = jax.device_put(sw)
             d_res_w = jax.device_put(rw)
 
-        dist = masked_sssp_batch(
-            plan, d_shift_w, ad.d_res_rows, ad.d_res_nbr, d_res_w,
-            ad.d_deltas, plan.node_index[my_node_name],
-            [locs for _, _, locs in jobs],
-        )
-        node_index = plan.node_index
-        for i, (dest, ignore, _locs) in enumerate(jobs):
-            row = dist[i]
-
-            def dist_of(n, _row=row, _idx=node_index):
-                j = _idx.get(n)
-                if j is None:
-                    return None
-                v = int(_row[j])
-                return None if v >= INF_E else v
-
-            paths2 = link_state.trace_paths_on_dist(
-                my_node_name, dest, dist_of, ignore
+        # base (k=1) field: one device SSSP + one [n_cap] pull per
+        # (vantage, topology generation). The masked batch dispatches
+        # SPECULATIVELY (previous masks) right behind it, so its compute
+        # and transfer overlap the base pull + the host trace work.
+        bkey = (area, my_node_name)
+        gen = link_state.generation
+        cached = None if root_overloaded else self._ksp2_base.get(bkey)
+        rstate = self._ksp2_rows.get(bkey)
+        if rstate is None:
+            rstate = self._ksp2_rows[bkey] = MaskedRowsState()
+        if cached is not None and cached[0] == gen and cached[1] is plan:
+            d_base, base_np = cached[2], cached[3]
+            spec = None  # same generation: rows already current
+        else:
+            d_base = base_dist(
+                plan, d_shift_w, ad.d_res_rows, ad.d_res_nbr, d_res_w,
+                ad.d_deltas, root_idx,
             )
+            d_base.copy_to_host_async()
+            spec = masked_rows_dispatch(
+                rstate, plan, d_shift_w, ad.d_res_rows, ad.d_res_nbr,
+                d_res_w, ad.d_deltas, root_idx,
+            )
+            base_np = np.asarray(d_base)
+            if not root_overloaded:
+                self._ksp2_base[bkey] = (gen, plan, d_base, base_np)
+        _t1 = _time.perf_counter()
+
+        def metric_of(n, _idx=node_index, _base=base_np):
+            j = _idx.get(n)
+            if j is None:
+                return None
+            v = int(_base[j])
+            return None if v >= INF_E else v
+
+        link_state.prime_spf_metrics(my_node_name, metric_of)
+
+        # -- trace-reuse certificates ---------------------------------------
+        # A canonical trace is a pure function of (the dist values it
+        # read, the link attributes at the nodes it visited). Remember
+        # each dest's read-set; if since the last prime (a) only "links"
+        # changelog events occurred, (b) no flapped link endpoint and no
+        # base-field change touches the read-set, and (c) for k=2 the
+        # masked row is value-identical (device-verified), the previous
+        # paths are re-primed without re-tracing. One victim flap then
+        # re-traces only the destinations it actually affects.
+        ck = (area, my_node_name)
+        certs = None if root_overloaded else self._ksp2_certs.get(ck)
+        reusable = certs is not None and certs["plan"] is plan
+        flap_dirty: set = set()
+        dirty: set = set()
+        if reusable:
+            events = link_state.events_since(certs["gen"])
+            reusable = events is not None and all(
+                ev[0] == "links" for ev in events
+            )
+            if reusable:
+                for _kind, links in events:
+                    for lk in links:
+                        flap_dirty.add(lk.n1)
+                        flap_dirty.add(lk.n2)
+                dirty = set(flap_dirty)
+                prev_base = certs["base_np"]
+                if prev_base is not base_np:
+                    names = plan.node_names
+                    for j in np.nonzero(base_np != prev_base)[0]:
+                        if j < len(names):
+                            dirty.add(names[j])
+        cert_dests = certs["dests"] if reusable else {}
+
+        new_dests: dict = {}
+        jobs = []  # (dest, ignore_set, mask_locs, cert, reads1, paths1)
+        for dest in dests:
+            if (my_node_name, dest, 2) in link_state._kth_paths:
+                continue
+            c = cert_dests.get(dest)
+            reads1 = None
+            paths1 = link_state._kth_paths.get((my_node_name, dest, 1))
+            if paths1 is None:
+                if (
+                    c is not None
+                    and c["reads1"] is not None
+                    and not (c["reads1"] & dirty)
+                ):
+                    paths1, reads1 = c["paths1"], c["reads1"]
+                else:
+                    reads1 = set()
+
+                    def rd1(n, _r=reads1, _m=metric_of):
+                        _r.add(n)
+                        return _m(n)
+
+                    paths1 = link_state.trace_paths_on_dist(
+                        my_node_name, dest, rd1, set()
+                    )
+                link_state.prime_kth_paths(my_node_name, dest, 1, paths1)
+            if not paths1:
+                link_state.prime_kth_paths(my_node_name, dest, 2, [])
+                new_dests[dest] = {
+                    "reads1": reads1, "paths1": paths1,
+                    "locs": None, "reads2": set(), "paths2": [],
+                }
+                continue
+            ignore = link_state.kth_paths_ignore_set(my_node_name, dest, 2)
+            locs = []
+            for link in ignore:
+                locs.append(edge_loc[(link, link.n1)])
+                locs.append(edge_loc[(link, link.n2)])
+            jobs.append((dest, ignore, locs, c, reads1, paths1))
+        _t2 = _time.perf_counter()
+        if not jobs:
+            if not root_overloaded:
+                self._ksp2_certs[ck] = {
+                    "gen": link_state.generation, "plan": plan,
+                    "base_np": base_np, "dests": new_dests,
+                }
+            self._ksp2_timing = {
+                "ksp2_base_ms": (_t1 - _t0) * 1e3,
+                "ksp2_k1_ms": (_t2 - _t1) * 1e3,
+            }
+            return
+
+        changed = masked_rows_update(
+            rstate, plan, d_shift_w, ad.d_res_rows, ad.d_res_nbr, d_res_w,
+            ad.d_deltas, root_idx,
+            tuple(j[0] for j in jobs), [j[2] for j in jobs],
+            spec=spec,
+        )
+        _t3 = _time.perf_counter()
+        node_names = plan.node_names
+        reused_traces = 0
+        for i, (dest, ignore, locs, c, reads1, paths1) in enumerate(jobs):
+            ch = changed[i]
+            reuse = (
+                c is not None
+                and ch is not True
+                and c["locs"] == locs
+                and not (c["reads2"] & flap_dirty)
+            )
+            if reuse and ch is not None:
+                # the row changed, but maybe nowhere this trace looked
+                reuse = not any(
+                    node_names[j] in c["reads2"]
+                    for j in ch.tolist()
+                    if j < len(node_names)
+                )
+            if reuse:
+                paths2, reads2 = c["paths2"], c["reads2"]
+                reused_traces += 1
+            else:
+                reads2 = set()
+                row = rstate.host_rows[i]
+
+                def dist_of(n, _r=reads2, _row=row, _idx=node_index):
+                    _r.add(n)
+                    j = _idx.get(n)
+                    if j is None:
+                        return None
+                    v = int(_row[j])
+                    return None if v >= INF_E else v
+
+                paths2 = link_state.trace_paths_on_dist(
+                    my_node_name, dest, dist_of, ignore
+                )
             link_state.prime_kth_paths(my_node_name, dest, 2, paths2)
+            new_dests[dest] = {
+                "reads1": reads1 if reads1 is not None else (
+                    c["reads1"] if c else None
+                ),
+                "paths1": paths1, "locs": locs,
+                "reads2": reads2, "paths2": paths2,
+            }
+        if not root_overloaded:
+            self._ksp2_certs[ck] = {
+                "gen": link_state.generation, "plan": plan,
+                "base_np": base_np, "dests": new_dests,
+            }
+        from openr_tpu.ops import ksp2 as _ksp2_ops
+
+        self._ksp2_timing = dict(
+            ksp2_base_ms=(_t1 - _t0) * 1e3,
+            ksp2_k1_ms=(_t2 - _t1) * 1e3,
+            ksp2_batch_ms=(_t3 - _t2) * 1e3,
+            ksp2_trace_ms=(_time.perf_counter() - _t3) * 1e3,
+            ksp2_reused_traces=reused_traces,
+            **{f"ksp2_{k}": v for k, v in _ksp2_ops.last_stats.items()},
+        )
 
     def device_compute_ms(self, iters: int = 8) -> Optional[float]:
         """Amortized device-only time per full pipeline execution: chain

@@ -91,6 +91,10 @@ class SelfOriginatedValue:
 
     value: Value
     persisted: bool = False  # re-advertise-to-win + periodic ttl refresh
+    # monotonic stamp of the last (re-)advertisement; the imminent-TTL
+    # alarm fires when an owned finite-ttl key goes unrefreshed past
+    # 3/4 of its ttl (ref KvStore.h:553-564 checkKeyTtl fiber)
+    last_refresh: float = 0.0
 
 
 class KvStoreArea:
@@ -182,6 +186,7 @@ class KvStore(Actor):
         self.add_task(self._sync_loop(), name=f"{self.name}.sync")
         self.add_task(self._ttl_loop(), name=f"{self.name}.ttl")
         self.add_task(self._ttl_refresh_loop(), name=f"{self.name}.ttl-refresh")
+        self.add_task(self._ttl_alarm_loop(), name=f"{self.name}.ttl-alarm")
         if self.cfg.sync_interval_s > 0:
             self.add_task(
                 self._anti_entropy_loop(), name=f"{self.name}.anti-entropy"
@@ -735,7 +740,9 @@ class KvStore(Actor):
             ttl_ms=ttl_ms,
             ttl_version=0,
         )
-        st.self_originated[key] = SelfOriginatedValue(new_val, persisted=True)
+        st.self_originated[key] = SelfOriginatedValue(
+            new_val, persisted=True, last_refresh=time.monotonic()
+        )
         if ttl_ms != TTL_INFINITY:
             self._refresh_wakeup.set()
         self._merge_and_flood(
@@ -762,7 +769,9 @@ class KvStore(Actor):
             ttl_ms=ttl_ms,
             ttl_version=0,
         )
-        st.self_originated[key] = SelfOriginatedValue(new_val, persisted=False)
+        st.self_originated[key] = SelfOriginatedValue(
+            new_val, persisted=False, last_refresh=time.monotonic()
+        )
         if ttl_ms != TTL_INFINITY:
             self._refresh_wakeup.set()
         self._merge_and_flood(
@@ -819,6 +828,7 @@ class KvStore(Actor):
                     if live is None or live.originator_id != self.node_name:
                         continue  # lost ownership; persist path defends
                     own.value.ttl_version = live.ttl_version + 1
+                    own.last_refresh = time.monotonic()
                     refresh[key] = Value(
                         version=live.version,
                         originator_id=self.node_name,
@@ -831,6 +841,52 @@ class KvStore(Actor):
                     self._merge_and_flood(
                         Publication(key_vals=refresh, area=st.area)
                     )
+
+    async def _ttl_alarm_loop(self) -> None:
+        """Imminent-TTL alarm (ref KvStore.h:553-564): an owned
+        finite-ttl adjacency key that has gone unrefreshed past 3/4 of
+        its ttl is about to age out network-wide — the refresh pipeline
+        is wedged or ownership was silently lost. Warn + count; the
+        counter (kvstore.<node>.imminent_ttl_expiry) surfaces through
+        Monitor/ctrl."""
+        while True:
+            finite = [
+                own.value.ttl_ms
+                for st in self.areas.values()
+                for own in st.self_originated.values()
+                if own.value.ttl_ms != TTL_INFINITY
+            ]
+            interval = max(0.05, (min(finite) if finite else
+                                  self.cfg.key_ttl_ms) / 1e3 / 4)
+            await asyncio.sleep(interval)
+            self._check_imminent_ttls()
+
+    def _check_imminent_ttls(self, now: Optional[float] = None) -> int:
+        from openr_tpu.types import ADJ_DB_MARKER
+
+        now = time.monotonic() if now is None else now
+        flagged = 0
+        for st in self.areas.values():
+            for key, own in st.self_originated.items():
+                if (
+                    own.value.ttl_ms == TTL_INFINITY
+                    or not key.startswith(ADJ_DB_MARKER)
+                    or not own.last_refresh
+                ):
+                    continue
+                stale_s = now - own.last_refresh
+                if stale_s > own.value.ttl_ms / 1e3 * 0.75:
+                    flagged += 1
+                    counters.increment(
+                        f"kvstore.{self.node_name}.imminent_ttl_expiry"
+                    )
+                    log.warning(
+                        "%s: adj key %s unrefreshed for %.1fs "
+                        "(ttl %.1fs) — imminent expiry",
+                        self.name, key, stale_s,
+                        own.value.ttl_ms / 1e3,
+                    )
+        return flagged
 
     # -- TTL expiry --------------------------------------------------------
 

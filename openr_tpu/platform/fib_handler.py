@@ -234,7 +234,10 @@ class NetlinkDataplane:
                 have.add(r.prefix)
         failed = await self.add_unicast(routes)
         stale = have - set(routes)
-        await self.delete_unicast(sorted(stale))
+        # a stale route that fails to delete leaves the kernel out of
+        # sync — surface it with the add failures so the Fib actor
+        # retries instead of trusting a clean table
+        failed += await self.delete_unicast(sorted(stale))
         return failed
 
     async def add_mpls(self, routes: dict[int, dict]) -> list[int]:

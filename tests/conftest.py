@@ -15,6 +15,9 @@ import os
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 ).strip()
+# hermetic tests: never load persistent-cache AOT artifacts compiled for
+# a different backend/machine-feature set (ops/xla_cache.py)
+os.environ["OPENR_TPU_XLA_CACHE"] = "off"
 try:
     import jax
 

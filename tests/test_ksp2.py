@@ -166,6 +166,105 @@ def test_ksp2_churn_reprimes_cache():
     )
 
 
+def test_ksp2_build_needs_zero_host_dijkstras():
+    """Steady-state KSP2 on device must not run ANY host Dijkstra — the
+    k=1 field comes from the device base SSSP (lazy SpfResult), the
+    second pass from the masked batch."""
+    adj_dbs, prefix_dbs = topologies.grid(4, forwarding_algorithm=KSP2)
+    states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    ls = states["0"]
+    calls = {"spf": 0}
+    orig = ls.run_spf
+
+    def counting_run_spf(root, use_link_metric=True, links_to_ignore=()):
+        calls["spf"] += 1
+        return orig(root, use_link_metric, links_to_ignore)
+
+    ls.run_spf = counting_run_spf
+    tpu_db = TpuSpfSolver("node-0-0").build_route_db("node-0-0", states, ps)
+    assert calls["spf"] == 0, "KSP2 build fell back to a host Dijkstra"
+    assert len(tpu_db.unicast_routes) == 15
+
+
+def test_lazy_spf_result_forces_real_dijkstra_on_structure():
+    """LazySpfResult answers metrics from the primed field; structural
+    access (next_hops) transparently forces run_spf and the forced
+    result replaces the memo entry."""
+    adj_dbs, _ = topologies.grid(3)
+    states, _ = topologies.build_states(adj_dbs, [])
+    ls: LinkState = states["0"]
+    real = ls.run_spf("node-0-0")
+    ls._spf_results.clear()
+
+    metrics = {n: r.metric for n, r in real.items()}
+    ls.prime_spf_metrics("node-0-0", lambda n: metrics.get(n))
+    lazy = ls.get_spf_result("node-0-0")
+    # metric + membership answered lazily
+    assert lazy["node-2-2"].metric == real["node-2-2"].metric
+    assert "node-2-2" in lazy and "ghost" not in lazy
+    assert lazy.get("ghost") is None
+    # structural access forces the real result and replaces the memo
+    assert lazy["node-2-2"].next_hops == real["node-2-2"].next_hops
+    forced = ls.get_spf_result("node-0-0")
+    assert not isinstance(forced, type(lazy))
+    assert {n: r.metric for n, r in forced.items()} == metrics
+
+
+def test_ksp2_delta_overflow_falls_back_to_full_rows(monkeypatch):
+    """A masked row deviating in more nodes than the delta budget must
+    ship as a full row — same RIB either way."""
+    from openr_tpu.ops import ksp2 as ksp2_ops
+
+    monkeypatch.setattr(ksp2_ops, "_DELTA_K", 1)
+    run_both_fresh(
+        "node-0-0", lambda: topologies.grid(4, forwarding_algorithm=KSP2)
+    )
+
+
+def test_ksp2_multi_round_churn_stays_parity_exact():
+    """Several churn rounds through the trace-reuse certificates and
+    the prev-generation delta rows: every round must match a fresh
+    oracle, including rounds that move the k=1 paths themselves."""
+    mk = lambda: topologies.wan(  # noqa: E731
+        regions=2, region_side=4, ksp2_every=5
+    )
+    me = "r00-n00-00"
+    cpu_states, cpu_ps = fresh(mk)
+    tpu_states, tpu_ps = fresh(mk)
+    cpu = SpfSolver(me)
+    tpu = TpuSpfSolver(me)
+    assert_rib_equal(
+        cpu.build_route_db(me, cpu_states, cpu_ps),
+        tpu.build_route_db(me, tpu_states, tpu_ps),
+        "round 0",
+    )
+    adj_dbs, _ = mk()
+    by_name = {d.this_node_name: d for d in adj_dbs}
+    # victims chosen to hit both in-region links (near the vantage) and
+    # far-region links; metrics swing hard so first paths actually move
+    victims = ["r00-n00-01", "r01-n02-02", "r00-n01-01"]
+    for rnd, victim in enumerate(victims * 2):
+        db = by_name[victim]
+        metric = [1, 90, 3, 40, 7, 1][rnd]
+        for states in (cpu_states, tpu_states):
+            states["0"].update_adjacency_database(
+                AdjacencyDatabase(
+                    this_node_name=victim,
+                    adjacencies=tuple(
+                        Adjacency(**{**a.__dict__, "metric": metric})
+                        for a in db.adjacencies
+                    ),
+                    node_label=db.node_label,
+                    area="0",
+                )
+            )
+        assert_rib_equal(
+            cpu.build_route_db(me, cpu_states, cpu_ps),
+            tpu.build_route_db(me, tpu_states, tpu_ps),
+            f"round {rnd + 1} (victim {victim}, metric {metric})",
+        )
+
+
 def test_canonical_trace_is_deterministic():
     """trace_paths_on_dist depends only on distance values: tracing the
     same dest twice over independent LinkState builds yields identical

@@ -504,3 +504,53 @@ class TestSelfOriginated:
             ) == InitializationEvent.KVSTORE_SYNCED
         finally:
             await _stop_stores([a, b])
+
+
+class TestImminentTtlAlarm:
+    """ref KvStore.h:553-564 — warn when an owned finite-ttl adj key
+    nears expiry without a refresh."""
+
+    @run_async
+    async def test_unrefreshed_adj_key_raises_alarm(self):
+        import time as _time
+
+        from openr_tpu.runtime.counters import counters
+
+        (a,) = await _start_stores(1)
+        try:
+            a.persist_key("adj:store0", b"adjdb", ttl_ms=10_000)
+            a.persist_key("prefix:store0", b"p", ttl_ms=10_000)
+            await wait_until(
+                lambda: a.get_key("adj:store0") is not None
+                and a.get_key("prefix:store0") is not None
+            )
+            st = a.store
+            # fresh: no alarm
+            assert st._check_imminent_ttls() == 0
+            # simulate a wedged refresh pipeline: pretend the last
+            # advertisement happened 9s ago on a 10s ttl (> 3/4)
+            for area in st.areas.values():
+                for own in area.self_originated.values():
+                    own.last_refresh = _time.monotonic() - 9.0
+            before = counters.get_counters().get(
+                "kvstore.store0.imminent_ttl_expiry", 0
+            )
+            # only the adj: key alarms, not prefix:
+            assert st._check_imminent_ttls() == 1
+            after = counters.get_counters()["kvstore.store0.imminent_ttl_expiry"]
+            assert after == before + 1
+        finally:
+            await _stop_stores([a])
+
+    @run_async
+    async def test_healthy_refresh_keeps_alarm_quiet(self):
+        from openr_tpu.config import KvstoreConfig
+
+        cfg = KvstoreConfig(key_ttl_ms=300)
+        (a,) = await _start_stores(1, config=cfg)
+        try:
+            a.persist_key("adj:store0", b"adjdb")  # refreshed every ~75ms
+            await asyncio.sleep(0.6)  # two ttl lifetimes of refreshes
+            assert a.store._check_imminent_ttls() == 0
+        finally:
+            await _stop_stores([a])
