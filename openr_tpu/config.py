@@ -110,6 +110,16 @@ class LinkMonitorConfig:
     linkflap_initial_backoff_ms: int = 60_000
     linkflap_max_backoff_ms: int = 300_000
     use_rtt_metric: bool = True
+    # kernel interface discovery over rtnetlink events
+    # (platform/iface_monitor.py) instead of static --interface flags;
+    # selection via the reference's regex config
+    # (ref LinkMonitorConfig include_interface_regexes:196)
+    enable_netlink_interfaces: bool = False
+    include_interface_regexes: list[str] = field(default_factory=list)
+    exclude_interface_regexes: list[str] = field(default_factory=list)
+    # interfaces whose addresses redistribute as LOOPBACK prefixes;
+    # empty = all tracked interfaces (emulation-friendly default)
+    redistribute_interface_regexes: list[str] = field(default_factory=list)
 
 
 @dataclass

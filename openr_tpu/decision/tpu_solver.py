@@ -641,6 +641,10 @@ class TpuSpfSolver:
         # (area, vantage) -> trace-reuse certificates: per-dest read
         # sets + paths from the last prime (see _prime_ksp2)
         self._ksp2_certs: dict[tuple, dict] = {}
+        # LRU over the per-vantage KSP2 state above: each entry pins
+        # ~2x b_cap x n_cap int32 (device rows + host mirror), so the
+        # multi-vantage fabric path must evict, not accumulate
+        self._ksp2_lru: list[tuple] = []
         # unrolled while_loop trips of the last device SSSP — a measured
         # diameter bound the sharded fabric path reuses
         self.last_trips: int = 0
@@ -678,6 +682,18 @@ class TpuSpfSolver:
     # -- vantage cache management ------------------------------------------
 
     _MAX_FOREIGN_VANTAGES = 4
+    _MAX_KSP2_STATES = 4
+
+    def _touch_ksp2_state(self, bkey: tuple) -> None:
+        lru = self._ksp2_lru
+        if bkey in lru:
+            lru.remove(bkey)
+        lru.append(bkey)
+        while len(lru) > self._MAX_KSP2_STATES:
+            old = lru.pop(0)
+            self._ksp2_rows.pop(old, None)
+            self._ksp2_base.pop(old, None)
+            self._ksp2_certs.pop(old, None)
 
     def _touch_foreign_vantage(self, vkey: tuple) -> None:
         lru = self._vantage_lru
@@ -712,6 +728,9 @@ class TpuSpfSolver:
             prefix_state, area_link_states
         )
 
+        # a KSP2 prime with no subsequent fast-path finish must not leak
+        # its timing into a later solve's breakdown
+        self._ksp2_timing = {}
         route_db = DecisionRouteDb()
         finishes = []
         # per-area device dispatch: a prefix announced in exactly one
@@ -985,9 +1004,30 @@ class TpuSpfSolver:
         # announcer matrix: keyed on prefix churn + node-index stability
         mkey = (prefix_state.generation, plan.index_version)
         if ad.matrix_key != mkey or ad.matrix is None:
-            ad.matrix = build_prefix_matrix(
-                prefix_state, plan.node_index, area, prefixes
+            # packed matrices are pure derivations — memoized on the
+            # PrefixState so a fresh solver over live state (restart-in-
+            # process, any-vantage, sharded fabric) skips the ~1s
+            # 100k-prefix packing loop
+            cache = getattr(prefix_state, "_matrix_memo", None)
+            if cache is None:
+                cache = prefix_state._matrix_memo = {}
+            # link_state.generation pins the node-index mapping (the
+            # mirror_source memo rebuilds it only on a new generation)
+            ckey = (
+                prefix_state.generation, area, link_state.generation,
             )
+            hit = cache.get(area)
+            if (
+                hit is not None
+                and hit[0] == ckey
+                and hit[1] == prefixes
+            ):
+                ad.matrix = hit[2]
+            else:
+                ad.matrix = build_prefix_matrix(
+                    prefix_state, plan.node_index, area, prefixes
+                )
+                cache[area] = (ckey, prefixes, ad.matrix)
             ad.matrix_key = mkey
             ad.matrix_version += 1
             ad.flags = None  # force re-pack
@@ -1238,6 +1278,7 @@ class TpuSpfSolver:
         # SPECULATIVELY (previous masks) right behind it, so its compute
         # and transfer overlap the base pull + the host trace work.
         bkey = (area, my_node_name)
+        self._touch_ksp2_state(bkey)
         gen = link_state.generation
         cached = None if root_overloaded else self._ksp2_base.get(bkey)
         rstate = self._ksp2_rows.get(bkey)

@@ -399,6 +399,12 @@ class Fib(Actor):
             for l in del_labels:
                 rs.dirty_labels.pop(l, None)
                 programmed.mpls_routes_to_delete.append(l)
+        except FibUpdateError as e:
+            ok = False
+            for l in del_labels:
+                if l not in e.failed_labels:
+                    rs.dirty_labels.pop(l, None)
+                    programmed.mpls_routes_to_delete.append(l)
         except Exception as e:
             log.warning("%s: delete_mpls failed: %s", self.name, e)
             ok = False

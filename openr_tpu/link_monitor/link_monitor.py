@@ -26,6 +26,7 @@ Role of the reference's openr/link-monitor/LinkMonitor.{h,cpp}:
 from __future__ import annotations
 
 import logging
+import re
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -140,6 +141,15 @@ class LinkMonitor(Actor):
         self.interfaces: dict[str, _InterfaceState] = {}
         self._advertise_throttle: Optional[AsyncThrottle] = None
         self._advertise_throttle_s = advertise_throttle_s
+        self._redistribute_rx = [
+            re.compile(r)
+            for r in getattr(config, "redistribute_interface_regexes", [])
+        ]
+
+    def _redistributes(self, if_name: str) -> bool:
+        if not self._redistribute_rx:
+            return True
+        return any(rx.fullmatch(if_name) for rx in self._redistribute_rx)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -372,11 +382,13 @@ class LinkMonitor(Actor):
                 )
             )
         if self._prefix_q is not None:
-            # redistribute iface addresses as LOOPBACK prefixes
+            # redistribute iface addresses as LOOPBACK prefixes; regexes
+            # (ref redistribute_interface_regexes) limit which interfaces
+            # qualify — empty means all tracked ones
             entries = [
                 PrefixEntry(prefix=net, type=PrefixType.LOOPBACK)
                 for st in self.interfaces.values()
-                if st.active
+                if st.active and self._redistributes(st.info.if_name)
                 for net in st.info.networks
             ]
             self._prefix_q.push(

@@ -167,6 +167,21 @@ async def run_daemon(args) -> None:
         addr = await io.add_interface(name, bind_addr, bind_port)
         log.info("interface %s bound at %s:%d", name, *addr)
         iface_infos.append(InterfaceInfo(if_name=name, is_up=True))
+    # kernel interface discovery: rtnetlink dump + live events feed
+    # LinkMonitor directly (ref LinkMonitor's netlink subscription,
+    # NetlinkProtocolSocket.h:29-31); static --interface stays as the
+    # loopback/emulation seam
+    iface_mon = None
+    if oc.link_monitor_config.enable_netlink_interfaces:
+        from openr_tpu.platform.iface_monitor import NetlinkInterfaceMonitor
+
+        iface_mon = NetlinkInterfaceMonitor(
+            on_interface=lambda info: node.link_monitor.update_interface(
+                info
+            ),
+            include_regexes=oc.link_monitor_config.include_interface_regexes,
+            exclude_regexes=oc.link_monitor_config.exclude_interface_regexes,
+        )
     peers_by_iface: dict[str, list[tuple[str, int]]] = {}
     for spec in args.peer:
         iface, _, endpoint = spec.partition("=")
@@ -192,6 +207,12 @@ async def run_daemon(args) -> None:
     await node.start(*[name for name, _, _ in iface_specs])
     for info in iface_infos:
         node.link_monitor.update_interface(info)
+    if iface_mon is not None:
+        await iface_mon.start()
+        log.info(
+            "netlink interface discovery: %s",
+            ", ".join(sorted(iface_mon.interfaces())) or "(none match)",
+        )
     if args.override_drain_state is not None:
         await node.link_monitor.set_node_overload(
             args.override_drain_state == "drained"
@@ -235,6 +256,8 @@ async def run_daemon(args) -> None:
 
     # graceful restart announcement, then reverse teardown
     log.info("stopping node %s", node_name)
+    if iface_mon is not None:
+        iface_mon.close()
     await node.spark.send_restarting_hellos()
     await node.stop()
     await monitor.stop()

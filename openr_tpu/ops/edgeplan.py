@@ -185,13 +185,11 @@ def build_plan(
     wins), residual grouping and the location tables are numpy. The
     (link, src) -> slot dict is deferred to the first delta application
     (_ensure_edge_loc), so a cold daemon start never builds it."""
-    adj_dbs = link_state.get_adjacency_databases()
-    names = None
-    if prev is not None and adj_dbs.keys() == set(prev.node_names):
-        names = prev.node_names  # node set unchanged: skip the re-sort
-    if names is None:
-        names = sorted(adj_dbs.keys(), key=natural_key)
-    index = {n: i for i, n in enumerate(names)}
+    # per-object extraction memoized on the LinkState per generation —
+    # a second full build at the same generation is numpy-only
+    names, index, n1i, n2i, trip, links_sorted = link_state.mirror_source(
+        natural_key
+    )
     n = len(names)
     if prev is not None:
         n_cap = max(n_cap, prev.n_cap)
@@ -203,20 +201,10 @@ def build_plan(
         if i is not None:
             node_over[i] = True
 
-    # directed edge extraction: edge 2i = links[i].n1 -> n2, 2i+1 reverse
-    links_sorted = link_state.ordered_all_links()
+    # directed edges: edge 2i = links[i].n1 -> n2, 2i+1 reverse
     m = len(links_sorted)
     e2 = m * 2
     if m:
-        n1i = np.fromiter(
-            (index[l.n1] for l in links_sorted), np.int32, m
-        )
-        n2i = np.fromiter(
-            (index[l.n2] for l in links_sorted), np.int32, m
-        )
-        trip = np.array(
-            [l.mirror_fields() for l in links_sorted], np.int64
-        )  # [m, 3]: w12, w21, up
         src = np.empty(e2, np.int32)
         dst = np.empty(e2, np.int32)
         wdir = np.empty(e2, np.int64)

@@ -67,9 +67,10 @@ class MemoryDataplane:
                 self.mpls[label] = r
         return failed
 
-    async def delete_mpls(self, labels: list[int]) -> None:
+    async def delete_mpls(self, labels: list[int]) -> list[int]:
         for label in labels:
             self.mpls.pop(label, None)
+        return []
 
     async def sync_mpls(self, routes: dict[int, dict]) -> list[int]:
         failed = [l for l in routes if l in self.fail_labels]
@@ -84,22 +85,46 @@ class NetlinkDataplane:
     """Kernel dataplane over rtnetlink (ref NetlinkFibHandler ->
     NetlinkProtocolSocket). Unicast routes program into `table` with the
     daemon protocol id; next hops resolve gateway/ifindex from the
-    NextHop address + if_name. MPLS label routes are tracked in memory
-    (kernel MPLS needs the mpls_router module; the seam keeps them
-    separate so a label-capable backend can slot in)."""
+    NextHop address + if_name. MPLS label routes program as AF_MPLS
+    kernel routes when the mpls_router dataplane is loaded (ref
+    NetlinkRouteMessage.cpp:618-769); without it they fall back to the
+    in-memory shadow so the Fib pipeline still round-trips."""
 
     def __init__(self, table: int = 254):
-        from openr_tpu.platform.netlink import NetlinkRouteSocket
+        from openr_tpu.platform.netlink import (
+            NetlinkRouteSocket,
+            mpls_supported,
+        )
 
         self.table = table
         self.nl = NetlinkRouteSocket()
         self._opened = False
         self.mpls: dict[int, dict] = {}
+        self.mpls_kernel = mpls_supported()
+        if not self.mpls_kernel:
+            logging.getLogger(__name__).info(
+                "kernel MPLS dataplane absent (/proc/sys/net/mpls); "
+                "label routes stay in-memory"
+            )
 
     def _ensure_open(self) -> None:
         if not self._opened:
             self.nl.open()
             self._opened = True
+
+    @staticmethod
+    def _nh_out_labels(nh: dict) -> tuple:
+        """MPLS labels this next hop imposes: PUSH labels on unicast
+        routes, the swap label on label routes."""
+        ma = nh.get("mpls_action")
+        if not ma:
+            return ()
+        action = ma.get("action")
+        if action in (0, "PUSH"):
+            return tuple(ma.get("push_labels") or ())
+        if action in (1, "SWAP") and ma.get("swap_label") is not None:
+            return (ma["swap_label"],)
+        return ()
 
     def _to_nl(self, prefix: str, route: dict):
         import socket as _socket
@@ -115,11 +140,18 @@ class NetlinkDataplane:
                 except OSError:
                     ifindex = 0
             addr = (nh.get("address") or "").split("%")[0]
+            # push-label encap only encodes when the kernel can accept
+            # it — otherwise program the plain IP route (traffic still
+            # flows, unlabeled) rather than failing the whole batch
+            out_labels = (
+                self._nh_out_labels(nh) if self.mpls_kernel else ()
+            )
             nhs.append(
                 NlNextHop(
                     gateway=addr or None,
                     ifindex=ifindex,
                     weight=nh.get("weight") or 0,
+                    out_labels=out_labels,
                 )
             )
         return NlRoute(
@@ -128,6 +160,40 @@ class NetlinkDataplane:
             metric=route.get("igp_cost") or 0,
             table=self.table,
         )
+
+    def _to_nl_mpls(self, label: int, route: dict):
+        import socket as _socket
+
+        from openr_tpu.platform.netlink import NlMplsRoute, NlNextHop
+
+        nhs = []
+        for nh in route.get("nexthops", []):
+            ma = nh.get("mpls_action") or {}
+            action = ma.get("action")
+            ifindex = 0
+            if nh.get("if_name"):
+                try:
+                    ifindex = _socket.if_nametoindex(nh["if_name"])
+                except OSError:
+                    ifindex = 0
+            if action in (3, "POP_AND_LOOKUP"):
+                # pop-and-lookup: label-only route out of loopback
+                try:
+                    lo = _socket.if_nametoindex("lo")
+                except OSError:
+                    lo = 1
+                nhs.append(NlNextHop(ifindex=lo))
+                continue
+            addr = (nh.get("address") or "").split("%")[0]
+            nhs.append(
+                NlNextHop(
+                    gateway=addr or None,
+                    ifindex=ifindex,
+                    weight=nh.get("weight") or 0,
+                    out_labels=self._nh_out_labels(nh),
+                )
+            )
+        return NlMplsRoute(label=label, nexthops=tuple(nhs))
 
     # batches at least this large go through the C++ bulk programmer
     # when built (native/netlink_bulk.cpp); smaller ones stay on the
@@ -241,16 +307,62 @@ class NetlinkDataplane:
         return failed
 
     async def add_mpls(self, routes: dict[int, dict]) -> list[int]:
-        self.mpls.update(routes)
-        return []
+        failed: list[int] = []
+        if self.mpls_kernel:
+            self._ensure_open()
+            for label, r in routes.items():
+                try:
+                    await self.nl.add_mpls_route(self._to_nl_mpls(label, r))
+                except OSError as e:
+                    logging.getLogger(__name__).warning(
+                        "add_mpls: label %s failed: %s", label, e
+                    )
+                    failed.append(label)
+        for label, r in routes.items():
+            if label not in failed:
+                self.mpls[label] = r
+        return failed
 
-    async def delete_mpls(self, labels: list[int]) -> None:
+    async def delete_mpls(self, labels: list[int]) -> list[int]:
+        import errno as _errno
+
+        failed: list[int] = []
+        if self.mpls_kernel:
+            self._ensure_open()
+            from openr_tpu.platform.netlink import NlMplsRoute
+
+            for label in labels:
+                try:
+                    await self.nl.delete_mpls_route(NlMplsRoute(label=label))
+                except OSError as e:
+                    if e.errno not in (_errno.ENOENT, _errno.ESRCH):
+                        logging.getLogger(__name__).warning(
+                            "delete_mpls: label %s failed: %s", label, e
+                        )
+                        failed.append(label)
         for label in labels:
-            self.mpls.pop(label, None)
+            if label not in failed:
+                self.mpls.pop(label, None)
+        return failed
 
     async def sync_mpls(self, routes: dict[int, dict]) -> list[int]:
-        self.mpls = dict(routes)
-        return []
+        if not self.mpls_kernel:
+            self.mpls = dict(routes)
+            return []
+        self._ensure_open()
+        from openr_tpu.platform.netlink import PROTO_OPENR
+
+        have = {
+            r.label
+            for r in await self.nl.get_mpls_routes(PROTO_OPENR)
+        }
+        failed = await self.add_mpls(routes)
+        stale = sorted(have - set(routes))
+        failed += await self.delete_mpls(stale)
+        self.mpls = {
+            label: r for label, r in routes.items() if label not in failed
+        }
+        return failed
 
     async def dump_unicast(self) -> dict:
         """Kernel-truth dump of the daemon-owned routes (by table +
@@ -330,8 +442,8 @@ class FibPlatformServer:
         return {"failed_labels": failed}
 
     async def _del_mpls(self, client_id: int, labels: list) -> dict:
-        await self.dataplane.delete_mpls([int(x) for x in labels])
-        return {}
+        failed = await self.dataplane.delete_mpls([int(x) for x in labels])
+        return {"failed_labels": failed or []}
 
     async def _sync_mpls(self, client_id: int, routes: dict) -> dict:
         failed = await self.dataplane.sync_mpls(
@@ -401,10 +513,11 @@ class RemoteFibService(FibServiceBase):
         self._raise_failed(res)
 
     async def delete_mpls_routes(self, client_id, labels) -> None:
-        await self.client.request(
+        res = await self.client.request(
             "platform.fib.delete_mpls_routes",
             {"client_id": client_id, "labels": list(labels)},
         )
+        self._raise_failed(res or {})
 
     async def sync_fib(self, client_id, routes) -> None:
         res = await self.client.request(

@@ -1,0 +1,172 @@
+"""Kernel interface discovery + live link/addr events.
+
+Role of the reference's netlink event plumbing into LinkMonitor
+(openr/nl/NetlinkProtocolSocket.h:29-31 event queue, consumed by
+LinkMonitor — openr/link-monitor/LinkMonitor.h:107): dump links and
+addresses at start, subscribe to RTM_NEWLINK/DELLINK/NEWADDR/DELADDR
+multicast groups, and push an InterfaceInfo snapshot to a callback
+(LinkMonitor.update_interface) on every change. A veth going down is
+therefore withdrawn immediately — not when Spark's hold timer fires.
+
+Interface selection mirrors the reference's include/exclude regex
+config (ref LinkMonitorConfig include_interface_regexes): an interface
+is tracked iff it matches an include regex (or no includes are
+configured), does not match any exclude regex, and is not loopback.
+Addresses feeding redistribution keep global scope only — link-local
+never leaves the box.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import socket
+from typing import Callable, Iterable, Optional
+
+from openr_tpu.platform.netlink import (
+    RTMGRP_IPV4_IFADDR,
+    RTMGRP_IPV6_IFADDR,
+    RTMGRP_LINK,
+    NetlinkRouteSocket,
+    NlAddr,
+    NlLink,
+)
+from openr_tpu.types import InterfaceInfo
+
+log = logging.getLogger(__name__)
+
+
+def _is_link_local(prefix: str) -> bool:
+    import ipaddress
+
+    try:
+        return ipaddress.ip_interface(prefix).ip.is_link_local
+    except ValueError:
+        return True
+
+
+class NetlinkInterfaceMonitor:
+    """Feeds kernel interface truth into LinkMonitor.
+
+    on_interface: called with an InterfaceInfo on every tracked-interface
+    change (and once per interface at start)."""
+
+    def __init__(
+        self,
+        on_interface: Callable[[InterfaceInfo], None],
+        include_regexes: Iterable[str] = (),
+        exclude_regexes: Iterable[str] = (),
+        nl: Optional[NetlinkRouteSocket] = None,
+    ):
+        self.on_interface = on_interface
+        self._include = [re.compile(r) for r in include_regexes]
+        self._exclude = [re.compile(r) for r in exclude_regexes]
+        self.nl = nl or NetlinkRouteSocket()
+        self.nl.event_cb = self._on_event
+        self._links: dict[int, NlLink] = {}
+        self._addrs: dict[int, set[str]] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        self.nl.open(
+            groups=RTMGRP_LINK | RTMGRP_IPV4_IFADDR | RTMGRP_IPV6_IFADDR
+        )
+        for link in await self.nl.get_links():
+            self._links[link.ifindex] = link
+        for fam in (socket.AF_INET, socket.AF_INET6):
+            for addr in await self.nl.get_addrs(fam):
+                self._addrs.setdefault(addr.ifindex, set()).add(addr.prefix)
+        for ifindex in list(self._links):
+            self._emit(ifindex)
+
+    def close(self) -> None:
+        self.nl.close()
+
+    # -- selection ---------------------------------------------------------
+
+    def wanted(self, link: NlLink) -> bool:
+        if link.is_loopback or not link.name:
+            return False
+        if any(rx.fullmatch(link.name) for rx in self._exclude):
+            return False
+        if self._include:
+            return any(rx.fullmatch(link.name) for rx in self._include)
+        return True
+
+    def interfaces(self) -> dict[str, InterfaceInfo]:
+        out = {}
+        for ifindex, link in self._links.items():
+            if self.wanted(link):
+                out[link.name] = self._info(ifindex, link)
+        return out
+
+    # -- events ------------------------------------------------------------
+
+    def _on_event(self, kind: str, obj) -> None:
+        if kind == "link":
+            old = self._links.get(obj.ifindex)
+            self._links[obj.ifindex] = obj
+            if (
+                old is not None
+                and old.name != obj.name
+                and self.wanted(old)
+            ):
+                # renamed: withdraw the old name — LinkMonitor tracks by
+                # name, and a stale entry would stay active forever
+                self.on_interface(
+                    InterfaceInfo(
+                        if_name=old.name, is_up=False,
+                        if_index=old.ifindex, networks=(),
+                    )
+                )
+            if old is None or old.flags != obj.flags or old.name != obj.name:
+                self._emit(obj.ifindex)
+        elif kind == "link_del":
+            old = self._links.pop(obj.ifindex, None)
+            self._addrs.pop(obj.ifindex, None)
+            if old is not None and self.wanted(old):
+                # a deleted interface reports down — LinkMonitor
+                # withdraws its adjacencies and prefixes
+                self.on_interface(
+                    InterfaceInfo(
+                        if_name=old.name, is_up=False,
+                        if_index=old.ifindex, networks=(),
+                    )
+                )
+        elif kind == "addr":
+            s = self._addrs.setdefault(obj.ifindex, set())
+            if obj.prefix not in s:
+                s.add(obj.prefix)
+                self._emit(obj.ifindex)
+        elif kind == "addr_del":
+            s = self._addrs.get(obj.ifindex)
+            if s is not None and obj.prefix in s:
+                s.discard(obj.prefix)
+                self._emit(obj.ifindex)
+
+    def _info(self, ifindex: int, link: NlLink) -> InterfaceInfo:
+        networks = tuple(
+            sorted(
+                p
+                for p in self._addrs.get(ifindex, ())
+                if not _is_link_local(p)
+            )
+        )
+        return InterfaceInfo(
+            if_name=link.name,
+            is_up=link.is_up,
+            if_index=ifindex,
+            networks=networks,
+        )
+
+    def _emit(self, ifindex: int) -> None:
+        link = self._links.get(ifindex)
+        if link is None or not self.wanted(link):
+            return
+        info = self._info(ifindex, link)
+        log.info(
+            "interface %s: %s, %d addr(s)",
+            info.if_name, "up" if info.is_up else "down", len(info.networks),
+        )
+        self.on_interface(info)

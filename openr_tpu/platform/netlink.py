@@ -36,6 +36,12 @@ NLM_F_REPLACE = 0x100
 NLM_F_CREATE = 0x400
 
 # rtnetlink (linux/rtnetlink.h)
+RTM_NEWLINK = 16
+RTM_DELLINK = 17
+RTM_GETLINK = 18
+RTM_NEWADDR = 20
+RTM_DELADDR = 21
+RTM_GETADDR = 22
 RTM_NEWROUTE = 24
 RTM_DELROUTE = 25
 RTM_GETROUTE = 26
@@ -49,9 +55,35 @@ RTA_GATEWAY = 5
 RTA_PRIORITY = 6
 RTA_MULTIPATH = 9
 RTA_TABLE = 15
+RTA_VIA = 18
+RTA_NEWDST = 19
+RTA_ENCAP_TYPE = 21
+RTA_ENCAP = 22
+
+# MPLS dataplane (linux/mpls.h, linux/lwtunnel.h, linux/mpls_iptunnel.h)
+AF_MPLS = 28
+LWTUNNEL_ENCAP_MPLS = 1
+MPLS_IPTUNNEL_DST = 1
+
+# link attributes (linux/if_link.h) + addr attributes (linux/if_addr.h)
+IFLA_IFNAME = 3
+IFA_ADDRESS = 1
+IFA_LOCAL = 2
+
+# interface flags (linux/if.h)
+IFF_UP = 0x1
+IFF_RUNNING = 0x40
+IFF_LOOPBACK = 0x8
+
+# multicast groups for event subscription (linux/rtnetlink.h)
+RTMGRP_LINK = 0x1
+RTMGRP_IPV4_IFADDR = 0x10
+RTMGRP_IPV6_IFADDR = 0x100
 
 _NLMSGHDR = struct.Struct("=IHHII")  # len, type, flags, seq, pid
 _RTMSG = struct.Struct("=BBBBBBBBI")  # family,dst,src,tos,table,proto,scope,type,flags
+_IFINFOMSG = struct.Struct("=BBHiII")  # family,pad,type,index,flags,change
+_IFADDRMSG = struct.Struct("=BBBBI")  # family,prefixlen,flags,scope,index
 _RTA = struct.Struct("=HH")  # len, type
 _RTNH = struct.Struct("=HBBi")  # len, flags, hops, ifindex
 
@@ -72,11 +104,61 @@ def _rta(rta_type: int, payload: bytes) -> bytes:
 
 @dataclass(frozen=True)
 class NlNextHop:
-    """One kernel next hop: gateway address and/or output interface."""
+    """One kernel next hop: gateway address and/or output interface.
+
+    out_labels: MPLS labels this hop imposes — on an IP route they
+    encode as LWTUNNEL MPLS encap (push); on an AF_MPLS route as
+    RTA_NEWDST (swap). Empty on an MPLS route means pop-and-forward
+    (PHP) — or pop-and-lookup when there is no gateway either."""
 
     gateway: Optional[str] = None  # "10.0.0.1" / "fe80::1"
     ifindex: int = 0
     weight: int = 0  # ECMP weight hint (rtnh_hops = weight - 1)
+    out_labels: tuple = ()
+
+
+@dataclass(frozen=True)
+class NlMplsRoute:
+    """One kernel MPLS label route (ref NetlinkRouteMessage.cpp:618-769
+    AF_MPLS encode)."""
+
+    label: int
+    nexthops: tuple = ()  # NlNextHop
+    protocol: int = PROTO_OPENR
+
+
+def mpls_supported() -> bool:
+    """True when the kernel has the MPLS dataplane loaded
+    (mpls_router); programming AF_MPLS routes without it returns
+    EAFNOSUPPORT."""
+    import os
+
+    return os.path.isdir("/proc/sys/net/mpls")
+
+
+def _mpls_label_stack(labels: tuple) -> bytes:
+    """Label records, 4 bytes each, bottom-of-stack bit on the last
+    (linux/mpls.h mpls_label: label<<12 | tc<<9 | bos<<8 | ttl)."""
+    out = bytearray()
+    for i, label in enumerate(labels):
+        bos = 1 if i == len(labels) - 1 else 0
+        out += struct.pack(">I", (int(label) << 12) | (bos << 8))
+    return bytes(out)
+
+
+def _rta_via(gateway: str) -> bytes:
+    """RTA_VIA payload: u16 address family + raw address bytes."""
+    addr = ipaddress.ip_address(gateway)
+    family = socket.AF_INET if addr.version == 4 else socket.AF_INET6
+    return _rta(RTA_VIA, struct.pack("=H", family) + addr.packed)
+
+
+def _mpls_encap_attrs(out_labels: tuple) -> bytes:
+    """LWTUNNEL MPLS push encap for an IP route's next hop
+    (ref NetlinkRouteMessage.cpp encap encode :664)."""
+    inner = _rta(MPLS_IPTUNNEL_DST, _mpls_label_stack(out_labels))
+    return _rta(RTA_ENCAP_TYPE, struct.pack("=H", LWTUNNEL_ENCAP_MPLS)) + \
+        _rta(RTA_ENCAP, inner)
 
 
 @dataclass
@@ -96,34 +178,74 @@ class NlRoute:
         )
 
 
+@dataclass(frozen=True)
+class NlLink:
+    """One kernel interface (RTM_NEWLINK/DELLINK payload)."""
+
+    ifindex: int
+    name: str
+    flags: int = 0
+
+    @property
+    def is_up(self) -> bool:
+        # operationally usable: administratively up AND carrier present
+        return bool(self.flags & IFF_UP) and bool(self.flags & IFF_RUNNING)
+
+    @property
+    def is_loopback(self) -> bool:
+        return bool(self.flags & IFF_LOOPBACK)
+
+
+@dataclass(frozen=True)
+class NlAddr:
+    """One kernel interface address (RTM_NEWADDR/DELADDR payload)."""
+
+    ifindex: int
+    prefix: str  # "10.0.0.1/24" / "fe80::1/64"
+    family: int = socket.AF_INET
+
+
 @dataclass
 class _Pending:
     future: asyncio.Future
     dump: bool = False
     results: list = field(default_factory=list)
+    parse: Optional[object] = None  # per-dump message parser
 
 
 class NetlinkRouteSocket:
     """Pipelined rtnetlink requests (ref NetlinkProtocolSocket.h:33-70:
     up to `max_in_flight` un-acked requests, each completing its future
-    on ACK/ERROR/DONE)."""
+    on ACK/ERROR/DONE). With `groups`, the socket also joins rtnetlink
+    multicast groups and surfaces unsolicited kernel events through
+    `event_cb(kind, obj)` — kind in {"link", "link_del", "addr",
+    "addr_del"} with NlLink/NlAddr payloads (ref event queue,
+    NetlinkProtocolSocket.h:29-31)."""
 
-    def __init__(self, max_in_flight: int = 256):
+    def __init__(self, max_in_flight: int = 256, event_cb=None):
         self._sock: Optional[socket.socket] = None
         self._seq = 0
         self._pending: dict[int, _Pending] = {}
         self._window = asyncio.Semaphore(max_in_flight)
         self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._portid = 0
+        self.event_cb = event_cb
 
     # -- lifecycle ---------------------------------------------------------
 
-    def open(self) -> None:
+    def open(self, groups: int = 0) -> None:
         sock = socket.socket(
             socket.AF_NETLINK, socket.SOCK_RAW, socket.NETLINK_ROUTE
         )
-        sock.bind((0, 0))
+        sock.bind((0, groups))
         sock.setblocking(False)
         self._sock = sock
+        # kernel-assigned portid: unicast replies to OUR requests carry
+        # it in nlmsg_pid; multicast events carry the originator's pid
+        # (0 for the kernel itself). Demultiplexing on it — not on seq —
+        # keeps another client's event from colliding with a pending
+        # dump's sequence number and truncating it.
+        self._portid = sock.getsockname()[0]
         self._loop = asyncio.get_running_loop()
         self._loop.add_reader(sock.fileno(), self._on_readable)
 
@@ -154,7 +276,7 @@ class NetlinkRouteSocket:
     # -- request plumbing --------------------------------------------------
 
     async def _send(self, msg_type: int, flags: int, payload: bytes,
-                    dump: bool = False) -> list:
+                    dump: bool = False, parse=None) -> list:
         assert self._sock is not None, "open() first"
         await self._window.acquire()
         self._seq += 1
@@ -163,7 +285,7 @@ class NetlinkRouteSocket:
             _NLMSGHDR.size + len(payload), msg_type, flags, seq, 0
         )
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._pending[seq] = _Pending(fut, dump=dump)
+        self._pending[seq] = _Pending(fut, dump=dump, parse=parse)
         try:
             self._sock.send(hdr + payload)
         except OSError:
@@ -202,7 +324,7 @@ class NetlinkRouteSocket:
             if mlen < _NLMSGHDR.size:
                 break
             body = data[off + _NLMSGHDR.size:off + mlen]
-            self._on_msg(mtype, mflags, seq, body)
+            self._on_msg(mtype, mflags, seq, body, _pid)
             off += _align4(mlen)
 
     def _complete(self, seq: int, value=None, error: Optional[int] = None):
@@ -217,7 +339,28 @@ class NetlinkRouteSocket:
         else:
             p.future.set_result(p.results if p.dump else value)
 
-    def _on_msg(self, mtype: int, mflags: int, seq: int, body: bytes):
+    _EVENT_KINDS = {
+        RTM_NEWLINK: "link",
+        RTM_DELLINK: "link_del",
+        RTM_NEWADDR: "addr",
+        RTM_DELADDR: "addr_del",
+    }
+
+    def _on_msg(self, mtype: int, mflags: int, seq: int, body: bytes,
+                pid: Optional[int] = None):
+        is_reply = pid is None or pid == self._portid
+        if not is_reply:
+            if self.event_cb is not None:
+                kind = self._EVENT_KINDS.get(mtype)
+                if kind is not None:
+                    obj = (
+                        _parse_link_msg(body)
+                        if kind.startswith("link")
+                        else _parse_addr_msg(body)
+                    )
+                    if obj is not None:
+                        self.event_cb(kind, obj)
+            return
         if mtype == NLMSG_ERROR:
             (code,) = struct.unpack_from("=i", body)
             self._complete(seq, error=-code if code else None)
@@ -226,11 +369,26 @@ class NetlinkRouteSocket:
         else:
             p = self._pending.get(seq)
             if p is not None and p.dump:
-                route = _parse_route_msg(body)
-                if route is not None:
-                    p.results.append(route)
+                parse = p.parse or _parse_route_msg
+                parsed = parse(body)
+                if parsed is not None:
+                    p.results.append(parsed)
                 if not (mflags & NLM_F_MULTI):
                     self._complete(seq)
+                return
+            if p is None and self.event_cb is not None:
+                # kernel-originated notification addressed to us
+                # (pid == portid happens for our own route changes too)
+                kind = self._EVENT_KINDS.get(mtype)
+                if kind is None:
+                    return
+                obj = (
+                    _parse_link_msg(body)
+                    if kind.startswith("link")
+                    else _parse_addr_msg(body)
+                )
+                if obj is not None:
+                    self.event_cb(kind, obj)
 
     # -- route operations (ref addRoute/deleteRoute/getAllRoutes) ----------
 
@@ -260,6 +418,52 @@ class NetlinkRouteSocket:
             if (table is None or r.table == table)
             and (protocol is None or r.protocol == protocol)
         ]
+
+    # -- MPLS label routes (ref NetlinkRouteMessage.cpp:618-769) -----------
+
+    async def add_mpls_route(
+        self, route: NlMplsRoute, replace: bool = True
+    ) -> None:
+        flags = NLM_F_REQUEST | NLM_F_ACK | NLM_F_CREATE
+        if replace:
+            flags |= NLM_F_REPLACE
+        await self._send(RTM_NEWROUTE, flags, _build_mpls_route_msg(route))
+
+    async def delete_mpls_route(self, route: NlMplsRoute) -> None:
+        await self._send(
+            RTM_DELROUTE,
+            NLM_F_REQUEST | NLM_F_ACK,
+            _build_mpls_route_msg(route, for_delete=True),
+        )
+
+    async def get_mpls_routes(
+        self, protocol: Optional[int] = None
+    ) -> list[NlMplsRoute]:
+        rtm = _RTMSG.pack(AF_MPLS, 0, 0, 0, 0, 0, 0, 0, 0)
+        routes = await self._send(
+            RTM_GETROUTE, NLM_F_REQUEST | NLM_F_DUMP, rtm,
+            dump=True, parse=_parse_mpls_route_msg,
+        )
+        return [
+            r for r in routes
+            if protocol is None or r.protocol == protocol
+        ]
+
+    # -- link/addr discovery (ref getAllLinks/getAllIfAddresses) -----------
+
+    async def get_links(self) -> list[NlLink]:
+        payload = _IFINFOMSG.pack(0, 0, 0, 0, 0, 0)
+        return await self._send(
+            RTM_GETLINK, NLM_F_REQUEST | NLM_F_DUMP, payload,
+            dump=True, parse=_parse_link_msg,
+        )
+
+    async def get_addrs(self, family: int = 0) -> list[NlAddr]:
+        payload = _IFADDRMSG.pack(family, 0, 0, 0, 0)
+        return await self._send(
+            RTM_GETADDR, NLM_F_REQUEST | NLM_F_DUMP, payload,
+            dump=True, parse=_parse_addr_msg,
+        )
 
 
 def native_bulk_available() -> bool:
@@ -291,6 +495,10 @@ def pack_bulk_routes(routes: list[NlRoute]) -> bytes:
                 f"{r.prefix}: {len(nhs)} nexthops exceed the bulk "
                 "format's u8 count"
             )
+        if any(nh.out_labels for nh in nhs):
+            # the bulk format carries no MPLS encap — silently dropping
+            # the labels would program a black-holing plain-IP route
+            raise ValueError(f"{r.prefix}: MPLS encap not bulk-encodable")
         out += struct.pack(
             "<BBBBI", family, net.prefixlen, len(nhs), 0, r.metric
         )
@@ -348,6 +556,10 @@ def _build_route_msg(route: NlRoute, for_delete: bool = False) -> bytes:
     if not for_delete and nhs:
         if len(nhs) == 1:
             nh = nhs[0]
+            if nh.out_labels:
+                # MPLS push: LWTUNNEL encap rides the route level for a
+                # single next hop (ref NetlinkRouteMessage.cpp:664)
+                attrs.append(_mpls_encap_attrs(nh.out_labels))
             if nh.gateway:
                 attrs.append(
                     _rta(
@@ -362,10 +574,60 @@ def _build_route_msg(route: NlRoute, for_delete: bool = False) -> bytes:
             blob = b""
             for nh in nhs:
                 nested = b""
+                if nh.out_labels:
+                    nested += _mpls_encap_attrs(nh.out_labels)
                 if nh.gateway:
-                    nested = _rta(
+                    nested += _rta(
                         RTA_GATEWAY, ipaddress.ip_address(nh.gateway).packed
                     )
+                rtnh_len = _RTNH.size + len(nested)
+                blob += _RTNH.pack(
+                    rtnh_len, 0, max(nh.weight - 1, 0), nh.ifindex
+                ) + nested
+            attrs.append(_rta(RTA_MULTIPATH, blob))
+    return rtm + b"".join(attrs)
+
+
+def _mpls_nh_attrs(nh: NlNextHop) -> bytes:
+    """Per-nexthop attributes of an AF_MPLS route: RTA_VIA (gateway),
+    RTA_NEWDST (outgoing label stack — swap); neither means pop."""
+    nested = b""
+    if nh.out_labels:
+        nested += _rta(RTA_NEWDST, _mpls_label_stack(nh.out_labels))
+    if nh.gateway:
+        nested += _rta_via(nh.gateway)
+    return nested
+
+
+def _build_mpls_route_msg(
+    route: NlMplsRoute, for_delete: bool = False
+) -> bytes:
+    """AF_MPLS label route (ref NetlinkRouteMessage.cpp:618-769):
+    dst = the incoming label (20-bit dst_len); per-nexthop RTA_NEWDST
+    swaps, RTA_VIA gateways; label-only nexthop (dev only) = pop."""
+    rtm = _RTMSG.pack(
+        AF_MPLS,
+        20,  # label bits
+        0,
+        0,
+        0,  # MPLS routes live in the platform label table, not an RT table
+        route.protocol,
+        RT_SCOPE_UNIVERSE,
+        RTN_UNICAST,
+        0,
+    )
+    attrs = [_rta(RTA_DST, _mpls_label_stack((route.label,)))]
+    nhs = route.nexthops
+    if not for_delete and nhs:
+        if len(nhs) == 1:
+            nh = nhs[0]
+            attrs.append(_mpls_nh_attrs(nh))
+            if nh.ifindex:
+                attrs.append(_rta(RTA_OIF, struct.pack("=i", nh.ifindex)))
+        else:
+            blob = b""
+            for nh in nhs:
+                nested = _mpls_nh_attrs(nh)
                 rtnh_len = _RTNH.size + len(nested)
                 blob += _RTNH.pack(
                     rtnh_len, 0, max(nh.weight - 1, 0), nh.ifindex
@@ -439,4 +701,142 @@ def _parse_route_msg(body: bytes) -> Optional[NlRoute]:
         metric=metric,
         table=table,
         protocol=proto,
+    )
+
+
+def _decode_label_stack(payload: bytes) -> tuple:
+    labels = []
+    for off in range(0, len(payload) - 3, 4):
+        (word,) = struct.unpack_from(">I", payload, off)
+        labels.append(word >> 12)
+        if word & (1 << 8):  # bottom of stack
+            break
+    return tuple(labels)
+
+
+def _parse_mpls_nh_attrs(payload: bytes, start: int, end: int):
+    gateway = None
+    out_labels: tuple = ()
+    off = start
+    while off + _RTA.size <= end:
+        alen, atype = _RTA.unpack_from(payload, off)
+        if alen < _RTA.size:
+            break
+        data = payload[off + _RTA.size:off + alen]
+        if atype == RTA_VIA and len(data) > 2:
+            gateway = str(ipaddress.ip_address(data[2:]))
+        elif atype == RTA_NEWDST:
+            out_labels = _decode_label_stack(data)
+        off += _align4(alen)
+    return gateway, out_labels
+
+
+def _parse_mpls_route_msg(body: bytes) -> Optional[NlMplsRoute]:
+    if len(body) < _RTMSG.size:
+        return None
+    family, _dl, _src, _tos, _table, proto, _scope, rtype, _flags = (
+        _RTMSG.unpack_from(body)
+    )
+    if family != AF_MPLS or rtype != RTN_UNICAST:
+        return None
+    label = None
+    nexthops: list[NlNextHop] = []
+    top_gw, top_labels, top_oif = None, (), 0
+    off = _RTMSG.size
+    while off + _RTA.size <= len(body):
+        alen, atype = _RTA.unpack_from(body, off)
+        if alen < _RTA.size:
+            break
+        payload = body[off + _RTA.size:off + alen]
+        if atype == RTA_DST:
+            stack = _decode_label_stack(payload)
+            label = stack[0] if stack else None
+        elif atype == RTA_VIA and len(payload) > 2:
+            top_gw = str(ipaddress.ip_address(payload[2:]))
+        elif atype == RTA_NEWDST:
+            top_labels = _decode_label_stack(payload)
+        elif atype == RTA_OIF and len(payload) >= 4:
+            (top_oif,) = struct.unpack("=i", payload[:4])
+        elif atype == RTA_MULTIPATH:
+            noff = 0
+            while noff + _RTNH.size <= len(payload):
+                rtnh_len, _f, hops, ifindex = _RTNH.unpack_from(
+                    payload, noff
+                )
+                if rtnh_len < _RTNH.size:
+                    break
+                gw, labels = _parse_mpls_nh_attrs(
+                    payload, noff + _RTNH.size, noff + rtnh_len
+                )
+                nexthops.append(
+                    NlNextHop(
+                        gateway=gw, ifindex=ifindex,
+                        weight=hops + 1, out_labels=labels,
+                    )
+                )
+                noff += _align4(rtnh_len)
+        off += _align4(alen)
+    if label is None:
+        return None
+    if not nexthops and (top_gw or top_oif or top_labels):
+        nexthops.append(
+            NlNextHop(
+                gateway=top_gw, ifindex=top_oif, out_labels=top_labels
+            )
+        )
+    return NlMplsRoute(
+        label=label, nexthops=tuple(nexthops), protocol=proto
+    )
+
+
+def _parse_link_msg(body: bytes) -> Optional[NlLink]:
+    """RTM_NEWLINK/DELLINK -> NlLink (ref NetlinkLinkMessage parsing)."""
+    if len(body) < _IFINFOMSG.size:
+        return None
+    _fam, _pad, _typ, index, flags, _change = _IFINFOMSG.unpack_from(body)
+    name = ""
+    off = _IFINFOMSG.size
+    while off + _RTA.size <= len(body):
+        alen, atype = _RTA.unpack_from(body, off)
+        if alen < _RTA.size:
+            break
+        if atype == IFLA_IFNAME:
+            name = body[off + _RTA.size:off + alen].rstrip(b"\0").decode(
+                errors="replace"
+            )
+        off += _align4(alen)
+    return NlLink(ifindex=index, name=name, flags=flags)
+
+
+def _parse_addr_msg(body: bytes) -> Optional[NlAddr]:
+    """RTM_NEWADDR/DELADDR -> NlAddr (ref NetlinkAddrMessage parsing).
+
+    IFA_ADDRESS is the peer on pointopoint links; IFA_LOCAL, when
+    present, is the interface's own address and wins."""
+    if len(body) < _IFADDRMSG.size:
+        return None
+    family, prefixlen, _flags, _scope, index = _IFADDRMSG.unpack_from(body)
+    if family not in (socket.AF_INET, socket.AF_INET6):
+        return None
+    address = local = None
+    off = _IFADDRMSG.size
+    while off + _RTA.size <= len(body):
+        alen, atype = _RTA.unpack_from(body, off)
+        if alen < _RTA.size:
+            break
+        payload = body[off + _RTA.size:off + alen]
+        if atype == IFA_ADDRESS:
+            address = payload
+        elif atype == IFA_LOCAL:
+            local = payload
+        off += _align4(alen)
+    raw = local if local is not None else address
+    if raw is None:
+        return None
+    try:
+        addr = ipaddress.ip_address(raw)
+    except ValueError:
+        return None
+    return NlAddr(
+        ifindex=index, prefix=f"{addr}/{prefixlen}", family=family
     )

@@ -464,3 +464,285 @@ class TestNativeBulk:
         finally:
             await dp.delete_unicast(sorted(routes))
             dp.nl.close()
+
+
+class TestNetlinkLinkAddr:
+    """Link/addr dumps + event subscription (ref NetlinkProtocolSocket
+    link/addr messages + event queue, NetlinkProtocolSocket.h:29-31)."""
+
+    @run_async
+    async def test_link_and_addr_dump(self):
+        """Unprivileged: every host has lo with 127.0.0.1/8."""
+        from openr_tpu.platform.netlink import NetlinkRouteSocket
+
+        nl = NetlinkRouteSocket()
+        nl.open()
+        try:
+            links = await nl.get_links()
+            by_name = {l.name: l for l in links}
+            assert "lo" in by_name
+            assert by_name["lo"].is_loopback
+            addrs = await nl.get_addrs(socket.AF_INET)
+            lo_addrs = [
+                a.prefix for a in addrs
+                if a.ifindex == by_name["lo"].ifindex
+            ]
+            assert "127.0.0.1/8" in lo_addrs
+        finally:
+            nl.close()
+
+    @pytest.mark.skipif(not _can_net_admin(), reason="needs CAP_NET_ADMIN")
+    @run_async
+    async def test_veth_lifecycle_events(self):
+        """Create a veth pair, add an address, flip it down, delete it —
+        each kernel action must surface as a subscription event."""
+        from openr_tpu.platform.netlink import (
+            RTMGRP_IPV4_IFADDR,
+            RTMGRP_IPV6_IFADDR,
+            RTMGRP_LINK,
+            NetlinkRouteSocket,
+        )
+
+        name = f"ovt{os.getpid() % 10000}"
+        events: asyncio.Queue = asyncio.Queue()
+        nl = NetlinkRouteSocket(
+            event_cb=lambda kind, obj: events.put_nowait((kind, obj))
+        )
+        nl.open(groups=RTMGRP_LINK | RTMGRP_IPV4_IFADDR | RTMGRP_IPV6_IFADDR)
+
+        def sh(*args):
+            subprocess.run(args, check=True, capture_output=True)
+
+        async def wait_for(pred, timeout=5.0):
+            deadline = time.monotonic() + timeout
+            while True:
+                remaining = deadline - time.monotonic()
+                assert remaining > 0, "event not observed"
+                kind, obj = await asyncio.wait_for(events.get(), remaining)
+                if pred(kind, obj):
+                    return kind, obj
+
+        try:
+            sh("ip", "link", "add", name, "type", "veth",
+               "peer", "name", f"{name}p")
+            await wait_for(
+                lambda k, o: k == "link" and o.name == name
+            )
+            sh("ip", "addr", "add", "10.254.77.1/30", "dev", name)
+            _, addr = await wait_for(
+                lambda k, o: k == "addr" and o.prefix == "10.254.77.1/30"
+            )
+            sh("ip", "link", "set", name, "up")
+            sh("ip", "link", "set", f"{name}p", "up")
+            await wait_for(
+                lambda k, o: k == "link" and o.name == name and o.is_up
+            )
+            sh("ip", "link", "set", name, "down")
+            await wait_for(
+                lambda k, o: k == "link" and o.name == name and not o.is_up
+            )
+            sh("ip", "link", "del", name)
+            await wait_for(
+                lambda k, o: k == "link_del" and o.name == name
+            )
+        finally:
+            subprocess.run(
+                ["ip", "link", "del", name], capture_output=True
+            )
+            nl.close()
+
+    @pytest.mark.skipif(not _can_net_admin(), reason="needs CAP_NET_ADMIN")
+    @run_async
+    async def test_interface_monitor_feeds_link_monitor(self):
+        """NetlinkInterfaceMonitor end-to-end: discovery + live up/down
+        propagate as InterfaceInfo callbacks (what LinkMonitor consumes);
+        downing the iface reports is_up=False immediately."""
+        from openr_tpu.platform.iface_monitor import NetlinkInterfaceMonitor
+
+        name = f"ovm{os.getpid() % 10000}"
+
+        def sh(*args):
+            subprocess.run(args, check=True, capture_output=True)
+
+        infos: asyncio.Queue = asyncio.Queue()
+        mon = NetlinkInterfaceMonitor(
+            on_interface=infos.put_nowait,
+            include_regexes=[re.escape(name)],
+        )
+
+        async def next_info(pred, timeout=5.0):
+            deadline = time.monotonic() + timeout
+            while True:
+                remaining = deadline - time.monotonic()
+                assert remaining > 0, "InterfaceInfo not observed"
+                info = await asyncio.wait_for(infos.get(), remaining)
+                if pred(info):
+                    return info
+
+        try:
+            sh("ip", "link", "add", name, "type", "veth",
+               "peer", "name", f"{name}p")
+            sh("ip", "addr", "add", "10.254.78.1/30", "dev", name)
+            await mon.start()
+            # discovered at start, down, with its (global) address
+            info = await next_info(lambda i: i.if_name == name)
+            assert not info.is_up
+            assert "10.254.78.1/30" in info.networks
+            sh("ip", "link", "set", name, "up")
+            sh("ip", "link", "set", f"{name}p", "up")
+            await next_info(lambda i: i.if_name == name and i.is_up)
+            sh("ip", "link", "set", name, "down")
+            await next_info(lambda i: i.if_name == name and not i.is_up)
+            # loopback and unmatched interfaces never surface
+            assert mon.interfaces().keys() == {name}
+        finally:
+            subprocess.run(
+                ["ip", "link", "del", name], capture_output=True
+            )
+            mon.close()
+
+
+class TestMplsEncode:
+    """AF_MPLS wire format (ref NetlinkRouteMessage.cpp:618-769) —
+    byte-level assertions, no kernel needed."""
+
+    def test_label_stack_bos_bit(self):
+        from openr_tpu.platform.netlink import _mpls_label_stack
+
+        one = _mpls_label_stack((100,))
+        assert one == (100 << 12 | 1 << 8).to_bytes(4, "big")
+        stack = _mpls_label_stack((100, 200))
+        assert len(stack) == 8
+        first = int.from_bytes(stack[:4], "big")
+        last = int.from_bytes(stack[4:], "big")
+        assert first >> 12 == 100 and not first & (1 << 8)
+        assert last >> 12 == 200 and last & (1 << 8)
+
+    def test_mpls_route_roundtrip_via_parser(self):
+        """encode -> parse yields the same route (swap + php + pop)."""
+        from openr_tpu.platform.netlink import (
+            NlMplsRoute,
+            NlNextHop,
+            _build_mpls_route_msg,
+            _parse_mpls_route_msg,
+        )
+
+        for route in (
+            # swap: one nexthop with a new label
+            NlMplsRoute(
+                label=100,
+                nexthops=(
+                    NlNextHop(gateway="10.0.0.2", ifindex=3,
+                              out_labels=(200,)),
+                ),
+            ),
+            # php: pop and forward (no out labels)
+            NlMplsRoute(
+                label=101,
+                nexthops=(NlNextHop(gateway="fe80::1", ifindex=2),),
+            ),
+            # ECMP swap group
+            NlMplsRoute(
+                label=102,
+                nexthops=(
+                    NlNextHop(gateway="10.0.0.2", ifindex=3,
+                              out_labels=(201,), weight=1),
+                    NlNextHop(gateway="10.0.0.6", ifindex=4,
+                              out_labels=(202,), weight=1),
+                ),
+            ),
+        ):
+            body = _build_mpls_route_msg(route)
+            parsed = _parse_mpls_route_msg(body)
+            assert parsed is not None
+            assert parsed.label == route.label
+            assert {
+                (nh.gateway, nh.ifindex, nh.out_labels)
+                for nh in parsed.nexthops
+            } == {
+                (nh.gateway, nh.ifindex, nh.out_labels)
+                for nh in route.nexthops
+            }
+
+    def test_unicast_push_encap_encoded(self):
+        """An IP route whose nexthop pushes labels must carry LWTUNNEL
+        MPLS encap attributes."""
+        from openr_tpu.platform.netlink import (
+            RTA_ENCAP,
+            RTA_ENCAP_TYPE,
+            NlNextHop,
+            NlRoute,
+            _build_route_msg,
+            _rta,
+        )
+        import struct as _struct
+
+        route = NlRoute(
+            prefix="10.1.0.0/24",
+            nexthops=(
+                NlNextHop(gateway="10.0.0.2", ifindex=3,
+                          out_labels=(300, 301)),
+            ),
+        )
+        body = _build_route_msg(route)
+        assert _rta(RTA_ENCAP_TYPE, _struct.pack("=H", 1)) in body
+        # the encap attr nests MPLS_IPTUNNEL_DST with the stack
+        assert (300 << 12).to_bytes(4, "big") in body
+        assert (301 << 12 | 1 << 8).to_bytes(4, "big") in body
+
+    def test_bulk_rejects_encap(self):
+        """The native bulk format cannot carry encap — packing must
+        refuse rather than silently strip labels."""
+        from openr_tpu.platform.netlink import (
+            NlNextHop,
+            NlRoute,
+            pack_bulk_routes,
+        )
+
+        with pytest.raises(ValueError, match="MPLS"):
+            pack_bulk_routes(
+                [
+                    NlRoute(
+                        prefix="10.1.0.0/24",
+                        nexthops=(
+                            NlNextHop(gateway="10.0.0.2",
+                                      out_labels=(300,)),
+                        ),
+                    )
+                ]
+            )
+
+    @pytest.mark.skipif(
+        not (_can_net_admin() and os.path.isdir("/proc/sys/net/mpls")),
+        reason="needs CAP_NET_ADMIN + mpls_router",
+    )
+    @run_async
+    async def test_kernel_mpls_route_programs(self):
+        """Where the kernel MPLS dataplane exists: program a label route
+        and read it back (the netns-lab path)."""
+        from openr_tpu.platform.netlink import (
+            PROTO_OPENR,
+            NetlinkRouteSocket,
+            NlMplsRoute,
+            NlNextHop,
+        )
+
+        subprocess.run(
+            ["sysctl", "-w", "net.mpls.platform_labels=1000"],
+            check=True, capture_output=True,
+        )
+        nl = NetlinkRouteSocket()
+        nl.open()
+        try:
+            lo = socket.if_nametoindex("lo")
+            route = NlMplsRoute(
+                label=500, nexthops=(NlNextHop(ifindex=lo),)
+            )
+            await nl.add_mpls_route(route)
+            try:
+                routes = await nl.get_mpls_routes(PROTO_OPENR)
+                assert any(r.label == 500 for r in routes)
+            finally:
+                await nl.delete_mpls_route(route)
+        finally:
+            nl.close()
