@@ -122,6 +122,37 @@ def peers(ctx, area) -> None:
     _print(_call(ctx, "ctrl.kvstore.peers", {"area": area}))
 
 
+@kvstore.command("set-key")
+@click.argument("key")
+@click.argument("value")
+@click.option("--area", default="0")
+@click.option("--ttl", "ttl_ms", type=int, default=None,
+              help="finite ttl in ms (default: infinite)")
+@click.pass_context
+def kv_set_key(ctx, key, value, area, ttl_ms) -> None:
+    """Inject a key (version auto-bumps to win; ref setKvStoreKeyVals)."""
+    _print(_call(ctx, "ctrl.kvstore.set_key",
+                 {"key": key, "value": value, "area": area,
+                  "ttl_ms": ttl_ms}))
+
+
+@kvstore.command("hashes")
+@click.option("--prefix", default="")
+@click.option("--area", default="0")
+@click.pass_context
+def kv_hashes(ctx, prefix, area) -> None:
+    """Hash-only dump (ref getKvStoreHashFiltered)."""
+    _print(_call(ctx, "ctrl.kvstore.hashes",
+                 {"prefix": prefix, "area": area}))
+
+
+@kvstore.command("areas")
+@click.pass_context
+def kv_areas(ctx) -> None:
+    """Per-area summary (ref getKvStoreAreaSummary)."""
+    _print(_call(ctx, "ctrl.kvstore.areas"))
+
+
 @kvstore.command("flood-topo")
 @click.option("--area", default="0")
 @click.pass_context
@@ -336,6 +367,61 @@ def set_link_metric(ctx, if_name, metric) -> None:
     )
 
 
+@lm.command("set-adj-metric")
+@click.argument("if_name")
+@click.argument("neighbor")
+@click.argument("metric", type=int)
+@click.pass_context
+def set_adj_metric(ctx, if_name, neighbor, metric) -> None:
+    """Override ONE adjacency's metric (ref setAdjacencyMetric)."""
+    _print(_call(ctx, "ctrl.lm.set_adj_metric",
+                 {"if_name": if_name, "neighbor": neighbor,
+                  "metric": metric}))
+
+
+@lm.command("unset-adj-metric")
+@click.argument("if_name")
+@click.argument("neighbor")
+@click.pass_context
+def unset_adj_metric(ctx, if_name, neighbor) -> None:
+    _print(_call(ctx, "ctrl.lm.set_adj_metric",
+                 {"if_name": if_name, "neighbor": neighbor}))
+
+
+@lm.command("unset-link-metric")
+@click.argument("if_name")
+@click.pass_context
+def unset_link_metric(ctx, if_name) -> None:
+    _print(_call(ctx, "ctrl.lm.set_link_metric", {"if_name": if_name}))
+
+
+@lm.command("set-node-metric-inc")
+@click.argument("increment", type=int)
+@click.pass_context
+def set_node_metric_inc(ctx, increment) -> None:
+    """Soft-drain metric increment; 0 unsets."""
+    _print(_call(ctx, "ctrl.lm.set_node_metric_increment",
+                 {"increment": increment}))
+
+
+@lm.command("set-link-metric-inc")
+@click.argument("if_name")
+@click.argument("increment", type=int)
+@click.pass_context
+def set_link_metric_inc(ctx, if_name, increment) -> None:
+    """Per-interface metric increment; 0 unsets."""
+    _print(_call(ctx, "ctrl.lm.set_link_metric_increment",
+                 {"if_name": if_name, "increment": increment}))
+
+
+@lm.command("adjacencies")
+@click.option("--area", default=None)
+@click.pass_context
+def lm_adjacencies(ctx, area) -> None:
+    """Advertised adjacency DBs (ref getLinkMonitorAdjacencies)."""
+    _print(_call(ctx, "ctrl.lm.adjacencies", {"area": area}))
+
+
 # -- spark ------------------------------------------------------------------
 
 @cli.group()
@@ -347,6 +433,13 @@ def spark() -> None:
 @click.pass_context
 def neighbors(ctx) -> None:
     _print(_call(ctx, "ctrl.spark.neighbors"))
+
+
+@spark.command("flood-restarting")
+@click.pass_context
+def flood_restarting(ctx) -> None:
+    """Send graceful-restart hellos now (ref floodRestartingMsg)."""
+    _print(_call(ctx, "ctrl.spark.flood_restarting"))
 
 
 # -- prefixmgr --------------------------------------------------------------
@@ -368,6 +461,51 @@ def view(ctx) -> None:
     _print(_call(ctx, "ctrl.prefixmgr.prefixes"))
 
 
+@prefixmgr.command("advertise")
+@click.argument("prefixes", nargs=-1, required=True)
+@click.option("--prefix-type", default="BREEZE")
+@click.pass_context
+def pm_advertise(ctx, prefixes, prefix_type) -> None:
+    """Inject prefixes network-wide (ref advertisePrefixes)."""
+    _print(_call(ctx, "ctrl.prefixmgr.advertise",
+                 {"prefixes": list(prefixes), "ptype": prefix_type}))
+
+
+@prefixmgr.command("withdraw")
+@click.argument("prefixes", nargs=-1, required=True)
+@click.option("--prefix-type", default="BREEZE")
+@click.pass_context
+def pm_withdraw(ctx, prefixes, prefix_type) -> None:
+    """Withdraw injected prefixes (ref withdrawPrefixes)."""
+    _print(_call(ctx, "ctrl.prefixmgr.withdraw",
+                 {"prefixes": list(prefixes), "ptype": prefix_type}))
+
+
+@prefixmgr.command("withdraw-by-type")
+@click.argument("prefix_type")
+@click.pass_context
+def pm_withdraw_by_type(ctx, prefix_type) -> None:
+    _print(_call(ctx, "ctrl.prefixmgr.withdraw_by_type",
+                 {"ptype": prefix_type}))
+
+
+@prefixmgr.command("sync-by-type")
+@click.argument("prefix_type")
+@click.argument("prefixes", nargs=-1)
+@click.pass_context
+def pm_sync_by_type(ctx, prefix_type, prefixes) -> None:
+    """Replace the full set of a type (ref syncPrefixesByType)."""
+    _print(_call(ctx, "ctrl.prefixmgr.sync_by_type",
+                 {"prefixes": list(prefixes), "ptype": prefix_type}))
+
+
+@prefixmgr.command("originated")
+@click.pass_context
+def pm_originated(ctx) -> None:
+    """Config-originated supernodes (ref getOriginatedPrefixes)."""
+    _print(_call(ctx, "ctrl.prefixmgr.originated"))
+
+
 # -- monitor ----------------------------------------------------------------
 
 @cli.group()
@@ -380,6 +518,13 @@ def monitor() -> None:
 @click.pass_context
 def counters(ctx, prefix) -> None:
     _print(_call(ctx, "monitor.counters", {"prefix": prefix}))
+
+
+@monitor.command("logs")
+@click.pass_context
+def event_logs(ctx) -> None:
+    """Sampled event logs (ref getEventLogs)."""
+    _print(_call(ctx, "monitor.event_logs"))
 
 
 # -- tech-support -----------------------------------------------------------

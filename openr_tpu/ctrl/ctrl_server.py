@@ -41,6 +41,8 @@ class CtrlServer(Actor):
         fib_updates_queue: Optional[ReplicateQueue] = None,
         listen_port: int = 0,
         config=None,
+        monitor=None,
+        persistent_store=None,
     ):
         super().__init__(f"ctrl:{node_name}")
         self.node_name = node_name
@@ -54,6 +56,8 @@ class CtrlServer(Actor):
         self._fib_updates_q = fib_updates_queue
         self._listen_port = listen_port
         self.config = config
+        self.monitor = monitor
+        self.persistent_store = persistent_store
         self.server = RpcServer(self.name)
         self.port: int = 0
         self.start_time = time.time()
@@ -64,12 +68,23 @@ class CtrlServer(Actor):
         s = self.server
         s.register("openr.version", self._version)
         s.register("openr.initialization_events", self._get_init_events)
+        s.register("openr.initialization_converged", self._init_converged)
+        s.register("openr.initialization_duration", self._init_duration)
+        s.register("openr.my_node_name", self._my_node_name)
+        s.register("openr.build_info", self._build_info)
         s.register("monitor.counters", self._counters)
+        s.register("monitor.event_logs", self._event_logs)
+        s.register("ctrl.store.set", self._store_set)
+        s.register("ctrl.store.get", self._store_get)
+        s.register("ctrl.store.erase", self._store_erase)
         if self.kvstore is not None:
             s.register("ctrl.kvstore.keyvals", self._kv_get)
             s.register("ctrl.kvstore.dump", self._kv_dump)
+            s.register("ctrl.kvstore.hashes", self._kv_hashes)
             s.register("ctrl.kvstore.peers", self._kv_peers)
             s.register("ctrl.kvstore.set", self._kv_set)
+            s.register("ctrl.kvstore.set_key", self._kv_set_key)
+            s.register("ctrl.kvstore.areas", self._kv_area_summary)
             s.register("ctrl.kvstore.long_poll_adj", self._kv_long_poll_adj)
             s.register("ctrl.kvstore.flood_topo", self._kv_flood_topo)
         s.register("ctrl.config.dryrun", self._dryrun_config)
@@ -82,6 +97,11 @@ class CtrlServer(Actor):
             )
             s.register("ctrl.decision.adj_dbs", self._decision_adj_dbs)
             s.register(
+                "ctrl.decision.adjacencies_filtered",
+                self._decision_adjacencies_filtered,
+            )
+            s.register("ctrl.decision.prefix_dbs", self._decision_prefix_dbs)
+            s.register(
                 "ctrl.decision.received_routes", self._decision_received
             )
             s.register("ctrl.decision.set_rib_policy", self._set_rib_policy)
@@ -92,18 +112,39 @@ class CtrlServer(Actor):
         if self.fib is not None:
             s.register("ctrl.fib.routes", self._fib_routes)
             s.register("ctrl.fib.mpls_routes", self._fib_mpls)
+            s.register("ctrl.fib.routes_filtered", self._fib_routes_filtered)
+            s.register("ctrl.fib.mpls_filtered", self._fib_mpls_filtered)
             s.register("ctrl.fib.perf", self._fib_perf)
         if self.link_monitor is not None:
             s.register("ctrl.lm.links", self._lm_links)
             s.register("ctrl.lm.interfaces", self._lm_interfaces)
+            s.register("ctrl.lm.adjacencies", self._lm_adjacencies)
             s.register("ctrl.lm.set_node_overload", self._lm_set_overload)
             s.register("ctrl.lm.set_link_overload", self._lm_set_link_overload)
             s.register("ctrl.lm.set_link_metric", self._lm_set_link_metric)
+            s.register("ctrl.lm.set_adj_metric", self._lm_set_adj_metric)
+            s.register(
+                "ctrl.lm.set_node_metric_increment",
+                self._lm_set_node_metric_increment,
+            )
+            s.register(
+                "ctrl.lm.set_link_metric_increment",
+                self._lm_set_link_metric_increment,
+            )
         if self.spark is not None:
             s.register("ctrl.spark.neighbors", self._spark_neighbors)
+            s.register("ctrl.spark.flood_restarting", self._spark_flood_restarting)
         if self.prefix_manager is not None:
             s.register("ctrl.prefixmgr.advertised", self._pm_advertised)
             s.register("ctrl.prefixmgr.prefixes", self._pm_prefixes)
+            s.register("ctrl.prefixmgr.prefixes_by_type", self._pm_prefixes_by_type)
+            s.register("ctrl.prefixmgr.originated", self._pm_originated)
+            s.register("ctrl.prefixmgr.advertise", self._pm_advertise)
+            s.register("ctrl.prefixmgr.withdraw", self._pm_withdraw)
+            s.register(
+                "ctrl.prefixmgr.withdraw_by_type", self._pm_withdraw_by_type
+            )
+            s.register("ctrl.prefixmgr.sync_by_type", self._pm_sync_by_type)
         if self._kvstore_updates_q is not None:
             s.register("ctrl.kvstore.subscribe", self._subscribe_kvstore)
             self.add_task(
@@ -160,6 +201,65 @@ class CtrlServer(Actor):
     async def _get_init_events(self) -> dict:
         return dict(self.initialization_events)
 
+    # the reference's convergence signal (ref initializationConverged):
+    # FIB_SYNCED marks the cold-boot pipeline complete end-to-end (the
+    # RIB was computed AND programmed)
+    _CONVERGENCE_EVENT = "FIB_SYNCED"
+
+    async def _init_converged(self) -> bool:
+        return self._CONVERGENCE_EVENT in self.initialization_events
+
+    async def _init_duration(self) -> Optional[float]:
+        """ref getInitializationDurationMs; None until converged."""
+        ts = self.initialization_events.get(self._CONVERGENCE_EVENT)
+        return None if ts is None else (ts - self.start_time) * 1e3
+
+    async def _my_node_name(self) -> str:
+        return self.node_name
+
+    async def _build_info(self) -> dict:
+        """ref getBuildInfo — platform/package provenance."""
+        import platform as _platform
+
+        try:
+            from importlib.metadata import version as _pkg_version
+
+            pkg = _pkg_version("openr-tpu")
+        except Exception:
+            pkg = "dev"
+        return {
+            "build_package": "openr_tpu",
+            "build_version": pkg,
+            "build_platform": _platform.platform(),
+            "build_python": _platform.python_version(),
+        }
+
+    async def _event_logs(self) -> list:
+        """ref getEventLogs — Monitor's LogSample ring."""
+        if self.monitor is None:
+            return []
+        return await self.monitor.get_event_logs()
+
+    # -- persistent config store (ref setConfigKey/getConfigKey/eraseConfigKey,
+    # OpenrCtrl.thrift:648-661) -----------------------------------------------
+
+    async def _store_set(self, key: str, value: str) -> dict:
+        if self.persistent_store is None:
+            raise RuntimeError("no persistent store configured")
+        self.persistent_store.store(f"ctrl:{key}", value.encode())
+        return {"ok": True}
+
+    async def _store_get(self, key: str) -> Optional[str]:
+        if self.persistent_store is None:
+            raise RuntimeError("no persistent store configured")
+        raw = self.persistent_store.load(f"ctrl:{key}")
+        return None if raw is None else raw.decode(errors="replace")
+
+    async def _store_erase(self, key: str) -> dict:
+        if self.persistent_store is None:
+            raise RuntimeError("no persistent store configured")
+        return {"erased": self.persistent_store.erase(f"ctrl:{key}")}
+
     # -- kvstore -----------------------------------------------------------
 
     async def _kv_get(self, area: str = "0", keys: Optional[list] = None) -> dict:
@@ -181,6 +281,41 @@ class CtrlServer(Actor):
 
         await self.kvstore.set_key_vals(area, {key: from_plain(value, Value)})
         return {"ok": True}
+
+    async def _kv_set_key(
+        self,
+        key: str,
+        value: str,
+        area: str = "0",
+        version: Optional[int] = None,
+        ttl_ms: Optional[int] = None,
+    ) -> dict:
+        """Operator key injection with TTL control (ref setKvStoreKeyVals
+        with KeySetParams ttl, KvStore.thrift:749): version defaults to
+        beating the live value."""
+        from openr_tpu.types import TTL_INFINITY, Value
+
+        if version is None:
+            live = await self.kvstore.get_key_vals(area, [key])
+            version = (live[key].version + 1) if key in live else 1
+        val = Value(
+            version=version,
+            originator_id=f"breeze:{self.node_name}",
+            value=value.encode(),
+            ttl_ms=TTL_INFINITY if ttl_ms is None else ttl_ms,
+        )
+        await self.kvstore.set_key_vals(area, {key: val})
+        return {"ok": True, "version": version}
+
+    async def _kv_hashes(self, area: str = "0", prefix: str = "") -> dict:
+        """Hash-only dump (ref getKvStoreHashFiltered) — the anti-entropy
+        comparison view, value payloads stripped."""
+        vals = await self.kvstore.dump_hashes(area, prefix)
+        return {k: to_plain(v) for k, v in vals.items()}
+
+    async def _kv_area_summary(self) -> dict:
+        """ref getKvStoreAreaSummary."""
+        return self.kvstore.get_area_summary()
 
     # -- decision ----------------------------------------------------------
 
@@ -221,6 +356,36 @@ class CtrlServer(Actor):
             for area, nodes in dbs.items()
         }
 
+    async def _decision_adjacencies_filtered(
+        self,
+        node_names: Optional[list] = None,
+        areas: Optional[list] = None,
+    ) -> dict:
+        """ref getDecisionAreaAdjacenciesFiltered: adjacency DBs
+        restricted to the requested node/area sets."""
+        dbs = await self.decision.get_adj_dbs()
+        return {
+            area: {
+                node: to_plain(db)
+                for node, db in nodes.items()
+                if not node_names or node in node_names
+            }
+            for area, nodes in dbs.items()
+            if not areas or area in areas
+        }
+
+    async def _decision_prefix_dbs(self) -> dict:
+        """ref getDecisionPrefixDbs: every announcer's prefix entries as
+        Decision currently sees them."""
+        dbs = await self.decision.get_prefix_dbs()
+        return {
+            node: {
+                area: {p: to_plain(e) for p, e in prefixes.items()}
+                for area, prefixes in areas.items()
+            }
+            for node, areas in dbs.items()
+        }
+
     async def _decision_received(self) -> list:
         return [
             [pfx, list(node_area), to_plain(entry)]
@@ -255,6 +420,22 @@ class CtrlServer(Actor):
         routes = await self.fib.get_mpls_route_db()
         return {str(l): to_plain(e) for l, e in routes.items()}
 
+    async def _fib_routes_filtered(self, prefixes: list) -> dict:
+        """ref getUnicastRoutesFiltered: exact-prefix selection."""
+        routes = await self.fib.get_route_db()
+        want = set(prefixes or [])
+        return {
+            p: to_plain(e) for p, e in routes.items() if p in want
+        }
+
+    async def _fib_mpls_filtered(self, labels: list) -> dict:
+        """ref getMplsRoutesFiltered."""
+        routes = await self.fib.get_mpls_route_db()
+        want = {int(x) for x in labels or []}
+        return {
+            str(l): to_plain(e) for l, e in routes.items() if l in want
+        }
+
     async def _fib_perf(self) -> list:
         return [to_plain(p) for p in await self.fib.get_perf_db()]
 
@@ -283,6 +464,35 @@ class CtrlServer(Actor):
         await self.link_monitor.set_link_metric(if_name, metric)
         return {"ok": True}
 
+    async def _lm_set_adj_metric(
+        self, if_name: str, neighbor: str, metric: Optional[int] = None
+    ) -> dict:
+        """ref set/unsetAdjacencyMetric (OpenrCtrl.thrift:581-586);
+        metric None unsets."""
+        await self.link_monitor.set_adjacency_metric(
+            if_name, neighbor, metric
+        )
+        return {"ok": True}
+
+    async def _lm_set_node_metric_increment(self, increment: int = 0) -> dict:
+        """ref set/unsetNodeInterfaceMetricIncrement; 0 unsets."""
+        await self.link_monitor.set_node_metric_increment(increment)
+        return {"ok": True}
+
+    async def _lm_set_link_metric_increment(
+        self, if_name: str, increment: int = 0
+    ) -> dict:
+        """ref set/unsetInterfaceMetricIncrement; 0 unsets."""
+        await self.link_monitor.set_link_metric_increment(if_name, increment)
+        return {"ok": True}
+
+    async def _lm_adjacencies(self, area: Optional[str] = None) -> list:
+        """ref getLinkMonitorAdjacencies(Filtered)."""
+        return [
+            to_plain(db)
+            for db in await self.link_monitor.get_adjacencies(area)
+        ]
+
     # -- spark / prefix manager --------------------------------------------
 
     async def _spark_neighbors(self) -> list:
@@ -308,6 +518,86 @@ class CtrlServer(Actor):
             p: to_plain(e)
             for p, e in (await self.prefix_manager.get_prefixes()).items()
         }
+
+    async def _pm_prefixes_by_type(self, ptype) -> dict:
+        """ref getPrefixesByType."""
+        pt = self._parse_prefix_type(ptype)
+        return {
+            p: to_plain(e)
+            for p, e in (await self.prefix_manager.get_prefixes()).items()
+            if e.type == pt
+        }
+
+    async def _pm_originated(self) -> dict:
+        """ref getOriginatedPrefixes: config-originated supernodes with
+        their install state."""
+        out = {}
+        for prefix, st in self.prefix_manager.originated.items():
+            out[prefix] = {
+                "config": to_plain(st.conf),
+                "supporting_prefixes": sorted(st.supporting),
+                "advertised": st.advertised,
+            }
+        return out
+
+    @staticmethod
+    def _parse_prefix_type(ptype):
+        from openr_tpu.types import PrefixType
+
+        if isinstance(ptype, str):
+            return PrefixType[ptype.upper()]
+        return PrefixType(ptype)
+
+    def _parse_entries(self, prefixes: list, ptype) -> tuple:
+        from openr_tpu.types import PrefixEntry, replace
+
+        pt = self._parse_prefix_type(ptype)
+        entries = []
+        for p in prefixes:
+            if isinstance(p, str):
+                entries.append(PrefixEntry(prefix=p, type=pt))
+            else:
+                e = from_plain(p, PrefixEntry)
+                entries.append(replace(e, type=pt))
+        return pt, entries
+
+    async def _pm_advertise(
+        self, prefixes: list, ptype="BREEZE", dest_areas: Optional[list] = None
+    ) -> dict:
+        """Operator prefix injection (ref advertisePrefixes,
+        OpenrCtrl.thrift:299): entries may be plain prefix strings or
+        full PrefixEntry payloads."""
+        pt, entries = self._parse_entries(prefixes, ptype)
+        self.prefix_manager.advertise_prefixes(
+            entries, pt, tuple(dest_areas or ())
+        )
+        return {"ok": True, "advertised": len(entries)}
+
+    async def _pm_withdraw(self, prefixes: list, ptype="BREEZE") -> dict:
+        """ref withdrawPrefixes (OpenrCtrl.thrift:307)."""
+        pt, entries = self._parse_entries(prefixes, ptype)
+        self.prefix_manager.withdraw_prefixes(entries, pt)
+        return {"ok": True, "withdrawn": len(entries)}
+
+    async def _pm_withdraw_by_type(self, ptype) -> dict:
+        """ref withdrawPrefixesByType (OpenrCtrl.thrift:314)."""
+        self.prefix_manager.withdraw_prefixes_by_type(
+            self._parse_prefix_type(ptype)
+        )
+        return {"ok": True}
+
+    async def _pm_sync_by_type(self, prefixes: list, ptype) -> dict:
+        """ref syncPrefixesByType (OpenrCtrl.thrift:323): the given set
+        REPLACES everything of that type."""
+        pt, entries = self._parse_entries(prefixes, ptype)
+        self.prefix_manager.sync_prefixes_by_type(entries, pt)
+        return {"ok": True, "synced": len(entries)}
+
+    async def _spark_flood_restarting(self) -> dict:
+        """ref floodRestartingMsg: graceful-restart hellos out of every
+        interface now (operator-initiated GR prep)."""
+        await self.spark.send_restarting_hellos()
+        return {"ok": True}
 
     async def _get_config(self) -> dict:
         """Running config dump (ref getRunningConfig)."""

@@ -434,6 +434,15 @@ class Decision(Actor):
     async def get_received_routes(self):
         return self.prefix_state.received_routes()
 
+    async def get_prefix_dbs(self):
+        """Announcer -> area -> prefix -> entry, as Decision currently
+        sees the network (ref getDecisionPrefixDbs)."""
+        out: dict = {}
+        for prefix, entries in self.prefix_state.prefixes().items():
+            for (node, area), entry in entries.items():
+                out.setdefault(node, {}).setdefault(area, {})[prefix] = entry
+        return out
+
     _RIB_POLICY_KEY = "rib-policy"
 
     def _save_rib_policy(self) -> None:

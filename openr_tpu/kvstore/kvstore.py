@@ -960,6 +960,27 @@ class KvStore(Actor):
         """Locally-originated write (ctrl API path)."""
         self._merge_and_flood(Publication(key_vals=dict(key_vals), area=area))
 
+    async def dump_hashes(self, area: str, prefix: str = "") -> dict[str, Value]:
+        """Hash-only view (the anti-entropy comparison dump) — same
+        stripping the peer-facing kvstore.dump_hashes RPC uses."""
+        st = self.areas[area]
+        filters = KvStoreFilters(key_prefixes=(prefix,) if prefix else ())
+        return dump_hash_with_filters(area, st.kv, filters).key_vals
+
+    def get_area_summary(self) -> dict[str, dict]:
+        """ref getKvStoreAreaSummary: per-area key count, payload bytes,
+        peer names."""
+        return {
+            area: {
+                "key_count": len(st.kv),
+                "size_bytes": sum(
+                    len(v.value or b"") for v in st.kv.values()
+                ),
+                "peers": sorted(st.peers),
+            }
+            for area, st in self.areas.items()
+        }
+
     def get_peers(self, area: str) -> dict[str, PeerSpec]:
         st = self.areas[area]
         return {

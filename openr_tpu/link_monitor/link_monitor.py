@@ -88,6 +88,12 @@ class LinkMonitorState:
     overloaded_links: list[str] = field(default_factory=list)
     link_metric_overrides: dict[str, int] = field(default_factory=dict)
     node_metric_increment: int = 0
+    # per-adjacency metric overrides, keyed "if_name|neighbor" (ref
+    # setAdjacencyMetric, OpenrCtrl.thrift:581)
+    adj_metric_overrides: dict[str, int] = field(default_factory=dict)
+    # per-interface hard-drain metric increments (ref
+    # setInterfaceMetricIncrement, OpenrCtrl.thrift:568)
+    link_metric_increments: dict[str, int] = field(default_factory=dict)
 
 
 @dataclass
@@ -304,7 +310,16 @@ class LinkMonitor(Actor):
             if a != area or not adj.kvstore_synced:
                 continue
             ev = adj.event
-            metric = self.state.link_metric_overrides.get(if_name, adj.metric)
+            # precedence: per-adjacency override > per-link override >
+            # measured; per-link increments apply on top (ref
+            # LinkMonitor.cpp getLinkMetric semantics)
+            metric = self.state.adj_metric_overrides.get(
+                f"{if_name}|{node}",
+                self.state.link_metric_overrides.get(if_name, adj.metric),
+            )
+            metric = max(
+                1, metric + self.state.link_metric_increments.get(if_name, 0)
+            )
             adjs.append(
                 Adjacency(
                     other_node_name=node,
@@ -425,6 +440,51 @@ class LinkMonitor(Actor):
             self.state.link_metric_overrides[if_name] = metric
         self._save_state()
         self._advertise_throttled()
+
+    async def set_adjacency_metric(
+        self, if_name: str, neighbor: str, metric: Optional[int] = None
+    ) -> None:
+        """Per-adjacency override (ref setAdjacencyMetric/
+        unsetAdjacencyMetric, OpenrCtrl.thrift:581-586); None unsets."""
+        key = f"{if_name}|{neighbor}"
+        if metric is None:
+            self.state.adj_metric_overrides.pop(key, None)
+        else:
+            self.state.adj_metric_overrides[key] = metric
+        self._save_state()
+        self._advertise_throttled()
+
+    async def set_node_metric_increment(self, increment: int) -> None:
+        """Soft-drain penalty advertised in the adjacency DB (ref
+        setNodeInterfaceMetricIncrement, OpenrCtrl.thrift:557); 0
+        unsets."""
+        if self.state.node_metric_increment != increment:
+            self.state.node_metric_increment = increment
+            self._save_state()
+            self._advertise_throttled()
+
+    async def set_link_metric_increment(
+        self, if_name: str, increment: int
+    ) -> None:
+        """Per-interface metric increment (ref
+        setInterfaceMetricIncrement, OpenrCtrl.thrift:568); 0 unsets."""
+        if increment:
+            self.state.link_metric_increments[if_name] = increment
+        else:
+            self.state.link_metric_increments.pop(if_name, None)
+        self._save_state()
+        self._advertise_throttled()
+
+    async def get_adjacencies(self, area: Optional[str] = None) -> list:
+        """Advertised adjacency DBs (ref getLinkMonitorAdjacencies)."""
+        areas = (
+            [area]
+            if area is not None
+            else sorted(
+                self._known_areas | {a for a, _, _ in self.adjacencies}
+            )
+        )
+        return [self.build_adjacency_database(a) for a in areas]
 
     async def get_interfaces(self) -> dict[str, InterfaceInfo]:
         return {name: st.info for name, st in self.interfaces.items()}

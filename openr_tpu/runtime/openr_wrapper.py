@@ -72,6 +72,7 @@ class OpenrWrapper:
         origination_policy: str = "",
         plugins: Optional[list[str]] = None,
         running_config=None,
+        monitor=None,
     ):
         self.node_name = node_name
         self.kv_ports = kv_ports  # shared node -> kvstore port registry
@@ -138,6 +139,8 @@ class OpenrWrapper:
         self._enable_ctrl = enable_ctrl
         self._ctrl_port = ctrl_port
         self._running_config = running_config
+        self._persistent_store = persistent_store
+        self._monitor = monitor
         self.plugin_host = None
         if plugins:
             from openr_tpu.plugins import PluginArgs, PluginHost
@@ -211,6 +214,8 @@ class OpenrWrapper:
                 fib_updates_queue=self.fib_updates_queue,
                 listen_port=self._ctrl_port,
                 config=self._running_config,
+                persistent_store=self._persistent_store,
+                monitor=self._monitor,
             )
             await self.ctrl.start()
 

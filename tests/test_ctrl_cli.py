@@ -217,6 +217,40 @@ class TestBreezeCli:
             res = runner.invoke(cli, base + ["tech-support"], obj={})
             assert res.exit_code == 0, res.output
             assert "PROGRAMMED ROUTES" in res.output
+
+            # operator injection end-to-end through the CLI
+            res = runner.invoke(
+                cli,
+                base + ["prefixmgr", "advertise", "10.77.0.0/24"],
+                obj={},
+            )
+            assert res.exit_code == 0, res.output
+            res = runner.invoke(cli, base + ["prefixmgr", "view"], obj={})
+            assert res.exit_code == 0 and "10.77.0.0/24" in res.output
+            res = runner.invoke(
+                cli,
+                base + ["prefixmgr", "withdraw", "10.77.0.0/24"],
+                obj={},
+            )
+            assert res.exit_code == 0, res.output
+
+            res = runner.invoke(
+                cli,
+                base + ["lm", "set-adj-metric", "if-ab", "node-b", "55"],
+                obj={},
+            )
+            assert res.exit_code == 0, res.output
+            res = runner.invoke(cli, base + ["lm", "adjacencies"], obj={})
+            assert res.exit_code == 0 and "55" in res.output
+
+            res = runner.invoke(
+                cli,
+                base + ["kvstore", "set-key", "op:x", "v", "--ttl", "60000"],
+                obj={},
+            )
+            assert res.exit_code == 0, res.output
+            res = runner.invoke(cli, base + ["kvstore", "areas"], obj={})
+            assert res.exit_code == 0 and "key_count" in res.output
         finally:
             loop_holder["loop"].call_soon_threadsafe(stop.set)
             t.join(timeout=30)
@@ -289,6 +323,160 @@ class TestLongPollAndDryrun:
             )
             assert bad["ok"] is False
             assert "solver_backend" in bad["error"]
+        finally:
+            await client.close()
+            await a.stop()
+            await b.stop()
+
+
+class TestOperatorInjection:
+    """Prefix injection + adjacency overrides (ref advertisePrefixes /
+    setAdjacencyMetric, OpenrCtrl.thrift:299-314, 581-586)."""
+
+    @run_async
+    async def test_advertise_withdraw_network_wide(self):
+        """breeze prefixmgr advertise on node-a must produce a route on
+        node-b; withdraw must remove it."""
+        mesh, a, b = await start_two_node()
+        client = RpcClient("127.0.0.1", a.ctrl.port)
+        try:
+            res = await client.request(
+                "ctrl.prefixmgr.advertise",
+                {"prefixes": ["10.9.0.0/24"], "ptype": "BREEZE"},
+            )
+            assert res["advertised"] == 1
+            await wait_until(
+                lambda: "10.9.0.0/24" in b.fib_routes, timeout_s=20
+            )
+            # visible in by-type introspection
+            by_type = await client.request(
+                "ctrl.prefixmgr.prefixes_by_type", {"ptype": "BREEZE"}
+            )
+            assert "10.9.0.0/24" in by_type
+
+            await client.request(
+                "ctrl.prefixmgr.withdraw",
+                {"prefixes": ["10.9.0.0/24"], "ptype": "BREEZE"},
+            )
+            await wait_until(
+                lambda: "10.9.0.0/24" not in b.fib_routes, timeout_s=20
+            )
+        finally:
+            await client.close()
+            await a.stop()
+            await b.stop()
+
+    @run_async
+    async def test_withdraw_by_type_and_sync(self):
+        mesh, a, b = await start_two_node()
+        client = RpcClient("127.0.0.1", a.ctrl.port)
+        try:
+            await client.request(
+                "ctrl.prefixmgr.advertise",
+                {"prefixes": ["10.9.1.0/24", "10.9.2.0/24"],
+                 "ptype": "BREEZE"},
+            )
+            await wait_until(
+                lambda: "10.9.1.0/24" in b.fib_routes
+                and "10.9.2.0/24" in b.fib_routes,
+                timeout_s=20,
+            )
+            # sync replaces the whole BREEZE set
+            await client.request(
+                "ctrl.prefixmgr.sync_by_type",
+                {"prefixes": ["10.9.3.0/24"], "ptype": "BREEZE"},
+            )
+            await wait_until(
+                lambda: "10.9.3.0/24" in b.fib_routes
+                and "10.9.1.0/24" not in b.fib_routes,
+                timeout_s=20,
+            )
+            await client.request(
+                "ctrl.prefixmgr.withdraw_by_type", {"ptype": "BREEZE"}
+            )
+            await wait_until(
+                lambda: "10.9.3.0/24" not in b.fib_routes, timeout_s=20
+            )
+        finally:
+            await client.close()
+            await a.stop()
+            await b.stop()
+
+    @run_async
+    async def test_adjacency_metric_override(self):
+        """set_adj_metric overrides ONE adjacency's advertised metric;
+        unset restores the measured one."""
+        mesh, a, b = await start_two_node()
+        client = RpcClient("127.0.0.1", a.ctrl.port)
+
+        async def adj_metric():
+            dbs = await client.request("ctrl.lm.adjacencies", {"area": "0"})
+            for db in dbs:
+                for adj in db["adjacencies"]:
+                    if adj["other_node_name"] == "node-b":
+                        return adj["metric"]
+            return None
+
+        try:
+            base = await adj_metric()
+            assert base is not None
+            await client.request(
+                "ctrl.lm.set_adj_metric",
+                {"if_name": "if-ab", "neighbor": "node-b", "metric": 77},
+            )
+            assert (await adj_metric()) == 77
+            # the override propagates into the other node's RIB metric
+            await wait_until(
+                lambda: any(
+                    nh.metric == 77
+                    for nh in (
+                        a.fib_routes.get("10.0.0.2/32").nexthops
+                        if a.fib_routes.get("10.0.0.2/32")
+                        else ()
+                    )
+                ),
+                timeout_s=20,
+            )
+            await client.request(
+                "ctrl.lm.set_adj_metric",
+                {"if_name": "if-ab", "neighbor": "node-b"},
+            )
+            assert (await adj_metric()) == base
+        finally:
+            await client.close()
+            await a.stop()
+            await b.stop()
+
+    @run_async
+    async def test_kv_set_key_with_ttl_and_introspection(self):
+        mesh, a, b = await start_two_node()
+        client = RpcClient("127.0.0.1", a.ctrl.port)
+        try:
+            res = await client.request(
+                "ctrl.kvstore.set_key",
+                {"key": "operator:test", "value": "hello", "ttl_ms": 60_000},
+            )
+            assert res["ok"]
+            vals = await client.request(
+                "ctrl.kvstore.keyvals", {"keys": ["operator:test"]}
+            )
+            assert vals["operator:test"]["ttl_ms"] == 60_000
+            hashes = await client.request("ctrl.kvstore.hashes", {})
+            assert "operator:test" in hashes
+            # hash view: payload stripped, hash + version kept
+            assert not hashes["operator:test"]["value"]
+            assert hashes["operator:test"]["hash"]
+            areas = await client.request("ctrl.kvstore.areas")
+            assert areas["0"]["key_count"] >= 1
+            assert "node-b" in areas["0"]["peers"]
+
+            # misc parity introspection
+            assert (await client.request("openr.my_node_name")) == "node-a"
+            assert (await client.request("openr.initialization_converged"))
+            dur = await client.request("openr.initialization_duration")
+            assert dur is None or dur >= 0
+            info = await client.request("openr.build_info")
+            assert info["build_package"] == "openr_tpu"
         finally:
             await client.close()
             await a.stop()
