@@ -48,6 +48,11 @@ class KvstoreConfig:
     # is_flood_root): a few well-connected nodes per area should set it
     is_flood_root: bool = False
     max_parallel_initial_syncs: int = 32
+    # TLS on the peer plane (flooding + full sync) using the
+    # thrift_server certificates; peers are mutually authenticated and
+    # identity-pinned to their node names (ref secure thrift between
+    # stores)
+    enable_secure_peers: bool = False
 
 
 @dataclass

@@ -129,6 +129,8 @@ class KvStore(Actor):
         kvstore_updates_queue: ReplicateQueue,
         kvstore_events_queue: ReplicateQueue,
         listen_port: int = 0,
+        server_ssl=None,
+        client_ssl=None,
     ):
         super().__init__(f"kvstore:{node_name}")
         self.node_name = node_name
@@ -159,6 +161,13 @@ class KvStore(Actor):
         self._updates_q = kvstore_updates_queue
         self._events_q = kvstore_events_queue
         self._listen_port = listen_port
+        # TLS on the PEER plane (flooding + full sync): the reference
+        # runs inter-node thrift with SSL; plaintext protocol traffic
+        # would let any on-path host inject LSDB state. server_ssl is
+        # an ssl.SSLContext for our listener; client_ssl one for peer
+        # sessions (pinning happens via expected_peer per connection).
+        self._server_ssl = server_ssl
+        self._client_ssl = client_ssl
         self.server = RpcServer(self.name)
         self.port: int = 0
         self._parallel_sync_limit = _INITIAL_PARALLEL_SYNCS
@@ -180,7 +189,26 @@ class KvStore(Actor):
         self.server.register("kvstore.dump_filtered", self._rpc_dump_filtered)
         self.server.register("kvstore.dump_hashes", self._rpc_dump_hashes)
         self.server.register("kvstore.dual", self._rpc_dual)
-        self.port = await self.server.start(port=self._listen_port)
+        # server-side identity check: a CA-valid client must also CLAIM
+        # a node name we actually peer with — otherwise any domain
+        # member could pull another segment's LSDB under a bogus name.
+        # (A peer connecting moments before LinkMonitor registers it is
+        # rejected once and heals on the sync loop's backoff retry.)
+        peer_verifier = None
+        if self._server_ssl is not None:
+            from openr_tpu.config import cert_peer_names
+
+            def peer_verifier(cert):
+                names = cert_peer_names(cert)
+                known = {
+                    name for st in self.areas.values() for name in st.peers
+                }
+                return bool(names & known)
+
+        self.port = await self.server.start(
+            port=self._listen_port, ssl=self._server_ssl,
+            peer_verifier=peer_verifier,
+        )
         self.add_task(self._peer_updates_loop(), name=f"{self.name}.peers")
         self.add_task(self._kv_requests_loop(), name=f"{self.name}.requests")
         self.add_task(self._sync_loop(), name=f"{self.name}.sync")
@@ -201,10 +229,29 @@ class KvStore(Actor):
 
     # -- RPC server side ---------------------------------------------------
 
+    def _authorize_peer(self, area: str) -> None:
+        """Per-request authorization on the secured peer plane: the
+        caller's VERIFIED cert identity (transport truth, not the
+        request's sender_id field) must name a peer of THIS area —
+        otherwise a node valid in one area could dump or inject another
+        area's LSDB through the shared connection."""
+        if self._server_ssl is None:
+            return
+        from openr_tpu.runtime.rpc import current_peer_cert_names
+
+        names = current_peer_cert_names() or frozenset()
+        st = self.areas.get(area)
+        if st is None or not (names & set(st.peers)):
+            raise PermissionError(
+                f"peer {sorted(names)} is not a registered peer of "
+                f"area {area!r}"
+            )
+
     async def _rpc_set_key_vals(
         self, area: str, publication: dict, sender_id: str = ""
     ) -> dict:
         """Peer flood / finalize-sync ingress (ref KvStoreDb::setKeyVals)."""
+        self._authorize_peer(area)
         pub = from_plain(publication, Publication)
         pub.area = area
         counters.increment(f"kvstore.{self.node_name}.thrift.num_flood_pub")
@@ -219,6 +266,7 @@ class KvStore(Actor):
         key_val_hashes: Optional[dict] = None,
     ) -> dict:
         """Full-sync / filtered dump (ref getKvStoreKeyValsFilteredArea)."""
+        self._authorize_peer(area)
         st = self.areas[area]
         filters = KvStoreFilters(
             key_prefixes=tuple(prefixes or ()),
@@ -243,6 +291,7 @@ class KvStore(Actor):
 
     async def _rpc_dual(self, area: str, sender_id: str, msg: dict) -> dict:
         """DUAL message ingress (ref processDualMessages)."""
+        self._authorize_peer(area)
         st = self.areas.get(area)
         if st is not None and st.dual is not None:
             st.dual.handle_message(sender_id, msg)
@@ -299,6 +348,7 @@ class KvStore(Actor):
         self.add_task(send(), name=f"{self.name}.dual:{peer_name}")
 
     async def _rpc_dump_hashes(self, area: str, prefix: str = "") -> dict:
+        self._authorize_peer(area)
         st = self.areas[area]
         filters = KvStoreFilters(key_prefixes=(prefix,) if prefix else ())
         return to_plain(dump_hash_with_filters(area, st.kv, filters))
@@ -592,6 +642,21 @@ class KvStore(Actor):
             except asyncio.TimeoutError:
                 pass
 
+    def _make_peer_client(self, peer: Peer) -> RpcClient:
+        """Peer session, TLS-wrapped when the peer plane is secured; the
+        peer's certificate must claim its NODE NAME (CN/SAN identity
+        pinning — CA membership alone would let any node impersonate
+        any other)."""
+        return RpcClient(
+            peer.spec.peer_addr,
+            peer.spec.ctrl_port,
+            name=f"{self.node_name}->{peer.node_name}",
+            ssl=self._client_ssl,
+            expected_peer=(
+                peer.node_name if self._client_ssl is not None else ""
+            ),
+        )
+
     async def _full_sync(self, st: KvStoreArea, peer: Peer) -> None:
         """3-way full sync, initiator side (ref requestThriftPeerSync
         KvStore.cpp:1838, processThriftSuccess :1974, finalizeFullSync
@@ -599,11 +664,7 @@ class KvStore(Actor):
         t0 = time.monotonic()
         try:
             if peer.client is None:
-                peer.client = RpcClient(
-                    peer.spec.peer_addr,
-                    peer.spec.ctrl_port,
-                    name=f"{self.node_name}->{peer.node_name}",
-                )
+                peer.client = self._make_peer_client(peer)
             hashes = {k: to_plain(v) for k, v in st.hashes().items()}
             resp = await peer.client.request(
                 "kvstore.dump_filtered",

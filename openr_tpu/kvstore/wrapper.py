@@ -34,6 +34,8 @@ class KvStoreWrapper:
         node_name: str,
         areas: Optional[list[str]] = None,
         config: Optional[KvstoreConfig] = None,
+        server_ssl=None,
+        client_ssl=None,
     ):
         self.node_name = node_name
         self.areas = areas or ["0"]
@@ -49,6 +51,8 @@ class KvStoreWrapper:
             self.kv_request_queue.get_reader(),
             self.updates_queue,
             self.events_queue,
+            server_ssl=server_ssl,
+            client_ssl=client_ssl,
         )
         # test-facing reader created before start so no update is missed
         self.updates_reader = self.updates_queue.get_reader("test")

@@ -457,7 +457,11 @@ class LinkMonitor(Actor):
     async def set_node_metric_increment(self, increment: int) -> None:
         """Soft-drain penalty advertised in the adjacency DB (ref
         setNodeInterfaceMetricIncrement, OpenrCtrl.thrift:557); 0
-        unsets."""
+        unsets. Negative increments are rejected — they would advertise
+        sub-zero path costs fleet-wide (the reference API refuses them
+        too)."""
+        if increment < 0:
+            raise ValueError("metric increment must be >= 0")
         if self.state.node_metric_increment != increment:
             self.state.node_metric_increment = increment
             self._save_state()
@@ -467,7 +471,10 @@ class LinkMonitor(Actor):
         self, if_name: str, increment: int
     ) -> None:
         """Per-interface metric increment (ref
-        setInterfaceMetricIncrement, OpenrCtrl.thrift:568); 0 unsets."""
+        setInterfaceMetricIncrement, OpenrCtrl.thrift:568); 0 unsets;
+        negative rejected."""
+        if increment < 0:
+            raise ValueError("metric increment must be >= 0")
         if increment:
             self.state.link_metric_increments[if_name] = increment
         else:

@@ -199,9 +199,7 @@ async def run_daemon(args) -> None:
         oc.monitor_config,
         node.log_sample_queue.get_reader("monitor"),
     )
-    # the monitor consumes the wrapper's log-sample queue, so it is
-    # created after the wrapper; hand it to the ctrl server before start
-    node._monitor = monitor
+    node.set_monitor(monitor)
 
     # -- start (ref start order Main.cpp) ---------------------------------
     if watchdog is not None:

@@ -91,14 +91,43 @@ class OpenrWrapper:
         self.prefix_updates_queue = ReplicateQueue(f"{node_name}.prefixUpdates")
         self.log_sample_queue = ReplicateQueue(f"{node_name}.logSamples")
 
+        kv_cfg = kvstore_config or KvstoreConfig()
+        kv_server_ssl = kv_client_ssl = None
+        if kv_cfg.enable_secure_peers:
+            # peer-plane TLS reuses the ctrl-plane certificates; the CA
+            # is mandatory (mutual auth — unauthenticated flooding would
+            # let any on-path host inject LSDB state)
+            from openr_tpu.config import (
+                ConfigError,
+                build_client_ssl_context,
+                build_server_ssl_context,
+            )
+
+            if running_config is None:
+                raise ConfigError(
+                    "kvstore enable_secure_peers needs the running config "
+                    "(thrift_server certificate paths)"
+                )
+            ts = running_config.raw.thrift_server
+            if not ts.x509_ca_path:
+                raise ConfigError(
+                    "kvstore enable_secure_peers requires x509_ca_path "
+                    "(mutual auth on the peer plane)"
+                )
+            kv_server_ssl = build_server_ssl_context(ts)
+            kv_client_ssl = build_client_ssl_context(
+                ts.x509_ca_path, ts.x509_cert_path, ts.x509_key_path
+            )
         self.kvstore = KvStore(
             node_name,
-            kvstore_config or KvstoreConfig(),
+            kv_cfg,
             areas,
             self.peer_updates_queue.get_reader(),
             self.kv_request_queue.get_reader(),
             self.kvstore_updates_queue,
             self.kvstore_events_queue,
+            server_ssl=kv_server_ssl,
+            client_ssl=kv_client_ssl,
         )
         self.spark = Spark(
             node_name,
@@ -180,6 +209,14 @@ class OpenrWrapper:
             retry_initial_backoff_s=0.02,
             retry_max_backoff_s=0.2,
         )
+
+    def set_monitor(self, monitor) -> None:
+        """Attach the Monitor actor for ctrl event-log introspection.
+        The monitor consumes this wrapper's log-sample queue, so it is
+        constructed after the wrapper; call before start()."""
+        self._monitor = monitor
+        if self.ctrl is not None:
+            self.ctrl.monitor = monitor
 
     async def start(self, *interfaces: str) -> None:
         """Reference start order (Main.cpp): kvstore -> link-monitor ->

@@ -20,12 +20,27 @@ with {"id": n, "done": true}.
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import itertools
 import json
 import logging
 from typing import Any, Awaitable, Callable, Optional
 
 log = logging.getLogger(__name__)
+
+# names the current connection's TLS peer certificate claims (CN/SAN);
+# None on plaintext connections. Dispatch tasks inherit the connection
+# handler's context, so handlers can bind authorization decisions to the
+# VERIFIED transport identity instead of trusting request payloads.
+_peer_cert_names: contextvars.ContextVar[Optional[frozenset]] = (
+    contextvars.ContextVar("rpc_peer_cert_names", default=None)
+)
+
+
+def current_peer_cert_names() -> Optional[frozenset]:
+    """CN/SAN names of the calling connection's verified client cert,
+    or None when the connection is not mutually-authenticated TLS."""
+    return _peer_cert_names.get()
 
 _MAX_FRAME = 256 * 1024 * 1024  # generous: full-sync dumps can be large
 
@@ -131,9 +146,13 @@ class RpcServer:
         if task is not None:
             self._conn_tasks.add(task)
             task.add_done_callback(self._conn_tasks.discard)
+        ssl_obj = writer.get_extra_info("ssl_object")
+        cert = ssl_obj.getpeercert() if ssl_obj is not None else None
+        if cert:
+            from openr_tpu.config import cert_peer_names
+
+            _peer_cert_names.set(frozenset(cert_peer_names(cert)))
         if self._peer_verifier is not None:
-            ssl_obj = writer.get_extra_info("ssl_object")
-            cert = ssl_obj.getpeercert() if ssl_obj is not None else None
             if not self._peer_verifier(cert):
                 log.warning(
                     "%s: rejecting connection — peer cert not in "

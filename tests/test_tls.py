@@ -185,3 +185,215 @@ async def test_client_pins_server_identity(pki):
             await pinned_right.close()
     finally:
         await server.stop()
+
+
+# -- KvStore peer plane ------------------------------------------------------
+
+import asyncio
+
+from openr_tpu.config import build_server_ssl_context
+from openr_tpu.kvstore.wrapper import KvStoreWrapper, wait_until
+from openr_tpu.types import KvStorePeerState
+
+
+@pytest.fixture(scope="module")
+def node_pki(tmp_path_factory):
+    """CA + per-node certs (CN = node name), plus a rogue CA, a
+    rogue-signed cert, an expired cert, and a wrong-name cert."""
+    d = tmp_path_factory.mktemp("node_pki")
+
+    def sh(*args):
+        subprocess.run(args, check=True, capture_output=True)
+
+    def mk_ca(name):
+        sh("openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+           "-keyout", str(d / f"{name}.key"), "-out", str(d / f"{name}.crt"),
+           "-days", "1", "-subj", f"/CN={name}")
+
+    def mk_cert(name, cn, ca="ca", days="1"):
+        sh("openssl", "req", "-newkey", "rsa:2048", "-nodes",
+           "-keyout", str(d / f"{name}.key"), "-out", str(d / f"{name}.csr"),
+           "-subj", f"/CN={cn}")
+        sh("openssl", "x509", "-req", "-in", str(d / f"{name}.csr"),
+           "-CA", str(d / f"{ca}.crt"), "-CAkey", str(d / f"{ca}.key"),
+           "-CAcreateserial", "-out", str(d / f"{name}.crt"), "-days", days)
+
+    mk_ca("ca")
+    mk_ca("rogue-ca")
+    for node in ("storeA", "storeB"):
+        mk_cert(node, node)
+    mk_cert("rogue", "storeB", ca="rogue-ca")  # right name, wrong CA
+    mk_cert("expired", "storeB", days="0")  # notAfter == notBefore
+    mk_cert("imposter", "not-storeB")  # right CA, wrong identity
+    return d
+
+
+def _ssl_pair(pki, cert_name, with_client_cert=True):
+    """(server_ssl, client_ssl) for one node from its cert files."""
+    from openr_tpu.config import ThriftServerConfig
+
+    ts = ThriftServerConfig(
+        enable_secure_thrift_server=True,
+        x509_cert_path=str(pki / f"{cert_name}.crt"),
+        x509_key_path=str(pki / f"{cert_name}.key"),
+        x509_ca_path=str(pki / "ca.crt"),
+    )
+    server_ssl = build_server_ssl_context(ts)
+    client_ssl = build_client_ssl_context(
+        str(pki / "ca.crt"),
+        str(pki / f"{cert_name}.crt") if with_client_cert else "",
+        str(pki / f"{cert_name}.key") if with_client_cert else "",
+    )
+    return server_ssl, client_ssl
+
+
+async def _start_secure_pair(pki, b_cert="storeB", b_client_cert=True):
+    sa, ca_ = _ssl_pair(pki, "storeA")
+    sb, cb = _ssl_pair(pki, b_cert, with_client_cert=b_client_cert)
+    a = KvStoreWrapper("storeA", server_ssl=sa, client_ssl=ca_)
+    b = KvStoreWrapper("storeB", server_ssl=sb, client_ssl=cb)
+    await a.start()
+    await b.start()
+    a.add_peer(b)
+    b.add_peer(a)
+    return a, b
+
+
+async def _stop_pair(a, b):
+    await a.stop()
+    await b.stop()
+
+
+class TestKvStorePeerTls:
+    """Mutual-auth matrix on the peer plane (ref the reference's secure
+    inter-store thrift): flooding + full sync over TLS; every broken
+    credential must keep the peer session down and the data out."""
+
+    @run_async
+    async def test_sync_and_flood_over_tls(self, node_pki):
+        a, b = await _start_secure_pair(node_pki)
+        try:
+            await wait_until(
+                lambda: (p := a.store.get_peers("0").get("storeB"))
+                is not None
+                and p.state == KvStorePeerState.INITIALIZED
+            )
+            # full sync + incremental flooding both ride TLS sessions
+            a.set_key("secure-key", b"v1")
+            await wait_until(lambda: b.get_key("secure-key") is not None)
+            assert b.get_key("secure-key").value == b"v1"
+        finally:
+            await _stop_pair(a, b)
+
+    @run_async
+    async def test_wrong_ca_peer_never_syncs(self, node_pki):
+        a, b = await _start_secure_pair(node_pki, b_cert="rogue")
+        try:
+            a.set_key("secret", b"v")
+            await asyncio.sleep(1.0)
+            assert b.get_key("secret") is None
+            assert (
+                a.store.get_peers("0")["storeB"].state
+                != KvStorePeerState.INITIALIZED
+            )
+        finally:
+            await _stop_pair(a, b)
+
+    @run_async
+    async def test_expired_cert_peer_never_syncs(self, node_pki):
+        a, b = await _start_secure_pair(node_pki, b_cert="expired")
+        try:
+            a.set_key("secret", b"v")
+            await asyncio.sleep(1.0)
+            assert b.get_key("secret") is None
+        finally:
+            await _stop_pair(a, b)
+
+    @run_async
+    async def test_certless_peer_cannot_pull(self, node_pki):
+        # B presents no client certificate: A's server (CERT_REQUIRED)
+        # refuses B's connections, so B can never complete a sync. (B
+        # may still RECEIVE pushes — its own server cert authenticates
+        # it as a domain member.)
+        a, b = await _start_secure_pair(node_pki, b_client_cert=False)
+        try:
+            await asyncio.sleep(1.0)
+            peer = b.store.get_peers("0").get("storeA")
+            assert (
+                peer is None
+                or peer.state != KvStorePeerState.INITIALIZED
+            )
+        finally:
+            await _stop_pair(a, b)
+
+    @run_async
+    async def test_identity_mismatch_rejected_by_pin(self, node_pki):
+        # B's cert is CA-valid but claims another node's name: A's
+        # client-side pin (expected_peer == peer node name) rejects it
+        a, b = await _start_secure_pair(node_pki, b_cert="imposter")
+        try:
+            a.set_key("secret", b"v")
+            await asyncio.sleep(1.0)
+            # A cannot push to B (pin rejects B's server identity)
+            assert b.get_key("secret") is None
+            assert (
+                a.store.get_peers("0")["storeB"].state
+                != KvStorePeerState.INITIALIZED
+            )
+        finally:
+            await _stop_pair(a, b)
+
+
+class TestSecurePeersConfigPath:
+    """The enable_secure_peers config flag through OpenrWrapper."""
+
+    def test_wrapper_builds_peer_contexts_from_config(self, node_pki):
+        from openr_tpu.config import Config, KvstoreConfig, OpenrConfig
+        from openr_tpu.runtime.openr_wrapper import OpenrWrapper
+        from openr_tpu.spark import MockIoMesh
+
+        cfg = Config(
+            OpenrConfig(
+                node_name="storeA",
+                thrift_server=ThriftServerConfig(
+                    x509_cert_path=str(node_pki / "storeA.crt"),
+                    x509_key_path=str(node_pki / "storeA.key"),
+                    x509_ca_path=str(node_pki / "ca.crt"),
+                ),
+            )
+        )
+        mesh = MockIoMesh()
+        w = OpenrWrapper(
+            "storeA", mesh.provider("storeA"), {},
+            kvstore_config=KvstoreConfig(enable_secure_peers=True),
+            running_config=cfg,
+        )
+        assert w.kvstore._server_ssl is not None
+        assert w.kvstore._client_ssl is not None
+
+    def test_secure_peers_without_ca_is_config_error(self, node_pki):
+        from openr_tpu.config import (
+            Config,
+            ConfigError,
+            KvstoreConfig,
+            OpenrConfig,
+        )
+        from openr_tpu.runtime.openr_wrapper import OpenrWrapper
+        from openr_tpu.spark import MockIoMesh
+
+        cfg = Config(
+            OpenrConfig(
+                node_name="storeA",
+                thrift_server=ThriftServerConfig(
+                    x509_cert_path=str(node_pki / "storeA.crt"),
+                    x509_key_path=str(node_pki / "storeA.key"),
+                ),
+            )
+        )
+        mesh = MockIoMesh()
+        with pytest.raises(ConfigError, match="x509_ca_path"):
+            OpenrWrapper(
+                "storeA", mesh.provider("storeA"), {},
+                kvstore_config=KvstoreConfig(enable_secure_peers=True),
+                running_config=cfg,
+            )
