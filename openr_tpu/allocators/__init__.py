@@ -1,5 +1,11 @@
+from openr_tpu.allocators.prepend_label import (  # noqa: F401
+    LabelRangeExhausted,
+    PrependLabelAllocator,
+)
 from openr_tpu.allocators.range_allocator import (  # noqa: F401
     ALLOC_PREFIX_MARKER,
+    STATIC_ALLOC_KEY,
     PrefixAllocator,
     RangeAllocator,
+    StaticPrefixAllocator,
 )

@@ -1,0 +1,99 @@
+"""Prepend-label allocation (ref openr/common/PrependLabelAllocator.{h,cpp}).
+
+A prepend label names a NEXT-HOP GROUP: it is advertised with a route so
+remote nodes can push the label and have this node forward the traffic
+through that group (stitching LSPs across areas/domains). Labels are
+reference-counted per next-hop set — every route sharing the group
+shares the label — and freed labels recycle most-recent-first from the
+per-family static ranges (ref MplsUtil.h:86-88).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+# ref MplsConstants::kSrV4StaticMplsRouteRange / kSrV6StaticMplsRouteRange
+V4_RANGE = (60000, 64999)
+V6_RANGE = (65000, 69999)
+
+
+class LabelRangeExhausted(RuntimeError):
+    pass
+
+
+class PrependLabelAllocator:
+    """Next-hop-set -> label with reference counting (ref
+    PrependLabelAllocator.h:24)."""
+
+    def __init__(
+        self,
+        v4_range: tuple[int, int] = V4_RANGE,
+        v6_range: tuple[int, int] = V6_RANGE,
+    ):
+        self._ranges = {True: v4_range, False: v6_range}
+        self._next = {True: v4_range[0], False: v6_range[0]}
+        # last element = most recently freed (reused first, ref .h:83)
+        self._freed: dict[bool, list[int]] = {True: [], False: []}
+        # frozenset(next-hop addresses) -> [refcount, label]
+        self._by_set: dict[frozenset, list[int]] = {}
+
+    @staticmethod
+    def _key(next_hop_set: Iterable[str]) -> frozenset:
+        return frozenset(next_hop_set)
+
+    @staticmethod
+    def _is_v4(key: frozenset) -> bool:
+        return bool(key) and all("." in a for a in key)
+
+    def increment_ref_count(
+        self, next_hop_set: Iterable[str]
+    ) -> tuple[Optional[int], bool]:
+        """-> (label, newly_allocated). A known set bumps its refcount
+        and returns the existing label; a new set gets a recycled or
+        fresh label from its family's range. Empty sets get no label."""
+        key = self._key(next_hop_set)
+        if not key:
+            return None, False
+        entry = self._by_set.get(key)
+        if entry is not None:
+            entry[0] += 1
+            return entry[1], False
+        label = self._new_label(self._is_v4(key))
+        self._by_set[key] = [1, label]
+        return label, True
+
+    def decrement_ref_count(
+        self, next_hop_set: Iterable[str]
+    ) -> Optional[int]:
+        """-> the label to DELETE when the last reference drops (the
+        caller removes its MPLS route); None while still referenced."""
+        key = self._key(next_hop_set)
+        if not key:
+            return None
+        entry = self._by_set.get(key)
+        if entry is None:
+            return None
+        entry[0] -= 1
+        if entry[0] > 0:
+            return None
+        del self._by_set[key]
+        label = entry[1]
+        self._freed[self._is_v4(key)].append(label)
+        return label
+
+    def get_label(self, next_hop_set: Iterable[str]) -> Optional[int]:
+        entry = self._by_set.get(self._key(next_hop_set))
+        return None if entry is None else entry[1]
+
+    def _new_label(self, is_v4: bool) -> int:
+        freed = self._freed[is_v4]
+        if freed:
+            return freed.pop()  # most recently freed first (ref .cpp)
+        label = self._next[is_v4]
+        lo, hi = self._ranges[is_v4]
+        if label > hi:
+            raise LabelRangeExhausted(
+                f"prepend label range [{lo}, {hi}] exhausted"
+            )
+        self._next[is_v4] = label + 1
+        return label

@@ -172,7 +172,61 @@ class RangeAllocator(Actor):
                 self._lost()
 
 
-class PrefixAllocator(Actor):
+class _LoopbackAddressMixin:
+    """Shared 'write the derived address to the interface' behavior
+    (ref PrefixAllocator applying the loopback address via netlink)."""
+
+    loopback_iface: str = ""
+    set_loopback_address: bool = False
+    assigned_address: Optional[str] = None
+
+    def _maybe_assign_address(self, allocated_prefix: str) -> None:
+        if not (self.set_loopback_address and self.loopback_iface):
+            return
+        self.add_task(
+            self._assign_address(allocated_prefix),
+            name=f"{self.name}.assign-addr",
+        )
+
+    async def _assign_address(self, allocated_prefix: str) -> None:
+        """Best-effort: install the allocation's first host address on
+        the loopback interface, REMOVING the previous allocation's
+        address first — a lost index now belongs to another node, and
+        answering for its prefix would be an address conflict (ref
+        PrefixAllocator.cpp syncIfaceAddrs removes stale addrs).
+        Needs CAP_NET_ADMIN; failure logs and moves on — advertising the
+        prefix does not depend on the local address."""
+        import socket as _socket
+
+        from openr_tpu.platform.netlink import NetlinkRouteSocket
+
+        net = parse_prefix(allocated_prefix)
+        host = net.network_address + (1 if net.num_addresses > 1 else 0)
+        addr = f"{host}/{net.prefixlen}"
+        nl = NetlinkRouteSocket()
+        try:
+            nl.open()
+            ifindex = _socket.if_nametoindex(self.loopback_iface)
+            if self.assigned_address and self.assigned_address != addr:
+                try:
+                    await nl.del_addr(ifindex, self.assigned_address)
+                except OSError:
+                    pass  # already gone
+            await nl.add_addr(ifindex, addr)
+            self.assigned_address = addr
+            log.info(
+                "%s: assigned %s to %s", self.name, addr, self.loopback_iface
+            )
+        except OSError as e:
+            log.warning(
+                "%s: could not assign %s to %s: %s",
+                self.name, addr, self.loopback_iface, e,
+            )
+        finally:
+            nl.close()
+
+
+class PrefixAllocator(_LoopbackAddressMixin, Actor):
     """Derive the node's prefix from (seed prefix, allocated index) and
     advertise it (ref PrefixAllocator.h:35, SEEDED mode)."""
 
@@ -185,6 +239,8 @@ class PrefixAllocator(Actor):
         seed_prefix: str,
         allocate_prefix_len: int,
         area: str = "0",
+        loopback_iface: str = "",
+        set_loopback_address: bool = False,
         **allocator_kwargs,
     ):
         super().__init__(f"prefix-allocator:{node_name}")
@@ -198,6 +254,8 @@ class PrefixAllocator(Actor):
         n_subnets = 1 << (self.alloc_len - self.seed.prefixlen)
         self._prefix_q = prefix_updates_queue
         self.allocated_prefix: Optional[str] = None
+        self.loopback_iface = loopback_iface
+        self.set_loopback_address = set_loopback_address
         self.range_allocator = RangeAllocator(
             node_name,
             kvstore,
@@ -243,4 +301,102 @@ class PrefixAllocator(Actor):
                 ],
             )
         )
+        self._maybe_assign_address(self.allocated_prefix)
         counters.increment("prefix_allocator.allocations")
+
+
+STATIC_ALLOC_KEY = "e2e-network-allocations"  # ref kStaticPrefixAllocParamKey
+
+
+class StaticPrefixAllocator(_LoopbackAddressMixin, Actor):
+    """STATIC allocation mode (ref PrefixAllocator.h:88-101
+    staticAllocation / processStaticPrefixAllocUpdate): a central
+    controller publishes the `e2e-network-allocations` KvStore key —
+    JSON {node_name: prefix} — and each node advertises (and optionally
+    installs) whatever the controller assigned it. Changes re-sync; a
+    removed assignment withdraws."""
+
+    def __init__(
+        self,
+        node_name: str,
+        kvstore: KvStore,
+        kvstore_updates_reader: RQueue,
+        prefix_updates_queue: ReplicateQueue,
+        area: str = "0",
+        loopback_iface: str = "",
+        set_loopback_address: bool = False,
+    ):
+        super().__init__(f"static-prefix-allocator:{node_name}")
+        self.node_name = node_name
+        self.kvstore = kvstore
+        self._updates = kvstore_updates_reader
+        self._prefix_q = prefix_updates_queue
+        self.area = area
+        self.allocated_prefix: Optional[str] = None
+        self.loopback_iface = loopback_iface
+        self.set_loopback_address = set_loopback_address
+
+    async def on_start(self) -> None:
+        # initial read: the key may predate us
+        vals = await self.kvstore.get_key_vals(
+            self.area, [STATIC_ALLOC_KEY]
+        )
+        val = vals.get(STATIC_ALLOC_KEY)
+        if val is not None:
+            self._apply(val.value)
+        self.add_task(self._watch(), name=f"{self.name}.watch")
+
+    async def _watch(self) -> None:
+        while True:
+            pub = await self._updates.get()
+            if not isinstance(pub, Publication) or pub.area != self.area:
+                continue
+            val = pub.key_vals.get(STATIC_ALLOC_KEY)
+            if val is not None:
+                # ttl-only refreshes carry value=None (engine merge
+                # update_ttl) — they are NOT withdrawals
+                if val.value is not None:
+                    self._apply(val.value)
+            elif STATIC_ALLOC_KEY in pub.expired_keys:
+                self._apply(None)
+
+    def _apply(self, raw: Optional[bytes]) -> None:
+        import json
+
+        assigned: Optional[str] = None
+        if raw:
+            try:
+                allocations = json.loads(raw)
+                assigned = allocations.get(self.node_name)
+                if assigned is not None:
+                    assigned = str(parse_prefix(assigned))
+            except (ValueError, TypeError, AttributeError):
+                log.warning(
+                    "%s: malformed %s payload", self.name, STATIC_ALLOC_KEY
+                )
+                return  # keep the last good assignment
+        if assigned == self.allocated_prefix:
+            return
+        self.allocated_prefix = assigned
+        entries = (
+            [
+                PrefixEntry(
+                    prefix=assigned, type=PrefixType.PREFIX_ALLOCATOR
+                )
+            ]
+            if assigned
+            else []
+        )
+        self._prefix_q.push(
+            PrefixEvent(
+                event_type=PrefixEventType.SYNC_PREFIXES_BY_TYPE,
+                type=PrefixType.PREFIX_ALLOCATOR,
+                prefixes=entries,
+            )
+        )
+        if assigned:
+            log.info("%s: static allocation %s", self.name, assigned)
+            self._maybe_assign_address(assigned)
+            counters.increment("prefix_allocator.static_allocations")
+        else:
+            log.info("%s: static allocation withdrawn", self.name)

@@ -204,10 +204,21 @@ class Decision(Actor):
             self.process_static_routes_update(update)
 
     def process_static_routes_update(self, update: DecisionRouteUpdate) -> None:
-        """PrefixManager-sourced static routes (ref Decision.cpp:873)."""
+        """PrefixManager-sourced static routes (ref Decision.cpp:873);
+        carries prepend-label MPLS routes too (the allocator's local
+        label -> next-hop-group bindings)."""
         self.solver.update_static_unicast_routes(
             update.unicast_routes_to_update, update.unicast_routes_to_delete
         )
+        if update.mpls_routes_to_update or update.mpls_routes_to_delete:
+            self.solver.update_static_mpls_routes(
+                update.mpls_routes_to_update, update.mpls_routes_to_delete
+            )
+            # static MPLS routes merge into the DB only in build_route_db
+            # — the incremental branch copies the old mpls dict verbatim,
+            # so a label change must force the full path or it never
+            # programs (rare event: label allocation churn)
+            self.pending.needs_full_rebuild = True
         self.pending.apply_prefix_changes(
             set(update.unicast_routes_to_update)
             | set(update.unicast_routes_to_delete)

@@ -136,13 +136,17 @@ class Link:
         }
         self._adj_label = {node1: adj1.adj_label, node2: adj2.adj_label}
         self._weight = {node1: adj1.weight, node2: adj2.weight}
-        # link addresses for Fib programming: the *next hop address* from
-        # node X's perspective is the other end's link-local address; the
-        # framework uses "<other_node>@<other_if>" as the structural address
-        self._addr_v4 = {node1: "", node2: ""}
+        # next-hop addresses for Fib programming, from each node's
+        # perspective = the OTHER end's advertised link address (ref
+        # Types.thrift nextHopV6/nextHopV4); emulation adjacencies carry
+        # none, so a structural placeholder keeps tests deterministic
+        self._addr_v4 = {
+            node1: adj1.next_hop_v4,
+            node2: adj2.next_hop_v4,
+        }
         self._addr_v6 = {
-            node1: f"fe80::{node2}%{adj2.if_name}",
-            node2: f"fe80::{node1}%{adj1.if_name}",
+            node1: adj1.next_hop_v6 or f"fe80::{node2}%{adj2.if_name}",
+            node2: adj2.next_hop_v6 or f"fe80::{node1}%{adj1.if_name}",
         }
         self._sort_key = (self.n1, self.if1, self.n2, self.if2)
 
@@ -182,6 +186,19 @@ class Link:
 
     def nh_v6_from_node(self, node: str) -> str:
         """Next-hop address when forwarding *from* node over this link."""
+        return self._addr_v6[node]
+
+    def nh_v4_from_node(self, node: str) -> str:
+        return self._addr_v4[node]
+
+    def nh_from_node(self, node: str, is_v4: bool) -> str:
+        """Family-aware next hop (ref createNextHop: v4 prefixes take
+        the v4 address unless v4-over-v6 is configured; missing v4
+        falls back to v6)."""
+        if is_v4:
+            a = self._addr_v4[node]
+            if a:
+                return a
         return self._addr_v6[node]
 
     def is_up(self) -> bool:
@@ -406,6 +423,11 @@ class LinkState:
         # Monotonic change counter: bumps on any applied change so derived
         # mirrors (ops/ device arrays) know when to refresh.
         self.generation = 0
+        # bumps when a link's next-hop ADDRESS changes in place (no
+        # topology change): vantage route caches hold materialized
+        # addresses and must rebuild (tpu_solver folds this into its
+        # cache key)
+        self.nh_addr_version = 0
         # Bounded changelog of (generation, event) consumed by device
         # mirrors to apply LinkStateChange as index writes instead of full
         # rebuilds (SURVEY §5 "delta scatter updates"). Events:
@@ -648,6 +670,16 @@ class LinkState:
             if nl.weight_from_node(node) != ol.weight_from_node(node):
                 change.link_attributes_changed = True
                 ol.set_weight_from_node(node, nl.weight_from_node(node))
+            if (
+                nl.nh_v4_from_node(node) != ol.nh_v4_from_node(node)
+                or nl.nh_v6_from_node(node) != ol.nh_v6_from_node(node)
+            ):
+                # neighbor renumbered without the link flapping (e.g.
+                # graceful restart): keep forwarding to the LIVE address
+                change.link_attributes_changed = True
+                ol._addr_v4[node] = nl.nh_v4_from_node(node)
+                ol._addr_v6[node] = nl.nh_v6_from_node(node)
+                self.nh_addr_version += 1
             if link_touched:
                 ev_changed.append(ol)
             i += 1

@@ -338,7 +338,10 @@ class SpfSolver:
             if cands:
                 alt_metric, _, _, link = min(cands)
                 lfa = NextHop(
-                    address=link.nh_v6_from_node(my_node_name),
+                    address=link.nh_from_node(
+                        my_node_name,
+                        is_v4 and not self.v4_over_v6_nexthop,
+                    ),
                     if_name=link.iface_from_node(my_node_name),
                     metric=alt_metric,
                     area=link.area,
@@ -574,7 +577,10 @@ class SpfSolver:
 
                 next_hops.add(
                     NextHop(
-                        address=link.nh_v6_from_node(my_node_name),
+                        address=link.nh_from_node(
+                            my_node_name,
+                            is_v4 and not self.v4_over_v6_nexthop,
+                        ),
                         if_name=link.iface_from_node(my_node_name),
                         metric=int(dist_over_link),
                         mpls_action=mpls_action,

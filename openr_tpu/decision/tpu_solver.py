@@ -1067,6 +1067,11 @@ class TpuSpfSolver:
         shape_key = (
             plan.n_cap, plan.s_cap, r_cap, kr_cap, has_res, d_cap, p_cap, a_cap
         )
+        # the vantage cache key ALSO folds in the next-hop address
+        # version: in-place renumbering invalidates materialized routes
+        # without any shape change (the jit pipeline itself is
+        # address-free and keys on shape alone)
+        cache_key = shape_key + (link_state.nh_addr_version,)
 
         vkey = (area, my_node_name)
         if my_node_name != self.my_node_name:
@@ -1076,7 +1081,7 @@ class TpuSpfSolver:
             vs = self._vstates[vkey] = _VantageState()
         links_tuple = tuple(links)
         if (
-            vs.shape_key != shape_key
+            vs.shape_key != cache_key
             or vs.matrix_version != ad.matrix_version
             or not vs.valid
             or vs.links_tuple != links_tuple
@@ -1092,7 +1097,7 @@ class TpuSpfSolver:
                 jax.device_put(np.zeros(p_cap, np.int32)),
                 jax.device_put(np.zeros(p_cap, np.int32)),
             )
-            vs.shape_key = shape_key
+            vs.shape_key = cache_key
             vs.matrix_version = ad.matrix_version
             vs.routes = {}
             vs.nh_cache = {}
@@ -1594,6 +1599,12 @@ class TpuSpfSolver:
         routes = vs.routes
         no_lfa = frozenset()
         n_links = len(links)
+        # family-aware next-hop addresses (ref createNextHop): v4
+        # prefixes take the link's v4 address unless v4-over-v6 is on.
+        # Sliced by row — the delta path calls this for a handful of
+        # rows and must not pay an O(P) conversion.
+        v4_rows_l = matrix.is_v4[rows].tolist()
+        use_v4_allowed = not self.cpu.v4_over_v6_nexthop
         for i, p in enumerate(rows_l):
             vi = vi_l[i]
             row = s3_l[vi]
@@ -1602,13 +1613,14 @@ class TpuSpfSolver:
             if not sel:
                 continue
             m = met_l[vi]
-            key = (nh_bytes[vi * nh_stride:(vi + 1) * nh_stride], m)
+            use_v4 = use_v4_allowed and v4_rows_l[i]
+            key = (nh_bytes[vi * nh_stride:(vi + 1) * nh_stride], m, use_v4)
             nexthops = nh_cache.get(key)
             if nexthops is None:
                 nh_row = nh_l[vi]
                 nexthops = frozenset(
                     NextHop(
-                        address=links[d].nh_v6_from_node(my_node_name),
+                        address=links[d].nh_from_node(my_node_name, use_v4),
                         if_name=links[d].iface_from_node(my_node_name),
                         metric=m,
                         area=links[d].area,
@@ -1623,12 +1635,14 @@ class TpuSpfSolver:
                 d = lfa_slot_l[vi]
                 if 0 <= d < n_links:
                     alt_m = lfa_metric_l[vi]
-                    lkey = ("lfa", d, alt_m)
+                    lkey = ("lfa", d, alt_m, use_v4)
                     lfa_nexthops = nh_cache.get(lkey)
                     if lfa_nexthops is None:
                         lfa_nexthops = frozenset({
                             NextHop(
-                                address=links[d].nh_v6_from_node(my_node_name),
+                                address=links[d].nh_from_node(
+                                    my_node_name, use_v4
+                                ),
                                 if_name=links[d].iface_from_node(my_node_name),
                                 metric=alt_m,
                                 area=links[d].area,

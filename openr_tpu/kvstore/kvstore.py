@@ -129,6 +129,7 @@ class KvStore(Actor):
         kvstore_updates_queue: ReplicateQueue,
         kvstore_events_queue: ReplicateQueue,
         listen_port: int = 0,
+        listen_addr: str = "127.0.0.1",
         server_ssl=None,
         client_ssl=None,
     ):
@@ -161,6 +162,7 @@ class KvStore(Actor):
         self._updates_q = kvstore_updates_queue
         self._events_q = kvstore_events_queue
         self._listen_port = listen_port
+        self._listen_addr = listen_addr
         # TLS on the PEER plane (flooding + full sync): the reference
         # runs inter-node thrift with SSL; plaintext protocol traffic
         # would let any on-path host inject LSDB state. server_ssl is
@@ -206,8 +208,8 @@ class KvStore(Actor):
                 return bool(names & known)
 
         self.port = await self.server.start(
-            port=self._listen_port, ssl=self._server_ssl,
-            peer_verifier=peer_verifier,
+            host=self._listen_addr, port=self._listen_port,
+            ssl=self._server_ssl, peer_verifier=peer_verifier,
         )
         self.add_task(self._peer_updates_loop(), name=f"{self.name}.peers")
         self.add_task(self._kv_requests_loop(), name=f"{self.name}.requests")

@@ -330,6 +330,8 @@ class LinkMonitor(Actor):
                     rtt_us=ev.rtt_us,
                     timestamp_s=int(time.time()),
                     adj_only_used_by_other_node=ev.adj_only_used_by_other_node,
+                    next_hop_v6=ev.neighbor_addr_v6,
+                    next_hop_v4=ev.neighbor_addr_v4,
                 )
             )
         return AdjacencyDatabase(

@@ -151,14 +151,21 @@ async def run_daemon(args) -> None:
             args.ctrl_port if args.ctrl_port is not None else oc.openr_ctrl_port
         ),
         persistent_store=store,
-        # neighbors publish their kvstore endpoint in the spark handshake's
-        # dedicated kvstore_port field
-        kvstore_port_of=lambda ev: ("127.0.0.1", ev.kvstore_port),
+        # neighbors publish their kvstore port in the spark handshake;
+        # the ADDRESS is kernel truth — the UDP source the handshake
+        # arrived from (falls back to loopback for same-host emulation)
+        kvstore_port_of=lambda ev: (
+            ev.neighbor_addr_v4 or ev.neighbor_addr_v6 or "127.0.0.1",
+            ev.kvstore_port,
+        ),
         node_label=oc.segment_routing_config.node_segment_label,
         policy_manager=_build_policy_manager(oc),
         origination_policy=oc.origination_policy,
         plugins=oc.plugins,
         running_config=cfg,
+        # peers connect to the kvstore from OTHER hosts/namespaces —
+        # bind the configured listen address, not loopback
+        kv_listen_addr=oc.listen_addr,
     )
 
     # -- bring up interfaces ----------------------------------------------
@@ -214,6 +221,40 @@ async def run_daemon(args) -> None:
             "netlink interface discovery: %s",
             ", ".join(sorted(iface_mon.interfaces())) or "(none match)",
         )
+
+    # -- prefix allocator (ref Main.cpp prefix-allocator start) -----------
+    allocator = None
+    pac = oc.prefix_allocation_config
+    if pac is not None:
+        from openr_tpu.allocators import (
+            PrefixAllocator,
+            StaticPrefixAllocator,
+        )
+
+        alloc_reader = node.kvstore_updates_queue.get_reader(
+            "prefix-allocator"
+        )
+        common = dict(
+            loopback_iface=pac.loopback_interface,
+            set_loopback_address=pac.set_loopback_address,
+        )
+        if pac.prefix_allocation_mode == "STATIC":
+            allocator = StaticPrefixAllocator(
+                node_name, node.kvstore, alloc_reader,
+                node.prefix_updates_queue, **common,
+            )
+        else:
+            allocator = PrefixAllocator(
+                node_name, node.kvstore, alloc_reader,
+                node.prefix_updates_queue,
+                seed_prefix=pac.seed_prefix,
+                allocate_prefix_len=pac.allocate_prefix_len,
+                **common,
+            )
+        await allocator.start()
+        log.info(
+            "prefix allocator started (%s mode)", pac.prefix_allocation_mode
+        )
     if args.override_drain_state is not None:
         await node.link_monitor.set_node_overload(
             args.override_drain_state == "drained"
@@ -257,6 +298,8 @@ async def run_daemon(args) -> None:
 
     # graceful restart announcement, then reverse teardown
     log.info("stopping node %s", node_name)
+    if allocator is not None:
+        await allocator.stop()
     if iface_mon is not None:
         iface_mon.close()
     await node.spark.send_restarting_hellos()

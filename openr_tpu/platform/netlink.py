@@ -449,6 +449,25 @@ class NetlinkRouteSocket:
             if protocol is None or r.protocol == protocol
         ]
 
+    # -- interface addresses (ref addIfAddress/deleteIfAddress) ------------
+
+    async def add_addr(self, ifindex: int, prefix: str) -> None:
+        """Assign `addr/len` to an interface (ref NetlinkAddrMessage
+        encode; used by the prefix allocator to install the derived
+        loopback address)."""
+        await self._send(
+            RTM_NEWADDR,
+            NLM_F_REQUEST | NLM_F_ACK | NLM_F_CREATE | NLM_F_REPLACE,
+            _build_addr_msg(ifindex, prefix),
+        )
+
+    async def del_addr(self, ifindex: int, prefix: str) -> None:
+        await self._send(
+            RTM_DELADDR,
+            NLM_F_REQUEST | NLM_F_ACK,
+            _build_addr_msg(ifindex, prefix),
+        )
+
     # -- link/addr discovery (ref getAllLinks/getAllIfAddresses) -----------
 
     async def get_links(self) -> list[NlLink]:
@@ -787,6 +806,14 @@ def _parse_mpls_route_msg(body: bytes) -> Optional[NlMplsRoute]:
     return NlMplsRoute(
         label=label, nexthops=tuple(nexthops), protocol=proto
     )
+
+
+def _build_addr_msg(ifindex: int, prefix: str) -> bytes:
+    iface = ipaddress.ip_interface(prefix)
+    family = socket.AF_INET if iface.version == 4 else socket.AF_INET6
+    hdr = _IFADDRMSG.pack(family, iface.network.prefixlen, 0, 0, ifindex)
+    packed = iface.ip.packed
+    return hdr + _rta(IFA_LOCAL, packed) + _rta(IFA_ADDRESS, packed)
 
 
 def _parse_link_msg(body: bytes) -> Optional[NlLink]:

@@ -27,6 +27,7 @@ from openr_tpu.decision.rib import (
     DecisionRouteUpdate,
     NextHop,
     RibUnicastEntry,
+    RouteUpdateType,
 )
 from openr_tpu.messaging import RQueue, ReplicateQueue
 from openr_tpu.runtime.actor import Actor
@@ -72,6 +73,10 @@ class OriginatedPrefix:
     install_to_fib: bool = False
     forwarding_type: int = 0
     tags: tuple[str, ...] = ()
+    # advertise with an allocator-assigned prepend label bound to the
+    # supporting routes' next-hop group, and program the matching local
+    # MPLS route (ref PrependLabelAllocator.h:17-23 LSP stitching)
+    allocate_prepend_label: bool = False
 
 
 @dataclass
@@ -83,6 +88,9 @@ class _OriginatedState:
     # re-runs on every FIB delta — without this a denied prefix re-bumps
     # the deny counters forever
     policy_denied: bool = False
+    # prepend-label binding: the label and the next-hop set it names
+    prepend_label: Optional[int] = None
+    label_nh_set: frozenset = frozenset()
 
 
 class PrefixManager(Actor):
@@ -127,6 +135,11 @@ class PrefixManager(Actor):
         self._sync_throttle: Optional[AsyncThrottle] = None
         self._sync_throttle_s = sync_throttle_s
         self._db_synced_signalled = False
+        # prepend labels (ref PrependLabelAllocator): created on first
+        # use; bindings live on _OriginatedState
+        self._label_allocator = None
+        # programmed-route next hops, for label next-hop groups
+        self._route_nexthops: dict[str, frozenset] = {}
 
     async def on_start(self) -> None:
         self._sync_throttle = AsyncThrottle(
@@ -256,13 +269,20 @@ class PrefixManager(Actor):
         """Track programmed routes as supporting evidence for originated
         covering prefixes (ref aggregation, minimum_supporting_routes)."""
         changed = False
-        for prefix in upd.unicast_routes_to_update:
+        for prefix, entry in upd.unicast_routes_to_update.items():
+            nhs = frozenset(
+                nh.address for nh in entry.nexthops if nh.address
+            )
+            if self._route_nexthops.get(prefix) != nhs:
+                self._route_nexthops[prefix] = nhs
+                changed = True  # next-hop group may move the label
             for ostate in self.originated.values():
                 if self._supports(prefix, ostate.conf.prefix):
                     if prefix not in ostate.supporting:
                         ostate.supporting.add(prefix)
                         changed = True
         for prefix in upd.unicast_routes_to_delete:
+            self._route_nexthops.pop(prefix, None)
             for ostate in self.originated.values():
                 if prefix in ostate.supporting:
                     ostate.supporting.discard(prefix)
@@ -284,10 +304,83 @@ class PrefixManager(Actor):
             and route_net.subnet_of(cover_net)
         )
 
+    def _ensure_label_allocator(self):
+        if self._label_allocator is None:
+            from openr_tpu.allocators.prepend_label import (
+                PrependLabelAllocator,
+            )
+
+            self._label_allocator = PrependLabelAllocator()
+        return self._label_allocator
+
+    def _supporting_nexthops(self, ostate: _OriginatedState) -> frozenset:
+        """The next-hop group a prepend label names: the union of the
+        supporting routes' programmed next hops."""
+        out: set = set()
+        for prefix in ostate.supporting:
+            out |= self._route_nexthops.get(prefix, frozenset())
+        return frozenset(out)
+
+    def _bind_prepend_label(self, ostate: _OriginatedState) -> Optional[int]:
+        """(Re)bind the prefix's prepend label to its current next-hop
+        group; programs/updates the local MPLS route through the static
+        routes queue (ref PrependLabelAllocator.h:17-23)."""
+        from openr_tpu.decision.rib import RibMplsEntry
+
+        alloc = self._ensure_label_allocator()
+        nh_set = self._supporting_nexthops(ostate)
+        if nh_set == ostate.label_nh_set and ostate.prepend_label is not None:
+            return ostate.prepend_label
+        upd = DecisionRouteUpdate(type=RouteUpdateType.INCREMENTAL)
+        label, _new = alloc.increment_ref_count(nh_set)
+        if ostate.label_nh_set:
+            freed = alloc.decrement_ref_count(ostate.label_nh_set)
+            if freed is not None:
+                upd.mpls_routes_to_delete.append(freed)
+        ostate.label_nh_set = nh_set
+        ostate.prepend_label = label
+        if label is not None:
+            upd.mpls_routes_to_update[label] = RibMplsEntry(
+                label=label,
+                nexthops=frozenset(
+                    NextHop(address=a) for a in sorted(nh_set)
+                ),
+            )
+        if self._static_q is not None and not upd.empty():
+            self._static_q.push(upd)
+        return label
+
+    def _release_prepend_label(self, ostate: _OriginatedState) -> None:
+        if ostate.prepend_label is None and not ostate.label_nh_set:
+            return
+        alloc = self._ensure_label_allocator()
+        freed = alloc.decrement_ref_count(ostate.label_nh_set)
+        ostate.prepend_label = None
+        ostate.label_nh_set = frozenset()
+        if freed is not None and self._static_q is not None:
+            self._static_q.push(
+                DecisionRouteUpdate(
+                    type=RouteUpdateType.INCREMENTAL,
+                    mpls_routes_to_delete=[freed],
+                )
+            )
+
     def _evaluate_originated(self) -> None:
         for ostate in self.originated.values():
             conf = ostate.conf
             should = len(ostate.supporting) >= conf.minimum_supporting_routes
+            if should and ostate.advertised and conf.allocate_prepend_label:
+                # supporting next-hop group may have moved: rebind, and
+                # re-advertise if the label changed
+                old = ostate.prepend_label
+                label = self._bind_prepend_label(ostate)
+                if label != old:
+                    types = self.prefix_map.get(conf.prefix, {})
+                    cur = types.get(PrefixType.CONFIG)
+                    if cur is not None:
+                        types[PrefixType.CONFIG] = replace(
+                            cur, prepend_label=label
+                        )
             if should and not ostate.advertised:
                 if ostate.policy_denied:
                     continue
@@ -301,6 +394,11 @@ class PrefixManager(Actor):
                 if entry is None:
                     ostate.policy_denied = True
                     continue  # policy-denied: stays unadvertised
+                if conf.allocate_prepend_label:
+                    entry = replace(
+                        entry,
+                        prepend_label=self._bind_prepend_label(ostate),
+                    )
                 ostate.advertised = True
                 self.prefix_map.setdefault(conf.prefix, {})[
                     PrefixType.CONFIG
@@ -322,6 +420,8 @@ class PrefixManager(Actor):
                 counters.increment("prefix_manager.originated_advertised")
             elif not should and ostate.advertised:
                 ostate.advertised = False
+                if conf.allocate_prepend_label:
+                    self._release_prepend_label(ostate)
                 types = self.prefix_map.get(conf.prefix)
                 if types is not None:
                     types.pop(PrefixType.CONFIG, None)

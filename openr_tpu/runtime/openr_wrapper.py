@@ -73,6 +73,7 @@ class OpenrWrapper:
         plugins: Optional[list[str]] = None,
         running_config=None,
         monitor=None,
+        kv_listen_addr: str = "127.0.0.1",
     ):
         self.node_name = node_name
         self.kv_ports = kv_ports  # shared node -> kvstore port registry
@@ -126,6 +127,7 @@ class OpenrWrapper:
             self.kv_request_queue.get_reader(),
             self.kvstore_updates_queue,
             self.kvstore_events_queue,
+            listen_addr=kv_listen_addr,
             server_ssl=kv_server_ssl,
             client_ssl=kv_client_ssl,
         )

@@ -112,9 +112,25 @@ class RpcServer:
         connection — the reference's acceptable-peers identity check,
         which CA membership alone does not provide."""
         self._peer_verifier = peer_verifier
-        self._server = await asyncio.start_server(
-            self._handle_conn, host, port, limit=_MAX_FRAME, ssl=ssl
-        )
+        if host in ("", "::"):
+            # ONE dual-stack socket: asyncio's "::" binds V6-only, and
+            # host=None binds per-family sockets with DIFFERENT ephemeral
+            # ports — either way v4 peers would miss the advertised port
+            import socket as _socket
+
+            sock = _socket.socket(_socket.AF_INET6, _socket.SOCK_STREAM)
+            sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+            sock.setsockopt(
+                _socket.IPPROTO_IPV6, _socket.IPV6_V6ONLY, 0
+            )
+            sock.bind(("::", port))
+            self._server = await asyncio.start_server(
+                self._handle_conn, sock=sock, limit=_MAX_FRAME, ssl=ssl
+            )
+        else:
+            self._server = await asyncio.start_server(
+                self._handle_conn, host, port, limit=_MAX_FRAME, ssl=ssl
+            )
         return self._server.sockets[0].getsockname()[1]
 
     @property

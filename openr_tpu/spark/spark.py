@@ -55,6 +55,20 @@ from openr_tpu.types import (
 log = logging.getLogger(__name__)
 
 
+def _sender_ip(sender_addr: str):
+    """Parse the provider's sender address ("ip:port" for UDP; the mock
+    mesh uses "node@iface", which is not an IP) -> ip_address | None."""
+    import ipaddress
+
+    host, sep, _port = sender_addr.rpartition(":")
+    if not sep:
+        host = sender_addr
+    try:
+        return ipaddress.ip_address(host.strip("[]"))
+    except ValueError:
+        return None
+
+
 class SparkNeighEvent:
     """ref Types.thrift:37-47."""
 
@@ -551,6 +565,16 @@ class Spark(Actor):
         nb.kvstore_port = msg.kvstore_port
         nb.addr_v6 = msg.transport_address_v6
         nb.addr_v4 = msg.transport_address_v4
+        # kernel truth beats the message payload: the UDP source address
+        # the handshake ARRIVED from is where the neighbor is actually
+        # reachable (ref Spark reading the kernel's recvfrom address) —
+        # cross-namespace/real-network peering depends on it
+        sender_ip = _sender_ip(pkt.sender_addr)
+        if sender_ip is not None:
+            if sender_ip.version == 4:
+                nb.addr_v4 = str(sender_ip)
+            else:
+                nb.addr_v6 = str(sender_ip)
         self._transition(nb, SparkNeighEvent.HANDSHAKE_RCVD)
         if nb.negotiate_timer is not None:
             nb.negotiate_timer.cancel()

@@ -46,6 +46,11 @@ class Adjacency:
     is_overloaded: bool = False
     rtt_us: int = 0
     timestamp_s: int = 0
+    # the NEIGHBOR's link addresses — the next hop when forwarding over
+    # this adjacency (ref Types.thrift:104-110 nextHopV6/nextHopV4);
+    # learned from the Spark handshake's kernel source address
+    next_hop_v6: str = ""
+    next_hop_v4: str = ""
     weight: int = 1  # UCMP weight of this adj (ref Types.thrift:158)
     # Two-stage cold-boot insertion: adjacency only usable by the *other*
     # node until the restarting node has programmed routes

@@ -3,6 +3,8 @@ PrefixManagerTest.cpp, openr/allocators tests)."""
 
 import asyncio
 
+import pytest
+
 from openr_tpu.allocators import ALLOC_PREFIX_MARKER, PrefixAllocator, RangeAllocator
 from openr_tpu.decision.rib import DecisionRouteUpdate, NextHop, RibUnicastEntry
 from openr_tpu.kvstore.wrapper import KvStoreWrapper, wait_until
@@ -275,3 +277,231 @@ class TestPrefixAllocator:
         finally:
             await alloc.stop()
             await w.stop()
+
+
+class TestPrependLabelAllocator:
+    """ref openr/common/tests/PrependLabelAllocatorTest.cpp semantics."""
+
+    def test_refcount_and_reuse(self):
+        from openr_tpu.allocators import PrependLabelAllocator
+
+        alloc = PrependLabelAllocator()
+        g1 = {"10.0.0.1", "10.0.0.2"}
+        g2 = {"10.0.0.3"}
+        l1, new1 = alloc.increment_ref_count(g1)
+        assert new1 and l1 == 60000  # v4 range start
+        # same set shares the label, no new allocation
+        l1b, new1b = alloc.increment_ref_count(g1)
+        assert (l1b, new1b) == (l1, False)
+        l2, new2 = alloc.increment_ref_count(g2)
+        assert new2 and l2 == 60001
+        # still referenced: no label to delete
+        assert alloc.decrement_ref_count(g1) is None
+        # last ref drops: label freed...
+        assert alloc.decrement_ref_count(g1) == l1
+        # ...and reused most-recent-first for the next new set
+        l3, new3 = alloc.increment_ref_count({"10.0.0.9"})
+        assert new3 and l3 == l1
+
+    def test_family_ranges_and_exhaustion(self):
+        from openr_tpu.allocators import (
+            LabelRangeExhausted,
+            PrependLabelAllocator,
+        )
+
+        alloc = PrependLabelAllocator(v4_range=(100, 101), v6_range=(200, 201))
+        assert alloc.increment_ref_count({"10.0.0.1"})[0] == 100
+        assert alloc.increment_ref_count({"fe80::1"})[0] == 200
+        assert alloc.increment_ref_count({"10.0.0.2"})[0] == 101
+        import pytest
+
+        with pytest.raises(LabelRangeExhausted):
+            alloc.increment_ref_count({"10.0.0.3"})
+        # empty sets never allocate
+        assert alloc.increment_ref_count(set()) == (None, False)
+
+    @run_async
+    async def test_originated_prefix_gets_label_and_mpls_route(self):
+        """An originated prefix with allocate_prepend_label advertises a
+        label bound to its supporting next-hop group and programs the
+        matching local MPLS route through the static queue."""
+        from openr_tpu.decision.rib import (
+            DecisionRouteUpdate,
+            NextHop,
+            RibUnicastEntry,
+            RouteUpdateType,
+        )
+        from openr_tpu.prefix_manager import OriginatedPrefix, PrefixManager
+
+        prefix_q = ReplicateQueue("prefixUpdates")
+        fib_q = ReplicateQueue("fibUpdates")
+        kv_req_q = ReplicateQueue("kvRequests")
+        static_q = ReplicateQueue("staticRoutes")
+        static_reader = static_q.get_reader("test")
+        pm = PrefixManager(
+            "node1",
+            ["0"],
+            prefix_q.get_reader(),
+            fib_q.get_reader(),
+            kv_req_q,
+            static_routes_queue=static_q,
+            originated_prefixes=[
+                OriginatedPrefix(
+                    prefix="10.0.0.0/16",
+                    minimum_supporting_routes=1,
+                    allocate_prepend_label=True,
+                )
+            ],
+            sync_throttle_s=0.002,
+        )
+        await pm.start()
+        try:
+            # a supporting route lands in the FIB
+            fib_q.push(
+                DecisionRouteUpdate(
+                    type=RouteUpdateType.INCREMENTAL,
+                    unicast_routes_to_update={
+                        "10.0.1.0/24": RibUnicastEntry(
+                            prefix="10.0.1.0/24",
+                            nexthops=frozenset(
+                                {NextHop(address="10.9.9.1")}
+                            ),
+                        )
+                    },
+                )
+            )
+            upd = await asyncio.wait_for(static_reader.get(), 5)
+            assert 60000 in upd.mpls_routes_to_update
+            mpls = upd.mpls_routes_to_update[60000]
+            assert {nh.address for nh in mpls.nexthops} == {"10.9.9.1"}
+            entry = pm.best_entries()["10.0.0.0/16"]
+            assert entry.prepend_label == 60000
+
+            # supporting route withdrawn -> prefix withdrawn, label freed
+            fib_q.push(
+                DecisionRouteUpdate(
+                    type=RouteUpdateType.INCREMENTAL,
+                    unicast_routes_to_delete=["10.0.1.0/24"],
+                )
+            )
+            upd = await asyncio.wait_for(static_reader.get(), 5)
+            assert upd.mpls_routes_to_delete == [60000]
+            assert "10.0.0.0/16" not in pm.best_entries()
+        finally:
+            await pm.stop()
+            for q in (prefix_q, fib_q, kv_req_q, static_q):
+                q.close()
+
+
+class TestStaticPrefixAllocator:
+    """ref PrefixAllocator.h:88-101 e2e-network-allocations mode."""
+
+    @run_async
+    async def test_assignment_and_withdrawal(self):
+        import json
+
+        from openr_tpu.allocators import STATIC_ALLOC_KEY, StaticPrefixAllocator
+
+        w = KvStoreWrapper("node1")
+        await w.start()
+        prefix_q = ReplicateQueue("prefixUpdates")
+        events = prefix_q.get_reader("test")
+        # the controller key may predate the allocator
+        w.set_key(
+            STATIC_ALLOC_KEY,
+            json.dumps(
+                {"node1": "10.77.0.0/24", "other": "10.77.1.0/24"}
+            ).encode(),
+        )
+        alloc = StaticPrefixAllocator(
+            "node1",
+            w.store,
+            w.updates_queue.get_reader("alloc"),
+            prefix_q,
+        )
+        await asyncio.sleep(0.05)  # let the key land
+        await alloc.start()
+        try:
+            ev = await asyncio.wait_for(events.get(), 5)
+            assert [e.prefix for e in ev.prefixes] == ["10.77.0.0/24"]
+            assert alloc.allocated_prefix == "10.77.0.0/24"
+
+            # controller reassigns our prefix
+            w.set_key(
+                STATIC_ALLOC_KEY,
+                json.dumps({"node1": "10.88.0.0/24"}).encode(),
+            )
+            ev = await asyncio.wait_for(events.get(), 5)
+            assert [e.prefix for e in ev.prefixes] == ["10.88.0.0/24"]
+
+            # controller drops us entirely -> withdraw
+            w.set_key(STATIC_ALLOC_KEY, json.dumps({}).encode())
+            ev = await asyncio.wait_for(events.get(), 5)
+            assert ev.prefixes == [] or list(ev.prefixes) == []
+            assert alloc.allocated_prefix is None
+        finally:
+            await alloc.stop()
+            await w.stop()
+
+
+def _root_with_netlink() -> bool:
+    import os
+    import socket as _s
+
+    try:
+        s = _s.socket(_s.AF_NETLINK, _s.SOCK_RAW, _s.NETLINK_ROUTE)
+        s.close()
+    except OSError:
+        return False
+    return os.geteuid() == 0
+
+
+class TestAllocatorWritesAddress:
+    @pytest.mark.skipif(
+        not _root_with_netlink(), reason="needs CAP_NET_ADMIN"
+    )
+    @run_async
+    async def test_allocated_address_lands_on_interface(self):
+        """set_loopback_address: the derived first-host address must
+        appear on the configured interface (ref PrefixAllocator applying
+        the loopback address via netlink)."""
+        import os
+        import subprocess
+
+        name = f"ova{os.getpid() % 10000}"
+
+        def sh(*args):
+            subprocess.run(args, check=True, capture_output=True)
+
+        sh("ip", "link", "add", name, "type", "veth",
+           "peer", "name", f"{name}p")
+        w = KvStoreWrapper("node1")
+        await w.start()
+        prefix_q = ReplicateQueue("prefixUpdates")
+        alloc = PrefixAllocator(
+            "node1",
+            w.store,
+            w.updates_queue.get_reader("alloc"),
+            prefix_q,
+            seed_prefix="10.131.0.0/16",
+            allocate_prefix_len=24,
+            settle_s=0.03,
+            loopback_iface=name,
+            set_loopback_address=True,
+        )
+        await alloc.start()
+        try:
+            await wait_until(
+                lambda: alloc.assigned_address is not None, timeout_s=10
+            )
+            out = subprocess.run(
+                ["ip", "-br", "addr", "show", name],
+                capture_output=True, text=True, check=True,
+            ).stdout
+            assert alloc.assigned_address in out
+            assert alloc.assigned_address.startswith("10.131.")
+        finally:
+            await alloc.stop()
+            await w.stop()
+            subprocess.run(["ip", "link", "del", name], capture_output=True)
+
