@@ -34,6 +34,19 @@ retry() { # retry <tries> <sleep> <desc> <cmd...>
   fail "$desc"
 }
 
+# -- per-node PKI: the kvstore peer plane runs mutual TLS -------------------
+PKI="$WORK/pki"
+mkdir -p "$PKI"
+openssl req -x509 -newkey rsa:2048 -nodes -keyout "$PKI/ca.key" \
+  -out "$PKI/ca.crt" -days 1 -subj "/CN=lab-ca" 2>/dev/null
+for n in lab-a lab-b; do
+  openssl req -newkey rsa:2048 -nodes -keyout "$PKI/$n.key" \
+    -out "$PKI/$n.csr" -subj "/CN=$n" 2>/dev/null
+  openssl x509 -req -in "$PKI/$n.csr" -CA "$PKI/ca.crt" \
+    -CAkey "$PKI/ca.key" -CAcreateserial -out "$PKI/$n.crt" -days 1 \
+    2>/dev/null
+done
+
 # -- namespaces + veth ------------------------------------------------------
 ip netns add $NS_A || { echo "needs CAP_NET_ADMIN"; exit 1; }
 ip netns add $NS_B
@@ -48,10 +61,14 @@ ip netns exec $NS_B ip link set orv-b up
 log "namespaces up: $NS_A (10.100.0.1) <-veth-> $NS_B (10.100.0.2)"
 
 # -- configs ----------------------------------------------------------------
-mkcfg() { # node iface
+mkcfg() { # node iface index
 cat > "$WORK/$1.json" <<JSON
 {"node_name": "$1",
  "decision_config": {"solver_backend": "cpu"},
+ "kvstore_config": {"enable_secure_peers": true},
+ "thrift_server": {"x509_cert_path": "$PKI/$1.crt",
+                    "x509_key_path": "$PKI/$1.key",
+                    "x509_ca_path": "$PKI/ca.crt"},
  "link_monitor_config": {"enable_netlink_interfaces": true,
                           "include_interface_regexes": ["$2"],
                           "linkflap_initial_backoff_ms": 1,
@@ -131,10 +148,29 @@ retry 100 0.2 "ns-a withdrew 10.200.2.0/24 after carrier loss" \
   sh -c "ip netns exec $NS_A ip route show | grep -q '10.200.2.0/24' && exit 1 || exit 0"
 log "OK(6) carrier loss withdrew the peer's routes from the kernel"
 
-# 7. MPLS, where the kernel supports it
-if [ -d /proc/sys/net/mpls ]; then
-  sysctl -w net.mpls.platform_labels=100000 >/dev/null
-  log "kernel MPLS present — label routes would appear in 'ip -f mpls route'"
+# 7. MPLS, where the kernel supports it: drive the platform dataplane's
+# AF_MPLS path directly in ns-a and read the label route back from the
+# kernel (net.mpls sysctls are netns-local; the namespace teardown
+# reverts them)
+if ip netns exec $NS_A test -d /proc/sys/net/mpls; then
+  ip netns exec $NS_A sysctl -w net.mpls.platform_labels=1000 >/dev/null
+  ip netns exec $NS_A python - <<'PYEOF' || fail "MPLS label route did not program"
+import asyncio, sys
+sys.path.insert(0, "/root/repo")
+from openr_tpu.platform.fib_handler import NetlinkDataplane
+
+async def main():
+    dp = NetlinkDataplane()
+    assert dp.mpls_kernel, "mpls module present but dataplane gated off"
+    failed = await dp.add_mpls({500: {"nexthops": [
+        {"address": "", "if_name": "lo",
+         "mpls_action": {"action": 3}}]}})
+    assert not failed, failed
+asyncio.run(main())
+PYEOF
+  ip netns exec $NS_A ip -f mpls route show | grep -q "^500" \
+    || fail "label 500 not visible in ip -f mpls route"
+  log "OK(7) AF_MPLS label route programmed and visible in the kernel"
 else
   log "SKIP(7) kernel lacks mpls_router; MPLS routes stay in the agent's shadow table"
 fi

@@ -179,6 +179,14 @@ class _LoopbackAddressMixin:
     loopback_iface: str = ""
     set_loopback_address: bool = False
     assigned_address: Optional[str] = None
+    _addr_lock = None  # serializes assign/remove (rapid reassignments)
+
+    def _address_lock(self):
+        import asyncio as _asyncio
+
+        if self._addr_lock is None:
+            self._addr_lock = _asyncio.Lock()
+        return self._addr_lock
 
     def _maybe_assign_address(self, allocated_prefix: str) -> None:
         if not (self.set_loopback_address and self.loopback_iface):
@@ -187,6 +195,39 @@ class _LoopbackAddressMixin:
             self._assign_address(allocated_prefix),
             name=f"{self.name}.assign-addr",
         )
+
+    def _maybe_remove_address(self) -> None:
+        """Withdrawal: the prefix (and its derived address) now belongs
+        to nobody or to another node — answering on it would be an
+        address conflict."""
+        if not (self.set_loopback_address and self.loopback_iface):
+            return
+        self.add_task(
+            self._remove_address(), name=f"{self.name}.remove-addr"
+        )
+
+    async def _remove_address(self) -> None:
+        import socket as _socket
+
+        from openr_tpu.platform.netlink import NetlinkRouteSocket
+
+        async with self._address_lock():
+            if not self.assigned_address:
+                return
+            nl = NetlinkRouteSocket()
+            try:
+                nl.open()
+                ifindex = _socket.if_nametoindex(self.loopback_iface)
+                await nl.del_addr(ifindex, self.assigned_address)
+                log.info(
+                    "%s: removed %s from %s",
+                    self.name, self.assigned_address, self.loopback_iface,
+                )
+            except OSError:
+                pass  # already gone
+            finally:
+                self.assigned_address = None
+                nl.close()
 
     async def _assign_address(self, allocated_prefix: str) -> None:
         """Best-effort: install the allocation's first host address on
@@ -203,27 +244,29 @@ class _LoopbackAddressMixin:
         net = parse_prefix(allocated_prefix)
         host = net.network_address + (1 if net.num_addresses > 1 else 0)
         addr = f"{host}/{net.prefixlen}"
-        nl = NetlinkRouteSocket()
-        try:
-            nl.open()
-            ifindex = _socket.if_nametoindex(self.loopback_iface)
-            if self.assigned_address and self.assigned_address != addr:
-                try:
-                    await nl.del_addr(ifindex, self.assigned_address)
-                except OSError:
-                    pass  # already gone
-            await nl.add_addr(ifindex, addr)
-            self.assigned_address = addr
-            log.info(
-                "%s: assigned %s to %s", self.name, addr, self.loopback_iface
-            )
-        except OSError as e:
-            log.warning(
-                "%s: could not assign %s to %s: %s",
-                self.name, addr, self.loopback_iface, e,
-            )
-        finally:
-            nl.close()
+        async with self._address_lock():
+            nl = NetlinkRouteSocket()
+            try:
+                nl.open()
+                ifindex = _socket.if_nametoindex(self.loopback_iface)
+                if self.assigned_address and self.assigned_address != addr:
+                    try:
+                        await nl.del_addr(ifindex, self.assigned_address)
+                    except OSError:
+                        pass  # already gone
+                await nl.add_addr(ifindex, addr)
+                self.assigned_address = addr
+                log.info(
+                    "%s: assigned %s to %s",
+                    self.name, addr, self.loopback_iface,
+                )
+            except OSError as e:
+                log.warning(
+                    "%s: could not assign %s to %s: %s",
+                    self.name, addr, self.loopback_iface, e,
+                )
+            finally:
+                nl.close()
 
 
 class PrefixAllocator(_LoopbackAddressMixin, Actor):
@@ -400,3 +443,4 @@ class StaticPrefixAllocator(_LoopbackAddressMixin, Actor):
             counters.increment("prefix_allocator.static_allocations")
         else:
             log.info("%s: static allocation withdrawn", self.name)
+            self._maybe_remove_address()

@@ -53,6 +53,11 @@ class KvstoreConfig:
     # identity-pinned to their node names (ref secure thrift between
     # stores)
     enable_secure_peers: bool = False
+    # peer-plane bind address. Empty = fail-closed default: the global
+    # listen_addr when the peer plane is TLS-secured, loopback
+    # otherwise (an any-address PLAINTEXT peer plane would let any
+    # on-path host inject LSDB state). Set explicitly to override.
+    listen_addr: str = ""
 
 
 @dataclass
@@ -94,9 +99,13 @@ class DecisionConfig:
     # (decision/tpu_solver.py); "auto" prefers tpu when a device is present.
     solver_backend: str = "auto"
     # "auto" only: below this node count the device launch + result pull
-    # costs more than the whole CPU solve (measured crossover ~1.5k nodes
-    # on the bench rig), so auto delegates small graphs to the oracle
-    auto_small_graph_nodes: int = 1024
+    # costs more than the whole CPU solve, so auto delegates small
+    # graphs to the oracle. Measured crossover on the tunneled bench rig
+    # (~87 ms fixed round trip): cpu wins through 2025 nodes
+    # (72 ms vs 110 ms), tpu wins at 4096 (139 ms vs 212 ms) — crossing
+    # near ~2.8k. On PCIe-attached hosts (~us round trips) the true
+    # crossover is far lower; tune to the deployment's measured RTT.
+    auto_small_graph_nodes: int = 2816
     # openr_tpu extension: compute rfc5286 loop-free-alternate backup
     # next hops for SP_ECMP/IP prefixes (RibUnicastEntry.lfa_nexthops)
     enable_lfa: bool = False

@@ -317,8 +317,15 @@ class LinkMonitor(Actor):
                 f"{if_name}|{node}",
                 self.state.link_metric_overrides.get(if_name, adj.metric),
             )
+            # soft-drain: node + per-interface increments add on top of
+            # the chosen metric (ref LinkMonitor.cpp:1013 — the
+            # increment is applied at ADVERTISEMENT, Decision never
+            # sees the raw field)
             metric = max(
-                1, metric + self.state.link_metric_increments.get(if_name, 0)
+                1,
+                metric
+                + self.state.link_metric_increments.get(if_name, 0)
+                + self.state.node_metric_increment,
             )
             adjs.append(
                 Adjacency(

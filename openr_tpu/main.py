@@ -164,9 +164,29 @@ async def run_daemon(args) -> None:
         plugins=oc.plugins,
         running_config=cfg,
         # peers connect to the kvstore from OTHER hosts/namespaces —
-        # bind the configured listen address, not loopback
-        kv_listen_addr=oc.listen_addr,
+        # bind the configured listen address. Fail closed: without
+        # peer-plane TLS the default stays loopback (an any-address
+        # plaintext peer plane invites LSDB injection); an explicit
+        # kvstore_config.listen_addr overrides consciously.
+        kv_listen_addr=(
+            oc.kvstore_config.listen_addr
+            or (
+                oc.listen_addr
+                if oc.kvstore_config.enable_secure_peers
+                else "127.0.0.1"
+            )
+        ),
     )
+    if (
+        oc.kvstore_config.listen_addr
+        and oc.kvstore_config.listen_addr != "127.0.0.1"
+        and not oc.kvstore_config.enable_secure_peers
+    ):
+        log.warning(
+            "kvstore peer plane bound to %s WITHOUT TLS — any on-path "
+            "host can inject LSDB state (set enable_secure_peers)",
+            oc.kvstore_config.listen_addr,
+        )
 
     # -- bring up interfaces ----------------------------------------------
     iface_infos = []

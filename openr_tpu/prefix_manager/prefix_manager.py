@@ -138,7 +138,12 @@ class PrefixManager(Actor):
         # prepend labels (ref PrependLabelAllocator): created on first
         # use; bindings live on _OriginatedState
         self._label_allocator = None
-        # programmed-route next hops, for label next-hop groups
+        # programmed-route next hops, for label next-hop groups — only
+        # tracked when some originated prefix allocates labels (100k
+        # persistent frozensets otherwise, all dead weight)
+        self._track_nexthops = any(
+            o.conf.allocate_prepend_label for o in self.originated.values()
+        )
         self._route_nexthops: dict[str, frozenset] = {}
 
     async def on_start(self) -> None:
@@ -270,12 +275,13 @@ class PrefixManager(Actor):
         covering prefixes (ref aggregation, minimum_supporting_routes)."""
         changed = False
         for prefix, entry in upd.unicast_routes_to_update.items():
-            nhs = frozenset(
-                nh.address for nh in entry.nexthops if nh.address
-            )
-            if self._route_nexthops.get(prefix) != nhs:
-                self._route_nexthops[prefix] = nhs
-                changed = True  # next-hop group may move the label
+            if self._track_nexthops:
+                nhs = frozenset(
+                    nh.address for nh in entry.nexthops if nh.address
+                )
+                if self._route_nexthops.get(prefix) != nhs:
+                    self._route_nexthops[prefix] = nhs
+                    changed = True  # next-hop group may move the label
             for ostate in self.originated.values():
                 if self._supports(prefix, ostate.conf.prefix):
                     if prefix not in ostate.supporting:

@@ -567,3 +567,44 @@ class TestSparkRobustness:
         finally:
             await a.stop()
             await b.stop()
+
+
+class TestSoftDrain(TestLinkMonitor):
+    """Node/interface metric increments (ref setNodeInterfaceMetric-
+    Increment; LinkMonitor.cpp:1013 applies them at advertisement)."""
+
+    @run_async
+    async def test_increments_inflate_advertised_metrics(self):
+        import pytest
+
+        lm, nq, kvq, peers, reqs = self._make()
+        await lm.start()
+        try:
+            nq.push(self.neighbor_up())
+            await asyncio.wait_for(peers.get(), 2)
+            kvq.push(KvStoreSyncEvent("nbr", "0"))
+            await asyncio.wait_for(reqs.get(), 2)
+            base = lm.build_adjacency_database("0").adjacencies[0].metric
+
+            await lm.set_node_metric_increment(50)
+            db = lm.build_adjacency_database("0")
+            assert db.adjacencies[0].metric == base + 50
+            assert db.node_metric_increment == 50
+
+            await lm.set_link_metric_increment("if-nbr", 7)
+            assert (
+                lm.build_adjacency_database("0").adjacencies[0].metric
+                == base + 57
+            )
+
+            # unset both: back to the measured metric
+            await lm.set_node_metric_increment(0)
+            await lm.set_link_metric_increment("if-nbr", 0)
+            assert (
+                lm.build_adjacency_database("0").adjacencies[0].metric == base
+            )
+
+            with pytest.raises(ValueError):
+                await lm.set_node_metric_increment(-5)
+        finally:
+            await lm.stop()
