@@ -155,8 +155,7 @@ log "OK(6) carrier loss withdrew the peer's routes from the kernel"
 if ip netns exec $NS_A test -d /proc/sys/net/mpls; then
   ip netns exec $NS_A sysctl -w net.mpls.platform_labels=1000 >/dev/null
   ip netns exec $NS_A python - <<'PYEOF' || fail "MPLS label route did not program"
-import asyncio, sys
-sys.path.insert(0, "/root/repo")
+import asyncio
 from openr_tpu.platform.fib_handler import NetlinkDataplane
 
 async def main():

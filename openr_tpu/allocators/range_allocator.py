@@ -211,23 +211,42 @@ class _LoopbackAddressMixin:
 
         from openr_tpu.platform.netlink import NetlinkRouteSocket
 
+        import errno as _errno
+
         async with self._address_lock():
             if not self.assigned_address:
+                return
+            try:
+                # an interface that no longer exists took its addresses
+                # with it — the removal goal is already met
+                ifindex = _socket.if_nametoindex(self.loopback_iface)
+            except OSError:
+                self.assigned_address = None
                 return
             nl = NetlinkRouteSocket()
             try:
                 nl.open()
-                ifindex = _socket.if_nametoindex(self.loopback_iface)
                 await nl.del_addr(ifindex, self.assigned_address)
                 log.info(
                     "%s: removed %s from %s",
                     self.name, self.assigned_address, self.loopback_iface,
                 )
-            except OSError:
-                pass  # already gone
+            except OSError as e:
+                # ENOENT/EADDRNOTAVAIL = already gone, which is the goal;
+                # anything else means the conflicting address is STILL
+                # INSTALLED — keep assigned_address so a later removal can
+                # retry, and say so
+                if e.errno not in (_errno.ENOENT, _errno.EADDRNOTAVAIL):
+                    log.warning(
+                        "%s: failed to remove %s from %s (%s) — address "
+                        "remains installed",
+                        self.name, self.assigned_address,
+                        self.loopback_iface, e,
+                    )
+                    return
             finally:
-                self.assigned_address = None
                 nl.close()
+            self.assigned_address = None
 
     async def _assign_address(self, allocated_prefix: str) -> None:
         """Best-effort: install the allocation's first host address on

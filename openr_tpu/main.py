@@ -177,9 +177,19 @@ async def run_daemon(args) -> None:
             )
         ),
     )
+    def _is_loopback(addr: str) -> bool:
+        if addr == "localhost":
+            return True
+        try:
+            import ipaddress as _ip
+
+            return _ip.ip_address(addr).is_loopback
+        except ValueError:
+            return False
+
     if (
         oc.kvstore_config.listen_addr
-        and oc.kvstore_config.listen_addr != "127.0.0.1"
+        and not _is_loopback(oc.kvstore_config.listen_addr)
         and not oc.kvstore_config.enable_secure_peers
     ):
         log.warning(

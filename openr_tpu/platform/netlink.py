@@ -33,6 +33,7 @@ NLM_F_ROOT = 0x100
 NLM_F_MATCH = 0x200
 NLM_F_DUMP = NLM_F_ROOT | NLM_F_MATCH
 NLM_F_REPLACE = 0x100
+NLM_F_EXCL = 0x200
 NLM_F_CREATE = 0x400
 
 # rtnetlink (linux/rtnetlink.h)
@@ -45,6 +46,12 @@ RTM_GETADDR = 22
 RTM_NEWROUTE = 24
 RTM_DELROUTE = 25
 RTM_GETROUTE = 26
+RTM_NEWNEIGH = 28
+RTM_DELNEIGH = 29
+RTM_GETNEIGH = 30
+RTM_NEWRULE = 32
+RTM_DELRULE = 33
+RTM_GETRULE = 34
 RTN_UNICAST = 1
 RT_SCOPE_UNIVERSE = 0
 RT_TABLE_MAIN = 254
@@ -70,20 +77,45 @@ IFLA_IFNAME = 3
 IFA_ADDRESS = 1
 IFA_LOCAL = 2
 
+# neighbor table (linux/neighbour.h)
+NDA_DST = 1
+NDA_LLADDR = 2
+NUD_INCOMPLETE = 0x01
+NUD_REACHABLE = 0x02
+NUD_STALE = 0x04
+NUD_DELAY = 0x08
+NUD_PROBE = 0x10
+NUD_FAILED = 0x20
+NUD_NOARP = 0x40
+NUD_PERMANENT = 0x80
+
+# policy routing rules (linux/fib_rules.h)
+FRA_PRIORITY = 6
+FRA_FWMARK = 10
+FRA_TABLE = 15
+FR_ACT_TO_TBL = 1
+
 # interface flags (linux/if.h)
 IFF_UP = 0x1
 IFF_RUNNING = 0x40
 IFF_LOOPBACK = 0x8
 
-# multicast groups for event subscription (linux/rtnetlink.h)
+# multicast groups for event subscription (linux/rtnetlink.h); rule
+# groups have no legacy RTMGRP_ alias — masks are 1 << (RTNLGRP - 1)
 RTMGRP_LINK = 0x1
+RTMGRP_NEIGH = 0x4
 RTMGRP_IPV4_IFADDR = 0x10
+RTMGRP_IPV4_RULE = 0x80
 RTMGRP_IPV6_IFADDR = 0x100
+RTMGRP_IPV6_RULE = 1 << 18  # RTNLGRP_IPV6_RULE (19)
 
 _NLMSGHDR = struct.Struct("=IHHII")  # len, type, flags, seq, pid
 _RTMSG = struct.Struct("=BBBBBBBBI")  # family,dst,src,tos,table,proto,scope,type,flags
 _IFINFOMSG = struct.Struct("=BBHiII")  # family,pad,type,index,flags,change
 _IFADDRMSG = struct.Struct("=BBBBI")  # family,prefixlen,flags,scope,index
+_NDMSG = struct.Struct("=BBHiHBB")  # family,pad1,pad2,ifindex,state,flags,type
+# fib_rule_hdr: family,dst_len,src_len,tos,table,res1,res2,action,flags —
+# byte-for-byte the rtmsg layout, so _RTMSG packs/unpacks it too
 _RTA = struct.Struct("=HH")  # len, type
 _RTNH = struct.Struct("=HBBi")  # len, flags, hops, ifindex
 
@@ -205,6 +237,35 @@ class NlAddr:
     family: int = socket.AF_INET
 
 
+@dataclass(frozen=True)
+class NlNeighbor:
+    """One neighbor-table entry — ARP/NDP cache line (ref fbnl::Neighbor,
+    NetlinkTypes.h:466; RTM_NEWNEIGH/DELNEIGH/GETNEIGH carry ndmsg)."""
+
+    ifindex: int
+    destination: str  # neighbor's network-layer address
+    lladdr: str = ""  # link-layer (MAC) address, "" when unresolved
+    state: int = 0  # NUD_* bitmask
+    family: int = socket.AF_INET
+
+    @property
+    def is_reachable(self) -> bool:
+        # a usable entry: confirmed, static, or a no-ARP device
+        return bool(self.state & (NUD_REACHABLE | NUD_PERMANENT | NUD_NOARP))
+
+
+@dataclass(frozen=True)
+class NlRule:
+    """One policy-routing rule (ref fbnl::Rule, NetlinkTypes.h:609:
+    family + FR_ACT_* action + table, optional fwmark/priority)."""
+
+    family: int = socket.AF_INET
+    action: int = FR_ACT_TO_TBL
+    table: int = RT_TABLE_MAIN
+    priority: Optional[int] = None
+    fwmark: Optional[int] = None
+
+
 @dataclass
 class _Pending:
     future: asyncio.Future
@@ -219,7 +280,8 @@ class NetlinkRouteSocket:
     on ACK/ERROR/DONE). With `groups`, the socket also joins rtnetlink
     multicast groups and surfaces unsolicited kernel events through
     `event_cb(kind, obj)` — kind in {"link", "link_del", "addr",
-    "addr_del"} with NlLink/NlAddr payloads (ref event queue,
+    "addr_del", "neigh", "neigh_del", "rule", "rule_del"} with
+    NlLink/NlAddr/NlNeighbor/NlRule payloads (ref event queue,
     NetlinkProtocolSocket.h:29-31)."""
 
     def __init__(self, max_in_flight: int = 256, event_cb=None):
@@ -344,6 +406,10 @@ class NetlinkRouteSocket:
         RTM_DELLINK: "link_del",
         RTM_NEWADDR: "addr",
         RTM_DELADDR: "addr_del",
+        RTM_NEWNEIGH: "neigh",
+        RTM_DELNEIGH: "neigh_del",
+        RTM_NEWRULE: "rule",
+        RTM_DELRULE: "rule_del",
     }
 
     def _on_msg(self, mtype: int, mflags: int, seq: int, body: bytes,
@@ -353,11 +419,7 @@ class NetlinkRouteSocket:
             if self.event_cb is not None:
                 kind = self._EVENT_KINDS.get(mtype)
                 if kind is not None:
-                    obj = (
-                        _parse_link_msg(body)
-                        if kind.startswith("link")
-                        else _parse_addr_msg(body)
-                    )
+                    obj = _parse_event(kind, body)
                     if obj is not None:
                         self.event_cb(kind, obj)
             return
@@ -382,11 +444,7 @@ class NetlinkRouteSocket:
                 kind = self._EVENT_KINDS.get(mtype)
                 if kind is None:
                     return
-                obj = (
-                    _parse_link_msg(body)
-                    if kind.startswith("link")
-                    else _parse_addr_msg(body)
-                )
+                obj = _parse_event(kind, body)
                 if obj is not None:
                     self.event_cb(kind, obj)
 
@@ -482,6 +540,47 @@ class NetlinkRouteSocket:
         return await self._send(
             RTM_GETADDR, NLM_F_REQUEST | NLM_F_DUMP, payload,
             dump=True, parse=_parse_addr_msg,
+        )
+
+    # -- neighbor table (ref getAllNeighbors) ------------------------------
+
+    async def get_neighbors(self, family: int = 0) -> list[NlNeighbor]:
+        """Dump the ARP/NDP neighbor table (ref
+        NetlinkProtocolSocket::getAllNeighbors, h:197-198)."""
+        payload = _NDMSG.pack(family, 0, 0, 0, 0, 0, 0)
+        return await self._send(
+            RTM_GETNEIGH, NLM_F_REQUEST | NLM_F_DUMP, payload,
+            dump=True, parse=_parse_neigh_msg,
+        )
+
+    # -- policy routing rules (ref addRule/deleteRule/getAllRules) ---------
+
+    async def add_rule(self, rule: NlRule) -> None:
+        """Idempotent: NLM_F_EXCL makes the kernel reject a duplicate
+        (without it identical fib rules silently stack), and the EEXIST
+        that a retry then earns reads as success."""
+        import errno as _errno
+
+        try:
+            await self._send(
+                RTM_NEWRULE,
+                NLM_F_REQUEST | NLM_F_ACK | NLM_F_CREATE | NLM_F_EXCL,
+                _build_rule_msg(rule),
+            )
+        except OSError as e:
+            if e.errno != _errno.EEXIST:
+                raise
+
+    async def delete_rule(self, rule: NlRule) -> None:
+        await self._send(
+            RTM_DELRULE, NLM_F_REQUEST | NLM_F_ACK, _build_rule_msg(rule)
+        )
+
+    async def get_rules(self, family: int = 0) -> list[NlRule]:
+        payload = _RTMSG.pack(family, 0, 0, 0, 0, 0, 0, 0, 0)
+        return await self._send(
+            RTM_GETRULE, NLM_F_REQUEST | NLM_F_DUMP, payload,
+            dump=True, parse=_parse_rule_msg,
         )
 
 
@@ -867,3 +966,101 @@ def _parse_addr_msg(body: bytes) -> Optional[NlAddr]:
     return NlAddr(
         ifindex=index, prefix=f"{addr}/{prefixlen}", family=family
     )
+
+
+def _parse_neigh_msg(body: bytes) -> Optional[NlNeighbor]:
+    """RTM_NEWNEIGH/DELNEIGH -> NlNeighbor (ref NetlinkNeighborMessage
+    parsing: ndmsg + NDA_DST / NDA_LLADDR attributes)."""
+    if len(body) < _NDMSG.size:
+        return None
+    family, _p1, _p2, ifindex, state, _flags, _typ = _NDMSG.unpack_from(body)
+    if family not in (socket.AF_INET, socket.AF_INET6):
+        return None
+    dst = lladdr = None
+    off = _NDMSG.size
+    while off + _RTA.size <= len(body):
+        alen, atype = _RTA.unpack_from(body, off)
+        if alen < _RTA.size:
+            break
+        payload = body[off + _RTA.size:off + alen]
+        if atype == NDA_DST:
+            dst = payload
+        elif atype == NDA_LLADDR:
+            lladdr = payload
+        off += _align4(alen)
+    if dst is None:
+        return None
+    try:
+        destination = str(ipaddress.ip_address(dst))
+    except ValueError:
+        return None
+    mac = ":".join(f"{b:02x}" for b in lladdr) if lladdr else ""
+    return NlNeighbor(
+        ifindex=ifindex, destination=destination, lladdr=mac,
+        state=state, family=family,
+    )
+
+
+def _build_rule_msg(rule: NlRule) -> bytes:
+    """NlRule -> fib_rule_hdr + FRA attributes (ref NetlinkRuleMessage::
+    addRule/addRuleAttributes). Tables above the u8 header field go in
+    FRA_TABLE, mirroring the kernel's (and the reference's) convention."""
+    table8 = rule.table if rule.table < 256 else 0
+    body = _RTMSG.pack(
+        rule.family, 0, 0, 0, table8, 0, 0, rule.action, 0
+    )
+    if rule.table >= 256:
+        body += _rta(FRA_TABLE, struct.pack("=I", rule.table))
+    if rule.priority is not None:
+        body += _rta(FRA_PRIORITY, struct.pack("=I", rule.priority))
+    if rule.fwmark is not None:
+        body += _rta(FRA_FWMARK, struct.pack("=I", rule.fwmark))
+    return body
+
+
+def _parse_rule_msg(body: bytes) -> Optional[NlRule]:
+    """RTM_NEWRULE/DELRULE -> NlRule (ref NetlinkRuleMessage::parseMessage)."""
+    if len(body) < _RTMSG.size:
+        return None
+    family, _dl, _sl, _tos, table, _r1, _r2, action, _flags = (
+        _RTMSG.unpack_from(body)
+    )
+    if family not in (socket.AF_INET, socket.AF_INET6):
+        return None
+    priority = fwmark = None
+    full_table = table
+    off = _RTMSG.size
+    while off + _RTA.size <= len(body):
+        alen, atype = _RTA.unpack_from(body, off)
+        if alen < _RTA.size:
+            break
+        payload = body[off + _RTA.size:off + alen]
+        if atype == FRA_TABLE and len(payload) >= 4:
+            (full_table,) = struct.unpack_from("=I", payload)
+        elif atype == FRA_PRIORITY and len(payload) >= 4:
+            (priority,) = struct.unpack_from("=I", payload)
+        elif atype == FRA_FWMARK and len(payload) >= 4:
+            (fwmark,) = struct.unpack_from("=I", payload)
+        off += _align4(alen)
+    return NlRule(
+        family=family, action=action, table=full_table,
+        priority=priority, fwmark=fwmark,
+    )
+
+
+_EVENT_PARSE = {
+    "link": _parse_link_msg,
+    "link_del": _parse_link_msg,
+    "addr": _parse_addr_msg,
+    "addr_del": _parse_addr_msg,
+    "neigh": _parse_neigh_msg,
+    "neigh_del": _parse_neigh_msg,
+    "rule": _parse_rule_msg,
+    "rule_del": _parse_rule_msg,
+}
+
+
+def _parse_event(kind: str, body: bytes):
+    """Decode one unsolicited kernel notification (ref NetlinkEvent
+    variant: Link/IfAddress/Neighbor/Rule, NetlinkProtocolSocket.h:29-31)."""
+    return _EVENT_PARSE[kind](body)

@@ -185,6 +185,55 @@ class TestNetlinkMessages:
         parsed = nl._parse_route_msg(nl._build_route_msg(r))
         assert parsed.table == 10099
 
+    def test_rule_message_roundtrip(self):
+        """fib_rule_hdr + FRA attrs both directions (ref
+        NetlinkRuleMessage::addRule/parseMessage)."""
+        from openr_tpu.platform import netlink as nl
+
+        r = nl.NlRule(
+            family=socket.AF_INET, action=nl.FR_ACT_TO_TBL, table=100,
+            priority=1000, fwmark=0x2a,
+        )
+        parsed = nl._parse_rule_msg(nl._build_rule_msg(r))
+        assert parsed == r
+
+    def test_rule_extended_table_id(self):
+        """Tables >255 overflow the u8 header field into FRA_TABLE."""
+        from openr_tpu.platform import netlink as nl
+
+        r = nl.NlRule(family=socket.AF_INET6, table=70000, priority=7)
+        parsed = nl._parse_rule_msg(nl._build_rule_msg(r))
+        assert parsed.table == 70000 and parsed.family == socket.AF_INET6
+
+    def test_neighbor_message_parse(self):
+        """ndmsg + NDA_DST/NDA_LLADDR -> NlNeighbor (ref
+        NetlinkNeighborMessage parsing)."""
+        from openr_tpu.platform import netlink as nl
+
+        body = nl._NDMSG.pack(
+            socket.AF_INET, 0, 0, 4, nl.NUD_REACHABLE, 0, 0
+        )
+        body += nl._rta(nl.NDA_DST, socket.inet_aton("10.0.0.9"))
+        body += nl._rta(nl.NDA_LLADDR, bytes.fromhex("0202aabbccdd"))
+        n = nl._parse_neigh_msg(body)
+        assert n.ifindex == 4
+        assert n.destination == "10.0.0.9"
+        assert n.lladdr == "02:02:aa:bb:cc:dd"
+        assert n.is_reachable
+
+    def test_neighbor_unresolved_and_failed_states(self):
+        from openr_tpu.platform import netlink as nl
+
+        body = nl._NDMSG.pack(
+            socket.AF_INET6, 0, 0, 2, nl.NUD_FAILED, 0, 0
+        )
+        body += nl._rta(
+            nl.NDA_DST, socket.inet_pton(socket.AF_INET6, "fe80::9")
+        )
+        n = nl._parse_neigh_msg(body)
+        assert n.destination == "fe80::9"
+        assert n.lladdr == "" and not n.is_reachable
+
 
 def _can_net_admin() -> bool:
     try:
@@ -550,6 +599,84 @@ class TestNetlinkLinkAddr:
                 ["ip", "link", "del", name], capture_output=True
             )
             nl.close()
+
+    @run_async
+    async def test_neighbor_dump(self):
+        """Unprivileged: RTM_GETNEIGH dump parses into NlNeighbor
+        entries (ref getAllNeighbors)."""
+        from openr_tpu.platform.netlink import NetlinkRouteSocket, NlNeighbor
+
+        nl = NetlinkRouteSocket()
+        try:
+            nl.open()
+        except OSError:
+            pytest.skip("no AF_NETLINK")
+        try:
+            neighbors = await nl.get_neighbors()
+            assert all(isinstance(n, NlNeighbor) for n in neighbors)
+            for n in neighbors:
+                assert n.destination  # parsed an address for every entry
+        finally:
+            nl.close()
+
+    @pytest.mark.skipif(not _can_net_admin(), reason="needs CAP_NET_ADMIN")
+    @run_async
+    async def test_rule_lifecycle_with_events(self):
+        """Add a policy rule, see it in the dump AND as a subscription
+        event, delete it, see the deletion (ref addRule/deleteRule/
+        getAllRules + Rule events)."""
+        from openr_tpu.platform.netlink import (
+            FR_ACT_TO_TBL,
+            RTMGRP_IPV4_RULE,
+            NetlinkRouteSocket,
+            NlRule,
+        )
+
+        # separate listener: the kernel's group broadcast excludes the
+        # portid that issued the change, so a socket never sees events
+        # for its own mutations
+        events: asyncio.Queue = asyncio.Queue()
+        watcher = NetlinkRouteSocket(
+            event_cb=lambda kind, obj: events.put_nowait((kind, obj))
+        )
+        watcher.open(groups=RTMGRP_IPV4_RULE)
+        nl = NetlinkRouteSocket()
+        nl.open()
+        rule = NlRule(
+            family=socket.AF_INET, action=FR_ACT_TO_TBL, table=10077,
+            priority=30077,
+        )
+
+        async def wait_for(pred, timeout=5.0):
+            deadline = time.monotonic() + timeout
+            while True:
+                remaining = deadline - time.monotonic()
+                assert remaining > 0, "rule event not observed"
+                kind, obj = await asyncio.wait_for(events.get(), remaining)
+                if pred(kind, obj):
+                    return obj
+
+        try:
+            await nl.add_rule(rule)
+            await wait_for(
+                lambda k, o: k == "rule" and o.priority == 30077
+            )
+            rules = await nl.get_rules(socket.AF_INET)
+            mine = [r for r in rules if r.priority == 30077]
+            assert mine and mine[0].table == 10077
+            await nl.delete_rule(rule)
+            await wait_for(
+                lambda k, o: k == "rule_del" and o.priority == 30077
+            )
+            rules = await nl.get_rules(socket.AF_INET)
+            assert not [r for r in rules if r.priority == 30077]
+        finally:
+            try:
+                await nl.delete_rule(rule)
+            except OSError:
+                pass
+            nl.close()
+            watcher.close()
 
     @pytest.mark.skipif(not _can_net_admin(), reason="needs CAP_NET_ADMIN")
     @run_async

@@ -569,9 +569,15 @@ class TestSparkRobustness:
             await b.stop()
 
 
-class TestSoftDrain(TestLinkMonitor):
+class TestSoftDrain:
     """Node/interface metric increments (ref setNodeInterfaceMetric-
-    Increment; LinkMonitor.cpp:1013 applies them at advertisement)."""
+    Increment; LinkMonitor.cpp:1013 applies them at advertisement).
+
+    Borrows TestLinkMonitor's fixtures without subclassing it — pytest
+    would re-collect every inherited test method as a duplicate."""
+
+    _make = TestLinkMonitor._make
+    neighbor_up = staticmethod(TestLinkMonitor.neighbor_up)
 
     @run_async
     async def test_increments_inflate_advertised_metrics(self):
