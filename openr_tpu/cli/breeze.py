@@ -89,6 +89,14 @@ def initialization(ctx) -> None:
     _print(_call(ctx, "openr.initialization_events"))
 
 
+@openr.command("subscribers")
+@click.option("--type", "sub_type", default="", help="kvstore / fib / fib_detail")
+@click.pass_context
+def subscribers(ctx, sub_type) -> None:
+    """Live streaming-subscription stats (ref getSubscriberInfo)."""
+    _print(_call(ctx, "ctrl.subscriber_info", {"type": sub_type}))
+
+
 # -- kvstore ----------------------------------------------------------------
 
 @cli.group()
@@ -295,6 +303,13 @@ def fib_routes(ctx) -> None:
 @click.pass_context
 def fib_mpls(ctx) -> None:
     _print(_call(ctx, "ctrl.fib.mpls_routes"))
+
+
+@fib.command("route-detail")
+@click.pass_context
+def fib_route_detail(ctx) -> None:
+    """Programmed routes with selection detail (ref getRouteDetailDb)."""
+    _print(_call(ctx, "ctrl.fib.route_detail_db"))
 
 
 # -- perf -------------------------------------------------------------------

@@ -63,6 +63,10 @@ class CtrlServer(Actor):
         self.start_time = time.time()
         # initialization-event introspection (ref getInitializationEvents)
         self.initialization_events: dict[str, float] = {}
+        # live-stream bookkeeping (ref getSubscriberInfo,
+        # OpenrCtrl.thrift:72-83 + :407)
+        self._subscribers: dict[int, dict] = {}
+        self._next_subscriber_id = 0
 
     async def on_start(self) -> None:
         s = self.server
@@ -115,6 +119,8 @@ class CtrlServer(Actor):
             s.register("ctrl.fib.routes_filtered", self._fib_routes_filtered)
             s.register("ctrl.fib.mpls_filtered", self._fib_mpls_filtered)
             s.register("ctrl.fib.perf", self._fib_perf)
+            s.register("ctrl.fib.route_detail_db", self._fib_route_detail_db)
+        s.register("ctrl.subscriber_info", self._subscriber_info)
         if self.link_monitor is not None:
             s.register("ctrl.lm.links", self._lm_links)
             s.register("ctrl.lm.interfaces", self._lm_interfaces)
@@ -153,6 +159,7 @@ class CtrlServer(Actor):
             )
         if self._fib_updates_q is not None:
             s.register("ctrl.fib.subscribe", self._subscribe_fib)
+            s.register("ctrl.fib.subscribe_detail", self._subscribe_fib_detail)
             self.add_task(
                 self._watch_initialization(self._fib_updates_q),
                 name=f"{self.name}.init-watch-fib",
@@ -386,10 +393,21 @@ class CtrlServer(Actor):
             for node, areas in dbs.items()
         }
 
-    async def _decision_received(self) -> list:
+    async def _decision_received(
+        self,
+        prefixes: Optional[list] = None,
+        node: str = "",
+        area: str = "",
+    ) -> list:
+        """ref getReceivedRoutes(Filtered) — ReceivedRouteFilter's
+        prefixes / nodeName / areaName axes (OpenrCtrl.thrift:245-253)."""
+        want = set(prefixes or [])
         return [
             [pfx, list(node_area), to_plain(entry)]
             for pfx, node_area, entry in await self.decision.get_received_routes()
+            if (not want or pfx in want)
+            and (not node or node_area[0] == node)
+            and (not area or node_area[1] == area)
         ]
 
     async def _set_rib_policy(self, policy: dict) -> dict:
@@ -419,6 +437,17 @@ class CtrlServer(Actor):
     async def _fib_mpls(self) -> dict:
         routes = await self.fib.get_mpls_route_db()
         return {str(l): to_plain(e) for l, e in routes.items()}
+
+    async def _fib_route_detail_db(self) -> dict:
+        """ref getRouteDetailDb (OpenrCtrl.thrift:392): programmed routes
+        WITH the selection detail FibService never sees — the winning
+        PrefixEntry (best_prefix_entry), best node/area, igp cost, LFA
+        backups — in RouteDatabaseDetail shape."""
+        return {
+            "node": self.node_name,
+            "unicast": await self._fib_routes(),
+            "mpls": await self._fib_mpls(),
+        }
 
     async def _fib_routes_filtered(self, prefixes: list) -> dict:
         """ref getUnicastRoutesFiltered: exact-prefix selection."""
@@ -507,10 +536,27 @@ class CtrlServer(Actor):
             for nb in await self.spark.get_neighbors()
         ]
 
-    async def _pm_advertised(self) -> dict:
+    async def _pm_advertised(
+        self,
+        prefixes: Optional[list] = None,
+        ptype: Optional[str] = None,
+        area: str = "",
+    ) -> dict:
+        """ref getAdvertisedRoutes(Filtered) + getAreaAdvertisedRoutes —
+        AdvertisedRouteFilter's prefixes / prefixType axes
+        (OpenrCtrl.thrift:64-67) plus the destination-area view."""
+        want = set(prefixes or [])
+        pt = self._parse_prefix_type(ptype) if ptype is not None else None
+        if area:
+            routes = await self.prefix_manager.get_area_advertised_routes(
+                area
+            )
+        else:
+            routes = await self.prefix_manager.get_advertised_routes()
         return {
             p: to_plain(e)
-            for p, e in (await self.prefix_manager.get_advertised_routes()).items()
+            for p, e in routes.items()
+            if (not want or p in want) and (pt is None or e.type == pt)
         }
 
     async def _pm_prefixes(self) -> dict:
@@ -712,57 +758,133 @@ class CtrlServer(Actor):
 
     # -- streaming subscriptions (ref OpenrCtrlHandler.h:351-389) ----------
 
+    def _register_stream(self, stream: Stream, kind: str) -> int:
+        """Track a live stream for getSubscriberInfo (ref
+        StreamSubscriberInfo, OpenrCtrl.thrift:72-83): every push stamps
+        last-sent time and bumps the message count."""
+        sid = self._next_subscriber_id
+        self._next_subscriber_id += 1
+        info = {
+            "subscriber_id": sid,
+            "type": kind,
+            "started": time.time(),
+            "last_msg_sent_time": 0.0,
+            "total_streamed_msgs": 0,
+        }
+        self._subscribers[sid] = info
+        orig_push = stream.push
+
+        def push(item):
+            info["total_streamed_msgs"] += 1
+            info["last_msg_sent_time"] = time.time()
+            orig_push(item)
+
+        stream.push = push
+        return sid
+
+    async def _subscriber_info(self, type: str = "") -> list:
+        """ref getSubscriberInfo(type): stats for every live streaming
+        subscription, optionally filtered by kind (kvstore / fib /
+        fib_detail)."""
+        now = time.time()
+        return [
+            {
+                "subscriber_id": i["subscriber_id"],
+                "type": i["type"],
+                "uptime_ms": int((now - i["started"]) * 1e3),
+                "last_msg_sent_time": i["last_msg_sent_time"],
+                "total_streamed_msgs": i["total_streamed_msgs"],
+            }
+            for i in self._subscribers.values()
+            if not type or i["type"] == type
+        ]
+
+    def _start_subscription(
+        self, kind: str, snapshot, queue, reader_suffix: str, on_item
+    ) -> Stream:
+        """Common tail of every subscribe handler: acquire the queue
+        reader (fallible — the producer may have closed the queue),
+        register the subscriber, push the pre-serialized snapshot, spawn
+        the pump. Every fallible step precedes registration so a failing
+        subscribe can't leak a phantom ctrl.subscriber_info entry."""
+        stream = Stream()
+        reader = queue.get_reader(f"{self.name}.{reader_suffix}")
+        sid = self._register_stream(stream, kind)
+        if snapshot is not None:
+            stream.push(snapshot)
+        self.add_task(
+            self._pump_subscription(
+                stream, reader, queue, lambda item: on_item(stream, item), sid
+            ),
+            name=f"{self.name}.{kind}-sub",
+        )
+        return stream
+
     async def _subscribe_kvstore(self, area: str = "0") -> Stream:
         """Snapshot + live deltas (ref subscribeAndGetKvStoreFiltered)."""
-        stream = Stream()
         snapshot = await self.kvstore.dump_all(area)
-        stream.push(
-            {
-                "snapshot": {k: to_plain(v) for k, v in snapshot.items()},
-                "area": area,
-            }
-        )
-        reader = self._kvstore_updates_q.get_reader(f"{self.name}.sub")
+        payload = {
+            "snapshot": {k: to_plain(v) for k, v in snapshot.items()},
+            "area": area,
+        }
 
-        def on_item(item):
+        def on_item(stream, item):
             if isinstance(item, Publication) and item.area == area:
                 stream.push({"delta": to_plain(item)})
 
-        self.add_task(
-            self._pump_subscription(stream, reader, self._kvstore_updates_q, on_item),
-            name=f"{self.name}.kvstore-sub",
+        return self._start_subscription(
+            "kvstore", payload, self._kvstore_updates_q, "sub", on_item
         )
-        return stream
+
+    @staticmethod
+    def _fib_delta(stream, item):
+        if not isinstance(item, InitializationEvent):
+            stream.push({"delta": to_plain(item)})
 
     async def _subscribe_fib(self) -> Stream:
         """Snapshot + programmed-route deltas (ref subscribeAndGetFib)."""
-        stream = Stream()
+        payload = None
         if self.fib is not None:
             routes = await self.fib.get_route_db()
-            stream.push(
-                {"snapshot": {p: to_plain(e) for p, e in routes.items()}}
-            )
-        reader = self._fib_updates_q.get_reader(f"{self.name}.sub")
-
-        def on_item(item):
-            if not isinstance(item, InitializationEvent):
-                stream.push({"delta": to_plain(item)})
-
-        self.add_task(
-            self._pump_subscription(stream, reader, self._fib_updates_q, on_item),
-            name=f"{self.name}.fib-sub",
+            payload = {
+                "snapshot": {p: to_plain(e) for p, e in routes.items()}
+            }
+        return self._start_subscription(
+            "fib", payload, self._fib_updates_q, "sub", self._fib_delta
         )
-        return stream
 
-    async def _pump_subscription(self, stream, reader, queue, on_item) -> None:
+    async def _subscribe_fib_detail(self) -> Stream:
+        """ref subscribeAndGetFibDetail (OpenrCtrlCpp.thrift:53-55):
+        RouteDatabaseDetail-shaped snapshot (node name + unicast incl.
+        best_prefix_entry + mpls) followed by live deltas."""
+        payload = None
+        if self.fib is not None:
+            payload = {"snapshot": await self._fib_route_detail_db()}
+        return self._start_subscription(
+            "fib_detail", payload, self._fib_updates_q, "subd",
+            self._fib_delta,
+        )
+
+    async def _pump_subscription(
+        self, stream, reader, queue, on_item, sid: Optional[int] = None
+    ) -> None:
         """Forward queue items into a stream until it closes. reader.get()
         races stream closure so a disconnected client's queue reader is
         unregistered promptly instead of on the next (possibly never)
         published item."""
         close_wait = asyncio.ensure_future(stream.wait_closed())
+        get_t = None
         try:
             while not stream.closed:
                 get_t = asyncio.ensure_future(reader.get())
+                # mark any exception retrieved up front: the task can be
+                # abandoned mid-flight (stream close, or this pump task
+                # cancelled at actor stop) and then completed by the
+                # queue closing — without this the loop logs "Task
+                # exception was never retrieved"
+                get_t.add_done_callback(
+                    lambda t: t.cancelled() or t.exception()
+                )
                 await asyncio.wait(
                     {get_t, close_wait}, return_when=asyncio.FIRST_COMPLETED
                 )
@@ -773,6 +895,10 @@ class CtrlServer(Actor):
         except QueueClosedError:
             pass
         finally:
+            if get_t is not None and not get_t.done():
+                get_t.cancel()
             close_wait.cancel()
             stream.close()
             queue.remove_reader(reader)
+            if sid is not None:
+                self._subscribers.pop(sid, None)

@@ -527,3 +527,15 @@ class PrefixManager(Actor):
 
     async def get_advertised_routes(self) -> dict[str, PrefixEntry]:
         return {p: entry for p, (entry, _) in self._advertised.items()}
+
+    async def get_area_advertised_routes(
+        self, area: str
+    ) -> dict[str, PrefixEntry]:
+        """What this node advertises INTO one area (ref
+        getAreaAdvertisedRoutes, OpenrCtrl.thrift:~330) — honors
+        per-(prefix,type) destination-area restrictions."""
+        return {
+            p: entry
+            for p, (entry, areas) in self._advertised.items()
+            if area in areas
+        }

@@ -70,6 +70,45 @@ class TestCtrlServer:
             advertised = await client.request("ctrl.prefixmgr.advertised")
             assert "10.0.0.1/32" in advertised
 
+            # AdvertisedRouteFilter axes (ref getAdvertisedRoutesFiltered)
+            assert "10.0.0.1/32" in await client.request(
+                "ctrl.prefixmgr.advertised", {"ptype": "BREEZE"}
+            )
+            assert (
+                await client.request(
+                    "ctrl.prefixmgr.advertised", {"ptype": "VIP"}
+                )
+                == {}
+            )
+            assert list(
+                await client.request(
+                    "ctrl.prefixmgr.advertised",
+                    {"prefixes": ["10.0.0.1/32"]},
+                )
+            ) == ["10.0.0.1/32"]
+            # destination-area view (ref getAreaAdvertisedRoutes)
+            assert "10.0.0.1/32" in await client.request(
+                "ctrl.prefixmgr.advertised", {"area": "0"}
+            )
+            assert (
+                await client.request(
+                    "ctrl.prefixmgr.advertised", {"area": "no-such-area"}
+                )
+                == {}
+            )
+
+            # ReceivedRouteFilter axes (ref getReceivedRoutesFiltered)
+            rec = await client.request(
+                "ctrl.decision.received_routes", {"node": "node-b"}
+            )
+            assert rec and all(r[1][0] == "node-b" for r in rec)
+            assert (
+                await client.request(
+                    "ctrl.decision.received_routes", {"node": "nope"}
+                )
+                == []
+            )
+
             counts = await client.request("monitor.counters", {"prefix": "spark"})
             assert counts
 
@@ -154,6 +193,77 @@ class TestCtrlServer:
             await a.stop()
             await b.stop()
 
+    @run_async
+    async def test_fib_route_detail_db(self):
+        """ref getRouteDetailDb: programmed routes carry the selection
+        detail FibService never sees (best_prefix_entry, best node)."""
+        mesh, a, b = await start_two_node()
+        client = RpcClient("127.0.0.1", a.ctrl.port)
+        try:
+            detail = await client.request("ctrl.fib.route_detail_db")
+            assert detail["node"] == "node-a"
+            entry = detail["unicast"]["10.0.0.2/32"]
+            assert entry["best_node_area"] == ["node-b", "0"]
+            assert entry["best_prefix_entry"] is not None
+            assert "mpls" in detail
+        finally:
+            await client.close()
+            await a.stop()
+            await b.stop()
+
+    @run_async
+    async def test_subscriber_info_and_fib_detail_stream(self):
+        """ref getSubscriberInfo + subscribeAndGetFibDetail: live stream
+        bookkeeping appears while subscribed, clears on disconnect; the
+        detail stream's snapshot is RouteDatabaseDetail-shaped."""
+        mesh, a, b = await start_two_node()
+        client = RpcClient("127.0.0.1", a.ctrl.port)
+        try:
+            assert await client.request("ctrl.subscriber_info") == []
+            q = await client.subscribe("ctrl.fib.subscribe_detail", {})
+            first = await asyncio.wait_for(q.get(), 5)
+            snap = first["snapshot"]
+            assert snap["node"] == "node-a"
+            assert "10.0.0.2/32" in snap["unicast"]
+            assert snap["unicast"]["10.0.0.2/32"]["best_prefix_entry"]
+
+            subs = await client.request("ctrl.subscriber_info")
+            assert len(subs) == 1
+            assert subs[0]["type"] == "fib_detail"
+            assert subs[0]["total_streamed_msgs"] >= 1
+            assert subs[0]["uptime_ms"] >= 0
+            # filter mismatches return nothing
+            assert (
+                await client.request(
+                    "ctrl.subscriber_info", {"type": "kvstore"}
+                )
+                == []
+            )
+
+            # a route change must flow as a delta and bump the counter
+            b.advertise_prefix("10.99.0.0/24")
+
+            async def hunt():
+                while True:
+                    item = await q.get()
+                    if isinstance(item, Exception):
+                        raise item
+                    if (
+                        item
+                        and "delta" in item
+                        and "10.99.0.0/24"
+                        in item["delta"]["unicast_routes_to_update"]
+                    ):
+                        return item
+
+            await asyncio.wait_for(hunt(), 10)
+            subs = await client.request("ctrl.subscriber_info")
+            assert subs[0]["total_streamed_msgs"] >= 2
+        finally:
+            await client.close()
+            await a.stop()
+            await b.stop()
+
 
 class TestBreezeCli:
     """Drive the real CLI against a live node running in a background
@@ -203,6 +313,13 @@ class TestBreezeCli:
             res = runner.invoke(cli, base + ["fib", "routes"], obj={})
             assert res.exit_code == 0, res.output
             assert "10.0.0.2/32" in res.output
+
+            res = runner.invoke(cli, base + ["fib", "route-detail"], obj={})
+            assert res.exit_code == 0, res.output
+            assert "best_prefix_entry" in res.output
+
+            res = runner.invoke(cli, base + ["openr", "subscribers"], obj={})
+            assert res.exit_code == 0, res.output
 
             res = runner.invoke(cli, base + ["spark", "neighbors"], obj={})
             assert res.exit_code == 0, res.output
