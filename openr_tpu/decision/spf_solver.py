@@ -123,6 +123,13 @@ class SpfSolver:
         self.static_unicast_routes: dict[str, RibUnicastEntry] = {}
         self.static_mpls_routes: dict[int, RibMplsEntry] = {}
         self.best_routes_cache: dict[str, RouteSelectionResult] = {}
+        # optional accelerator hook for resolve_ucmp_weights (the TPU
+        # solver installs a device-backed one): called with
+        # (my_node_name, area, link_state, dst_weights,
+        # use_prefix_weight) and returns the root's NodeUcmpResult (or
+        # None when UCMP is inapplicable); NotImplemented falls back to
+        # the host heap walk
+        self.ucmp_resolver = None
 
     # -- static routes (ref SpfSolver.cpp:118-174) -------------------------
 
@@ -808,12 +815,19 @@ class SpfSolver:
             if not entry.weight:
                 return None  # a best route without weight disables UCMP
             dst_weights[dst_node] = entry.weight
+        use_prefix_weight = (
+            fwd_algo
+            == PrefixForwardingAlgorithm.SP_UCMP_PREFIX_WEIGHT_PROPAGATION
+        )
+        if self.ucmp_resolver is not None:
+            res = self.ucmp_resolver(
+                my_node_name, area, link_state, dst_weights,
+                use_prefix_weight,
+            )
+            if res is not NotImplemented:
+                return res
         results = link_state.resolve_ucmp_weights(
-            spf,
-            dst_weights,
-            use_prefix_weight=(
-                fwd_algo == PrefixForwardingAlgorithm.SP_UCMP_PREFIX_WEIGHT_PROPAGATION
-            ),
+            spf, dst_weights, use_prefix_weight=use_prefix_weight
         )
         return results.get(my_node_name)
 

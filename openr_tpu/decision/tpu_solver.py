@@ -39,12 +39,12 @@ backup next-hops) run the fused device pipeline; KSP2 (SR_MPLS +
 KSP2_ED_ECMP) prefixes are device-ASSISTED — the per-destination
 masked second-pass SSSPs batch on device (ops/ksp2.py) while the
 oracle's selection/trace/label assembly stays host-side, primed through
-the k-paths cache. What remains host-only, deliberately:
-  - UCMP weight resolution (resolve_ucmp_weights): the per-node
-    gcd-normalized leaf-to-root propagation is order-dependent and
-    sequential along the DAG — a hardware-hostile shape the reference
-    also computes per-prefix on CPU (LinkState.cpp:913-1033); prefixes
-    using it fall back to the oracle per prefix.
+the k-paths cache. UCMP prefixes are likewise device-assisted: the
+leaf-to-root weight propagation (ref LinkState.cpp:913-1033) runs as a
+masked segment-sum fixpoint over the device SSSP field (ops/ucmp.py,
+installed as the oracle's ucmp_resolver via _UcmpAccel), with the
+root-local per-interface grouping and gcd normalization on host.
+What remains host-only, deliberately:
   - cross-area-announced prefixes: selection and the min-metric
     next-hop union are global across areas; these go to the oracle.
     Multi-area LSDBs otherwise run on device — a prefix announced in
@@ -64,7 +64,7 @@ from typing import Optional
 
 import numpy as np
 
-from openr_tpu.decision.link_state import LinkState
+from openr_tpu.decision.link_state import LinkState, NodeUcmpResult
 from openr_tpu.decision.prefix_state import PrefixState
 from openr_tpu.decision.rib import DecisionRouteDb, NextHop, RibUnicastEntry
 from openr_tpu.decision.spf_solver import SpfSolver, select_best_node_area
@@ -578,6 +578,155 @@ class _VantageState:
         self.valid = False
 
 
+_UCMP_ALGOS = (
+    PrefixForwardingAlgorithm.SP_UCMP_ADJ_WEIGHT_PROPAGATION,
+    PrefixForwardingAlgorithm.SP_UCMP_PREFIX_WEIGHT_PROPAGATION,
+)
+
+
+class _UcmpAccel:
+    """Device-backed `ucmp_resolver` installed on the internal CPU
+    oracle: replaces the host heap walk of resolve_ucmp_weights
+    (ref LinkState.cpp:913-1033) with the ops/ucmp.py fixpoint over the
+    device SSSP field. Falls back (NotImplemented) whenever its area
+    state is stale — single-prefix incremental rebuilds, small graphs
+    routed entirely to the oracle, cross-area UCMP prefixes — so the
+    host path remains the correctness backstop."""
+
+    def __init__(self, solver: "TpuSpfSolver"):
+        self.solver = solver
+        # area -> (generation, plan, UcmpEdges)
+        self.edges: dict[str, tuple] = {}
+        # (area, root) -> (generation, plan, d_base, base_np) — the
+        # unmasked SSSP field, shared with the KSP2 base when present
+        self.base: dict[tuple, tuple] = {}
+        # per-generation memo: many prefixes share one announcer set
+        # (anycast), so identical (leaves, mode) resolve once
+        self.results: dict[tuple, object] = {}
+        self._results_gen: dict[str, int] = {}
+
+    def _base_for(self, area: str, root: str, ridx: int, link_state,
+                  ad: _AreaDev):
+        from openr_tpu.ops.ksp2 import base_dist
+
+        gen = link_state.generation
+        plan = ad.plan
+        cached = self.solver._ksp2_base.get((area, root))
+        if cached is not None and cached[0] == gen and cached[1] is plan:
+            return cached[2], cached[3]
+        mine = self.base.get((area, root))
+        if mine is not None and mine[0] == gen and mine[1] is plan:
+            return mine[2], mine[3]
+        d_base = base_dist(
+            plan, ad.d_shift_w, ad.d_res_rows, ad.d_res_nbr, ad.d_res_w,
+            ad.d_deltas, ridx,
+        )
+        base_np = np.asarray(d_base)
+        self.base[(area, root)] = (gen, plan, d_base, base_np)
+        return d_base, base_np
+
+    def _edges_for(self, area: str, link_state, plan) -> "object":
+        from openr_tpu.ops.ucmp import UcmpEdges
+
+        gen = link_state.generation
+        hit = self.edges.get(area)
+        if hit is not None and hit[0] == gen and hit[1] is plan:
+            return hit[2]
+        edges = UcmpEdges(link_state, plan.node_overloaded, plan.n_cap)
+        self.edges[area] = (gen, plan, edges)
+        return edges
+
+    def __call__(self, root, area, link_state, dst_weights,
+                 use_prefix_weight):
+        from openr_tpu.ops import ucmp as ucmp_ops
+
+        solver = self.solver
+        ad = solver._area_dev.get(area)
+        gen = link_state.generation
+        if (
+            not dst_weights
+            or ad is None
+            or ad.plan is None
+            or ad.plan.synced_generation != gen
+            or link_state.is_node_overloaded(root)
+        ):
+            return NotImplemented
+        plan = ad.plan
+        ridx = plan.node_index.get(root)
+        if ridx is None:
+            return NotImplemented
+        if self._results_gen.get(area) != gen:
+            self.results = {
+                k: v for k, v in self.results.items() if k[0] != area
+            }
+            self._results_gen[area] = gen
+        rkey = (
+            area, root, tuple(sorted(dst_weights.items())),
+            bool(use_prefix_weight),
+        )
+        if rkey in self.results:
+            return self.results[rkey]
+        d_base, base_np = self._base_for(area, root, ridx, link_state, ad)
+        # the caller filtered leaves to the best metric, so they are
+        # equidistant by construction — mirror the host guard anyway
+        leaf_metrics = {
+            int(base_np[plan.node_index[n]])
+            for n in dst_weights
+            if n in plan.node_index
+        }
+        if len(leaf_metrics) != 1 or INF_E in leaf_metrics:
+            self.results[rkey] = None
+            return None
+        edges = self._edges_for(area, link_state, plan)
+        reach, w, overflow = ucmp_ops.propagate(
+            edges, d_base, dst_weights, use_prefix_weight
+        )
+        if overflow:
+            # weighted path counts exceeded int32 — the host walk's
+            # Python ints are exact. Memoize the fallback sentinel so
+            # sibling anycast prefixes skip the wasted device round trip
+            self.results[rkey] = NotImplemented
+            return NotImplemented
+        res = self._assemble(
+            root, ridx, link_state, plan, base_np, reach, w, dst_weights
+        )
+        self.results[rkey] = res
+        return res
+
+    @staticmethod
+    def _assemble(root, ridx, link_state, plan, base_np, reach, w,
+                  dst_weights):
+        """Root-local finish: per-interface next-hop weights from the
+        propagated field, gcd-normalized (host NodeUcmpResult shape,
+        O(degree(root)))."""
+        res = NodeUcmpResult(0)
+        if root in dst_weights:
+            # the root itself announces: a leaf's weight is its own
+            # advertisement; equidistant leaves cannot chain, so no
+            # next-hop links accumulate (matches the host walk)
+            res.weight = dst_weights[root]
+            return res
+        if not reach[ridx]:
+            return None
+        my_dist = int(base_np[ridx])
+        index = plan.node_index
+        for link in link_state.ordered_links_from_node(root):
+            if not link.is_up():
+                continue
+            nbr = link.other_node(root)
+            j = index.get(nbr)
+            if j is None or not reach[j]:
+                continue
+            if my_dist + link.metric_from_node(root) != int(base_np[j]):
+                continue  # not a shortest-path DAG edge
+            res.add_next_hop_link(
+                link.iface_from_node(root), link, nbr, int(w[j])
+            )
+        res.weight = int(w[ridx])
+        res.normalize_next_hop_weights()
+        return res
+
+
 def _fast_path_eligible(entries) -> bool:
     """Device fast path covers IP + SP_ECMP announcements without prepend
     labels; anything else routes through the CPU oracle."""
@@ -624,6 +773,10 @@ class TpuSpfSolver:
         # whole CPU solve there (the "auto" backend sets this)
         self.small_graph_nodes = small_graph_nodes
         self.cpu = SpfSolver(my_node_name, **solver_kwargs)
+        # UCMP weight resolution runs on device through the oracle's
+        # resolver hook (falls back to the host walk when stale)
+        self._ucmp_accel = _UcmpAccel(self)
+        self.cpu.ucmp_resolver = self._ucmp_accel
         self._area_dev: dict[str, _AreaDev] = {}
         self._vstates: dict[tuple, _VantageState] = {}
         self._vantage_lru: list[tuple] = []
@@ -771,6 +924,11 @@ class TpuSpfSolver:
                 fast_by_area.get(area, []),
             )
 
+        if self.cpu.enable_ucmp:
+            self._prime_ucmp(
+                my_node_name, area_link_states, prefix_state, slow,
+                fast_by_area,
+            )
         self._host_routes(
             my_node_name, area_link_states, prefix_state,
             slow + ksp2 + small, route_db,
@@ -778,6 +936,55 @@ class TpuSpfSolver:
         for finish in finishes:
             finish(route_db)
         return route_db
+
+    def _prime_ucmp(
+        self, my_node_name, area_link_states, prefix_state, slow,
+        fast_by_area,
+    ) -> None:
+        """Before the oracle loop touches UCMP prefixes, sync their
+        areas' device mirrors and prime LinkState's SPF memo from the
+        device base field — the oracle's `get_spf_result(root)` in
+        _get_node_ucmp_result then answers lazily instead of running a
+        host Dijkstra, and the resolver hook finds fresh area state."""
+        by_area: dict[str, bool] = {}
+        for prefix in slow:
+            entries = prefix_state.entries_for(prefix) or {}
+            areas = {a for _, a in entries}
+            if len(areas) != 1:
+                continue  # cross-area: oracle host path by design
+            if any(
+                e.forwarding_algorithm in _UCMP_ALGOS
+                for e in entries.values()
+            ):
+                by_area[next(iter(areas))] = True
+        for area in by_area:
+            link_state = area_link_states.get(area)
+            if (
+                link_state is None
+                or not link_state.has_node(my_node_name)
+                or link_state.node_count() < self.small_graph_nodes
+                or link_state.is_node_overloaded(my_node_name)
+            ):
+                continue
+            ad = self._sync_area(
+                area, link_state, prefix_state, fast_by_area.get(area, [])
+            )
+            ridx = ad.plan.node_index.get(my_node_name)
+            if ridx is None:
+                continue
+            _, base_np = self._ucmp_accel._base_for(
+                area, my_node_name, ridx, link_state, ad
+            )
+            node_index = ad.plan.node_index
+
+            def metric_of(n, _idx=node_index, _base=base_np):
+                j = _idx.get(n)
+                if j is None:
+                    return None
+                v = int(_base[j])
+                return None if v >= INF_E else v
+
+            link_state.prime_spf_metrics(my_node_name, metric_of)
 
     def _partition_prefixes(
         self,

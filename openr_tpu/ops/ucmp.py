@@ -1,0 +1,194 @@
+"""Device UCMP weight propagation.
+
+Role of the reference's `LinkState::resolveUcmpWeights`
+(/root/reference/openr/decision/LinkState.cpp:913-1033): starting from
+the prefix's announcers ("leaves", all equidistant from the computing
+root), walk the shortest-path DAG leaf->root accumulating advertised
+weights, yielding per-next-hop load-balancing weights at the root.
+
+The reference (and our CPU oracle, link_state.resolve_ucmp_weights)
+does this with a heap walk — per-node sequential along the DAG. The
+device formulation observes that the walk computes a fixpoint that is
+expressible as masked edge aggregations over the SSSP distance field
+the solver already has:
+
+  - DAG membership per directed edge (u -> v):
+        dist[u] + w_eff(u->v) == dist[v]       (both finite)
+  - reach(v): v lies on a shortest root->leaf path — the leaf set
+    propagated backward one DAG level per iteration.
+  - node weight w(v):
+        leaf:        its advertised weight
+        prefix mode: sum over DAG out-edges (v -> s, reach(s)) of w(s)
+        adj mode:    sum over DAG out-edges (v -> s, reach(s)) of the
+                     static link weight of (v -> s)
+    (ref SP_UCMP_PREFIX_WEIGHT_PROPAGATION vs
+     SP_UCMP_ADJ_WEIGHT_PROPAGATION)
+
+Both reach and w converge in DAG-depth iterations of
+`segment_sum`/`segment_max` scatter-aggregations — the same O(E)-per-
+round shape as the SSSP relaxation, batch-friendly and free of the
+heap's sequential dependency. The root's per-interface weights and the
+gcd normalization are O(degree(root)) host work (ops consumers:
+decision/tpu_solver.py installs this as the oracle's ucmp_resolver).
+
+Weights accumulate weighted path counts, which can overflow int32 on
+deep fat trees (jax's default config has no int64). A float32 shadow
+of the propagation tracks magnitude — floats saturate instead of
+wrapping — and flags any node weight beyond 2^30; the caller then falls
+back to the host walk, whose Python ints are unbounded.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from openr_tpu.ops.edgeplan import INF32E, MAX_METRIC, natural_key
+
+INF_E = int(INF32E)
+
+
+@functools.lru_cache(maxsize=None)
+def _ucmp_fn(e_cap: int, n_cap: int, use_prefix_weight: bool):
+    import jax
+    import jax.numpy as jnp
+
+    def f(src, dst, w_eff, adj_w, dist, leaf_mask, leaf_w):
+        du, dv = dist[src], dist[dst]
+        dag = (
+            (w_eff < INF_E)
+            & (du < INF_E)
+            & (dv < INF_E)
+            & (du + w_eff == dv)
+        )
+        zero = jnp.zeros((), jnp.int32)
+        w0 = jnp.where(leaf_mask, leaf_w, zero)
+        wf0 = w0.astype(jnp.float32)
+
+        def body(state):
+            _, reach, w, wf = state
+            rv = reach[dst] & dag
+            if use_prefix_weight:
+                per_edge = jnp.where(rv, w[dst], zero)
+                per_edge_f = jnp.where(rv, wf[dst], 0.0)
+            else:
+                per_edge = jnp.where(rv, adj_w, zero)
+                per_edge_f = per_edge.astype(jnp.float32)
+            acc = jax.ops.segment_sum(per_edge, src, num_segments=n_cap)
+            new_w = jnp.where(leaf_mask, leaf_w, acc)
+            new_wf = jnp.where(
+                leaf_mask,
+                leaf_w.astype(jnp.float32),
+                jax.ops.segment_sum(per_edge_f, src, num_segments=n_cap),
+            )
+            hit = jax.ops.segment_max(
+                rv.astype(jnp.int32), src, num_segments=n_cap
+            )
+            new_reach = leaf_mask | (hit > 0)
+            changed = jnp.any(new_reach != reach) | jnp.any(new_w != w)
+            return changed, new_reach, new_w, new_wf
+
+        def cond(state):
+            return state[0]
+
+        _, reach, w, wf = jax.lax.while_loop(
+            cond, body, (jnp.bool_(True), leaf_mask, w0, wf0)
+        )
+        # float shadow saturates instead of wrapping: any node beyond
+        # 2^30 means the int32 field may have overflowed
+        overflow = jnp.any(wf > jnp.float32(1 << 30))
+        return reach, w, overflow
+
+    return jax.jit(f)
+
+
+class UcmpEdges:
+    """Directed-edge arrays for one area's LinkState, padded to a pow2
+    cap, device-resident; rebuilt per topology generation (the per-link
+    Python extraction is memoized by LinkState.mirror_source)."""
+
+    def __init__(self, link_state, node_overloaded: np.ndarray,
+                 n_cap: int):
+        import jax
+
+        names, index, n1i, n2i, trip, links = link_state.mirror_source(
+            natural_key
+        )
+        m = len(links)
+        e2 = m * 2
+        e_cap = 1
+        while e_cap < max(e2, 8):
+            e_cap *= 2
+        src = np.zeros(e_cap, np.int32)
+        dst = np.zeros(e_cap, np.int32)
+        w_eff = np.full(e_cap, INF_E, np.int32)
+        adj_w = np.zeros(e_cap, np.int32)
+        if m:
+            src[0:e2:2] = n1i
+            src[1:e2:2] = n2i
+            dst[0:e2:2] = n2i
+            dst[1:e2:2] = n1i
+            wdir = np.empty(e2, np.int64)
+            wdir[0::2] = trip[:, 0]
+            wdir[1::2] = trip[:, 1]
+            up2 = np.repeat(trip[:, 2].astype(bool), 2)
+            # identical masking to ops/edgeplan.build_plan: a drained
+            # (overloaded) source node provides no transit
+            w_eff[:e2] = np.where(
+                up2 & ~node_overloaded[src[:e2]],
+                np.minimum(wdir, MAX_METRIC),
+                INF_E,
+            ).astype(np.int32)
+            # static per-direction link weights; unlike metrics these are
+            # never added to distances, so the INF32E clipping discipline
+            # does not apply — out-of-range weights instead force the
+            # exact host walk (adj_w_unsafe)
+            aw = np.array(
+                [
+                    (l.weight_from_node(l.n1), l.weight_from_node(l.n2))
+                    for l in links
+                ],
+                np.int64,
+            )
+            self.adj_w_unsafe = bool((np.abs(aw) > (1 << 30)).any())
+            if not self.adj_w_unsafe:
+                adj_w[0:e2:2] = aw[:, 0]
+                adj_w[1:e2:2] = aw[:, 1]
+        else:
+            self.adj_w_unsafe = False
+        self.e_cap = e_cap
+        self.n_cap = n_cap
+        self.node_index = index
+        self.d_src = jax.device_put(src)
+        self.d_dst = jax.device_put(dst)
+        self.d_w_eff = jax.device_put(w_eff)
+        self.d_adj_w = jax.device_put(adj_w)
+
+
+def propagate(edges: UcmpEdges, d_dist, leaf_weights: dict[str, int],
+              use_prefix_weight: bool):
+    """Run the fixpoint; returns (reach, w, overflow) with reach/w as
+    HOST numpy arrays ([n_cap] bool, [n_cap] int32). d_dist is the
+    device SSSP row from the computing root (ops/ksp2.base_dist).
+    overflow=True means the int32 field is untrustworthy — the caller
+    must fall back to the host walk."""
+    import jax
+
+    if leaf_weights and max(leaf_weights.values()) > (1 << 30):
+        return None, None, True
+    if not use_prefix_weight and edges.adj_w_unsafe:
+        return None, None, True
+    leaf_mask = np.zeros(edges.n_cap, bool)
+    leaf_w = np.zeros(edges.n_cap, np.int32)
+    for name, weight in leaf_weights.items():
+        i = edges.node_index.get(name)
+        if i is not None:
+            leaf_mask[i] = True
+            leaf_w[i] = weight
+    fn = _ucmp_fn(edges.e_cap, edges.n_cap, bool(use_prefix_weight))
+    reach, w, overflow = fn(
+        edges.d_src, edges.d_dst, edges.d_w_eff, edges.d_adj_w,
+        d_dist, jax.device_put(leaf_mask), jax.device_put(leaf_w),
+    )
+    return np.asarray(reach), np.asarray(w), bool(overflow)

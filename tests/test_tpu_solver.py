@@ -334,3 +334,214 @@ def test_node_labels_via_tpu_backend():
         "a", states, PrefixState(), enable_node_segment_label=True
     )
     assert set(cpu_db.mpls_routes) == {101, 102, 103, 104}
+
+
+# -- UCMP on device --------------------------------------------------------
+# The oracle's resolve_ucmp_weights heap walk (ref LinkState.cpp:913-1033)
+# vs the device segment-sum fixpoint (ops/ucmp.py via _UcmpAccel).
+
+def ucmp_states():
+    """Two-level DAG with multipath, unit metrics:
+        r - {a, b}; a - {c, d}; b - {d, e}; c - l1; d - {l1, l2}; e - l2
+    l1/l2 are equidistant (3) from r and (2) from a/b."""
+    ls = LinkState("0")
+    topo = {
+        "r": ["a", "b"],
+        "a": ["r", "c", "d"],
+        "b": ["r", "d", "e"],
+        "c": ["a", "l1"],
+        "d": ["a", "b", "l1", "l2"],
+        "e": ["b", "l2"],
+        "l1": ["c", "d"],
+        "l2": ["d", "e"],
+    }
+    for node, others in topo.items():
+        ls.update_adjacency_database(
+            adj_db(node, [adj(node, o, weight=10 + ord(o[0]) % 7) for o in others])
+        )
+    return {"0": ls}
+
+
+def ucmp_prefix_state(algo, weights=(3, 5)):
+    ps = PrefixState()
+    for node, w in zip(("l1", "l2"), weights):
+        ps.update_prefix_database(
+            prefix_db(
+                node, "fd00::100/128", forwarding_algorithm=algo, weight=w
+            )
+        )
+    return ps
+
+
+def test_ucmp_differential_prefix_weight_propagation():
+    states = ucmp_states()
+    ps = ucmp_prefix_state(
+        PrefixForwardingAlgorithm.SP_UCMP_PREFIX_WEIGHT_PROPAGATION
+    )
+    for me in ("r", "a", "b"):
+        cpu = SpfSolver(me, enable_ucmp=True)
+        tpu = TpuSpfSolver(me, enable_ucmp=True)
+        cpu_db = cpu.build_route_db(me, states, ps)
+        tpu_db = tpu.build_route_db(me, states, ps)
+        assert_rib_equal(cpu_db, tpu_db, f"ucmp prefix-weight vantage {me}")
+        route = tpu_db.unicast_routes["fd00::100/128"]
+        assert route.ucmp_weight is not None
+        assert any(nh.weight for nh in route.nexthops)
+        # the device resolver actually answered (no host fallback)
+        assert any(
+            v is not None for v in tpu._ucmp_accel.results.values()
+        ), "device UCMP path did not engage"
+
+
+def test_ucmp_differential_adj_weight_propagation():
+    states = ucmp_states()
+    ps = ucmp_prefix_state(
+        PrefixForwardingAlgorithm.SP_UCMP_ADJ_WEIGHT_PROPAGATION
+    )
+    for me in ("r", "a", "b"):
+        cpu = SpfSolver(me, enable_ucmp=True)
+        tpu = TpuSpfSolver(me, enable_ucmp=True)
+        cpu_db = cpu.build_route_db(me, states, ps)
+        tpu_db = tpu.build_route_db(me, states, ps)
+        assert_rib_equal(cpu_db, tpu_db, f"ucmp adj-weight vantage {me}")
+        assert tpu._ucmp_accel.results, "device UCMP path did not engage"
+
+
+def test_ucmp_differential_through_churn():
+    """Metric churn changes the DAG; per-generation caches (edges, base
+    field, result memo) must invalidate and re-agree with the oracle."""
+    states = ucmp_states()
+    ls = states["0"]
+    ps = ucmp_prefix_state(
+        PrefixForwardingAlgorithm.SP_UCMP_PREFIX_WEIGHT_PROPAGATION
+    )
+    cpu = SpfSolver("r", enable_ucmp=True)
+    tpu = TpuSpfSolver("r", enable_ucmp=True)
+    assert_rib_equal(
+        cpu.build_route_db("r", states, ps),
+        tpu.build_route_db("r", states, ps),
+        "before churn",
+    )
+    # stretch r-a: the whole left arm leaves the shortest DAG
+    ls.update_adjacency_database(
+        adj_db("r", [adj("r", "a", metric=5), adj("r", "b")])
+    )
+    assert_rib_equal(
+        cpu.build_route_db("r", states, ps),
+        tpu.build_route_db("r", states, ps),
+        "after churn",
+    )
+    # heal it back
+    ls.update_adjacency_database(
+        adj_db("r", [adj("r", "a"), adj("r", "b")])
+    )
+    assert_rib_equal(
+        cpu.build_route_db("r", states, ps),
+        tpu.build_route_db("r", states, ps),
+        "after heal",
+    )
+
+
+def test_ucmp_anycast_shares_one_resolve():
+    """Anycast prefixes with identical (leaves, weights, mode) resolve
+    once on device (the result memo), and every prefix still matches."""
+    states = ucmp_states()
+    ps = ucmp_prefix_state(
+        PrefixForwardingAlgorithm.SP_UCMP_PREFIX_WEIGHT_PROPAGATION
+    )
+    for node, w in zip(("l1", "l2"), (3, 5)):
+        ps.update_prefix_database(
+            prefix_db(
+                node, "fd00::200/128",
+                forwarding_algorithm=(
+                    PrefixForwardingAlgorithm.SP_UCMP_PREFIX_WEIGHT_PROPAGATION
+                ),
+                weight=w,
+            )
+        )
+    cpu = SpfSolver("r", enable_ucmp=True)
+    tpu = TpuSpfSolver("r", enable_ucmp=True)
+    assert_rib_equal(
+        cpu.build_route_db("r", states, ps),
+        tpu.build_route_db("r", states, ps),
+        "anycast ucmp",
+    )
+    assert len(tpu._ucmp_accel.results) == 1  # shared leafset memo
+
+
+def test_ucmp_random_mesh_differential():
+    """Random mesh: announcer distances differ, so only the best-metric
+    subset becomes leaves; RIBs must match across vantages and modes."""
+    adj_dbs, _ = topologies.random_mesh(24, seed=11)
+    states, _ = topologies.build_states(adj_dbs, [])
+    names = [db.this_node_name for db in adj_dbs]
+    for algo in (
+        PrefixForwardingAlgorithm.SP_UCMP_PREFIX_WEIGHT_PROPAGATION,
+        PrefixForwardingAlgorithm.SP_UCMP_ADJ_WEIGHT_PROPAGATION,
+    ):
+        ps = PrefixState()
+        for node, w in zip(names[3:9], (2, 4, 6, 3, 5, 7)):
+            ps.update_prefix_database(
+                prefix_db(node, "fd00::a0/128", forwarding_algorithm=algo, weight=w)
+            )
+        for me in names[:4]:
+            cpu = SpfSolver(me, enable_ucmp=True)
+            tpu = TpuSpfSolver(me, enable_ucmp=True)
+            cpu_db = cpu.build_route_db(me, states, ps)
+            tpu_db = tpu.build_route_db(me, states, ps)
+            assert_rib_equal(cpu_db, tpu_db, f"random ucmp {algo} {me}")
+
+
+def test_ucmp_overflow_falls_back_to_host():
+    """Leaf weights beyond the int32-safe bound must not go through the
+    device fixpoint; the host walk (exact Python ints) answers and the
+    differential still holds."""
+    states = ucmp_states()
+    big = 1 << 31  # > float-shadow threshold
+    ps = ucmp_prefix_state(
+        PrefixForwardingAlgorithm.SP_UCMP_PREFIX_WEIGHT_PROPAGATION,
+        weights=(big, big * 2),
+    )
+    cpu = SpfSolver("r", enable_ucmp=True)
+    tpu = TpuSpfSolver("r", enable_ucmp=True)
+    cpu_db = cpu.build_route_db("r", states, ps)
+    tpu_db = tpu.build_route_db("r", states, ps)
+    assert_rib_equal(cpu_db, tpu_db, "ucmp overflow fallback")
+    route = tpu_db.unicast_routes["fd00::100/128"]
+    # exact (multipath-multiplied), far beyond anything int32 could hold
+    assert route.ucmp_weight > (1 << 32)
+    # the fallback is memoized as a sentinel so sibling anycast prefixes
+    # skip the wasted device round trip
+    assert all(
+        v is NotImplemented for v in tpu._ucmp_accel.results.values()
+    )
+
+
+def test_ucmp_huge_adjacency_weight_falls_back_exactly():
+    """Adjacency weights beyond the int32-safe bound skip the device
+    fixpoint (no silent clipping) and the host walk keeps the ratios
+    exact."""
+    states = ucmp_states()
+    ls = states["0"]
+    big = (1 << 31) + 6  # would clip/wrap on device
+    ls.update_adjacency_database(
+        adj_db(
+            "d",
+            [
+                adj("d", "a", weight=big),
+                adj("d", "b", weight=big),
+                adj("d", "l1", weight=big),
+                adj("d", "l2", weight=big * 2),
+            ],
+        )
+    )
+    ps = ucmp_prefix_state(
+        PrefixForwardingAlgorithm.SP_UCMP_ADJ_WEIGHT_PROPAGATION
+    )
+    cpu = SpfSolver("r", enable_ucmp=True)
+    tpu = TpuSpfSolver("r", enable_ucmp=True)
+    assert_rib_equal(
+        cpu.build_route_db("r", states, ps),
+        tpu.build_route_db("r", states, ps),
+        "huge adj weight",
+    )
