@@ -1,0 +1,228 @@
+#!/usr/bin/env bash
+# Lab 201 — three daemons, two areas, cross-area redistribution over
+# real kernel FIBs. See README.md for what each assertion proves.
+set -u
+
+REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+export PYTHONPATH="$REPO"
+export OPENR_TPU_XLA_CACHE=off
+WORK="$(mktemp -d /tmp/openr-lab201.XXXXXX)"
+NS_L=orlab2-l NS_C=orlab2-c NS_R=orlab2-r
+TABLE=254
+PIDS=()
+
+log() { echo "[lab201] $*"; }
+fail() {
+  echo "[lab201] FAIL: $*" >&2
+  for ns in $NS_L $NS_C $NS_R; do
+    echo "--- $ns routes ---"; ip netns exec "$ns" ip route show 2>/dev/null
+  done
+  for f in "$WORK"/*.log; do echo "--- $f (tail) ---"; tail -5 "$f"; done
+  cleanup; exit 1
+}
+cleanup() {
+  for pid in "${PIDS[@]:-}"; do kill "$pid" 2>/dev/null; done
+  wait 2>/dev/null
+  for ns in $NS_L $NS_C $NS_R; do ip netns del "$ns" 2>/dev/null; done
+  rm -rf "$WORK"
+}
+trap cleanup EXIT
+
+retry() { # retry <tries> <sleep> <desc> <cmd...>
+  local tries=$1 delay=$2 desc=$3; shift 3
+  for _ in $(seq 1 "$tries"); do "$@" >/dev/null 2>&1 && return 0; sleep "$delay"; done
+  fail "$desc"
+}
+
+# -- per-node PKI: the cross-namespace kvstore peer plane runs mutual TLS
+# (without TLS the peer plane fail-closes to loopback) ----------------------
+PKI="$WORK/pki"
+mkdir -p "$PKI"
+openssl req -x509 -newkey rsa:2048 -nodes -keyout "$PKI/ca.key" \
+  -out "$PKI/ca.crt" -days 1 -subj "/CN=lab-ca" 2>/dev/null
+for n in lab-left lab-center lab-right; do
+  openssl req -newkey rsa:2048 -nodes -keyout "$PKI/$n.key" \
+    -out "$PKI/$n.csr" -subj "/CN=$n" 2>/dev/null
+  openssl x509 -req -in "$PKI/$n.csr" -CA "$PKI/ca.crt" \
+    -CAkey "$PKI/ca.key" -CAcreateserial -out "$PKI/$n.crt" -days 1 \
+    2>/dev/null
+done
+
+# -- namespaces + veths: left <-> center <-> right --------------------------
+for ns in $NS_L $NS_C $NS_R; do
+  ip netns add "$ns" || { echo "needs CAP_NET_ADMIN"; exit 1; }
+  ip netns exec "$ns" ip link set lo up
+done
+ip link add or2-lc type veth peer name or2-cl
+ip link add or2-cr type veth peer name or2-rc
+ip link set or2-lc netns $NS_L
+ip link set or2-cl netns $NS_C
+ip link set or2-cr netns $NS_C
+ip link set or2-rc netns $NS_R
+ip netns exec $NS_L ip addr add 10.101.0.1/30 dev or2-lc
+ip netns exec $NS_C ip addr add 10.101.0.2/30 dev or2-cl
+ip netns exec $NS_C ip addr add 10.101.0.5/30 dev or2-cr
+ip netns exec $NS_R ip addr add 10.101.0.6/30 dev or2-rc
+ip netns exec $NS_L ip link set or2-lc up
+ip netns exec $NS_C ip link set or2-cl up
+ip netns exec $NS_C ip link set or2-cr up
+ip netns exec $NS_R ip link set or2-rc up
+ip netns exec $NS_C sysctl -qw net.ipv4.ip_forward=1
+log "namespaces up: $NS_L <-area1-> $NS_C <-area2-> $NS_R (fwd on in center)"
+
+# -- configs ----------------------------------------------------------------
+# left/right: one non-default area each. center: both, with interface
+# matchers steering each adjacency into its area (ref AreaConfig regexes).
+tls() { # node
+cat <<JSON
+ "kvstore_config": {"enable_secure_peers": true},
+ "thrift_server": {"x509_cert_path": "$PKI/$1.crt",
+                    "x509_key_path": "$PKI/$1.key",
+                    "x509_ca_path": "$PKI/ca.crt"},
+JSON
+}
+mkedge() { # node iface area loopback-prefix
+cat > "$WORK/$1.json" <<JSON
+{"node_name": "$1",
+ "decision_config": {"solver_backend": "cpu"},
+$(tls "$1")
+ "areas": [{"area_id": "$3",
+            "neighbor_regexes": [".*"],
+            "include_interface_regexes": ["$2"]}],
+ "link_monitor_config": {"enable_netlink_interfaces": true,
+                          "include_interface_regexes": ["$2"],
+                          "linkflap_initial_backoff_ms": 1,
+                          "linkflap_max_backoff_ms": 8},
+ "originated_prefixes": [{"prefix": "$4"}]}
+JSON
+}
+mkedge lab-left or2-lc area1 10.201.1.0/24
+mkedge lab-right or2-rc area2 10.201.2.0/24
+cat > "$WORK/lab-center.json" <<JSON
+{"node_name": "lab-center",
+ "decision_config": {"solver_backend": "cpu"},
+$(tls lab-center)
+ "areas": [{"area_id": "area1",
+            "neighbor_regexes": [".*left.*"],
+            "include_interface_regexes": ["or2-cl"]},
+           {"area_id": "area2",
+            "neighbor_regexes": [".*right.*"],
+            "include_interface_regexes": ["or2-cr"]}],
+ "link_monitor_config": {"enable_netlink_interfaces": true,
+                          "include_interface_regexes": ["or2-c.*"],
+                          "linkflap_initial_backoff_ms": 1,
+                          "linkflap_max_backoff_ms": 8}}
+JSON
+
+# -- platform agents + daemons ---------------------------------------------
+start_node() { # ns node ctrlport fibport iface=bind:port...
+  local ns=$1 node=$2 ctrl=$3 fib=$4; shift 4
+  ip netns exec "$ns" python -m openr_tpu.platform.main \
+    --backend netlink --table $TABLE --port "$fib" \
+    > "$WORK/$node-fib.log" 2>&1 &
+  PIDS+=($!)
+  retry 50 0.2 "$node platform agent" grep -q READY "$WORK/$node-fib.log"
+  local ifargs=()
+  for spec in "$@"; do ifargs+=(--interface "${spec%%@*}" --peer "${spec##*@}"); done
+  ip netns exec "$ns" python -m openr_tpu.main --config "$WORK/$node.json" \
+    --ctrl-port "$ctrl" --fib-service 127.0.0.1:"$fib" "${ifargs[@]}" \
+    > "$WORK/$node.log" 2>&1 &
+  PIDS+=($!)
+  retry 100 0.2 "$node daemon READY" grep -q READY "$WORK/$node.log"
+  log "$node up in $ns"
+}
+start_node $NS_L lab-left   2018 60201 "or2-lc=10.101.0.1:6680@or2-lc=10.101.0.2:6680"
+start_node $NS_C lab-center 2018 60201 \
+  "or2-cl=10.101.0.2:6680@or2-cl=10.101.0.1:6680" \
+  "or2-cr=10.101.0.5:6680@or2-cr=10.101.0.6:6680"
+start_node $NS_R lab-right  2018 60201 "or2-rc=10.101.0.6:6680@or2-rc=10.101.0.5:6680"
+
+bz() { ip netns exec "$1" python -m openr_tpu.cli.breeze --port 2018 "${@:2}"; }
+
+# 1. center negotiated one adjacency into each area
+retry 150 0.2 "center adjacency in area1" \
+  sh -c "ip netns exec $NS_C python -m openr_tpu.cli.breeze --port 2018 kvstore dump --area area1 | grep -q 'adj:lab-left'"
+retry 150 0.2 "center adjacency in area2" \
+  sh -c "ip netns exec $NS_C python -m openr_tpu.cli.breeze --port 2018 kvstore dump --area area2 | grep -q 'adj:lab-right'"
+log "OK(1) area negotiation: left in area1, right in area2"
+
+# 2. left's prefix crosses into right's KERNEL fib (and vice versa)
+retry 200 0.2 "left's prefix in right's kernel" \
+  sh -c "ip netns exec $NS_R ip route show | grep -q '10.201.1.0/24'"
+retry 200 0.2 "right's prefix in left's kernel" \
+  sh -c "ip netns exec $NS_L ip route show | grep -q '10.201.2.0/24'"
+log "OK(2) cross-area redistribution reached both edge kernels"
+
+# 2b. metric churn must REPLACE kernel routes, not stack them: every
+# daemon-owned prefix appears exactly once per kernel table
+no_dups() {
+  ip netns exec "$1" ip route show proto 99 2>/dev/null \
+    | awk "{print \$1}" | sort | uniq -d | grep -q . && return 1 || return 0
+}
+sleep 2  # let RTT-driven metric churn settle through a few updates
+for ns in $NS_L $NS_C $NS_R; do
+  no_dups "$ns" || fail "duplicate kernel routes in $ns: $(ip netns exec "$ns" ip route show proto 99)"
+done
+log "OK(2b) no duplicate (prefix, metric) kernel entries after churn"
+
+# 3. provenance: right received center's RIB re-advertisement with
+# area1 on the stack
+bz $NS_R kvstore dump --area area2 | grep "prefix:lab-center" \
+  | grep -q "10.201.1.0/24" || fail "no redistributed key from center"
+# received-routes decodes the entry: the RIB copy carries its source
+# area on the stack
+bz $NS_R decision received-routes | python3 -c '
+import json, sys
+rows = json.load(sys.stdin)
+for pfx, (node, area), entry in rows:
+    if pfx == "10.201.1.0/24" and node == "lab-center":
+        assert entry["area_stack"] == ["area1"], entry
+        assert entry["type"] == 8, entry  # PrefixType.RIB
+        break
+else:
+    raise SystemExit("no redistributed entry from lab-center")
+' || fail "area_stack provenance missing"
+log "OK(3) RIB re-advertisement carries area_stack provenance"
+
+# 4. packets: right opens a TCP connection to a listener on left's
+# loopback-prefix address through center, sourcing from its own
+# advertised loopback — the SYN rides left's redistributed route one
+# way and the SYN-ACK rides right's the other way
+ip netns exec $NS_L ip addr add 10.201.1.1/24 dev lo
+ip netns exec $NS_R ip addr add 10.201.2.1/24 dev lo
+ip netns exec $NS_L python3 -c '
+import socket
+s = socket.socket(); s.bind(("10.201.1.1", 7001)); s.listen(1)
+print("LISTENING", flush=True)
+c, _ = s.accept(); c.sendall(b"lab201"); c.close()
+' > "$WORK/echo.log" 2>&1 &
+PIDS+=($!)
+retry 50 0.2 "echo listener up" grep -q LISTENING "$WORK/echo.log"
+connect_check() {
+  ip netns exec $NS_R python3 -c '
+import socket
+s = socket.create_connection(("10.201.1.1", 7001), timeout=2,
+                             source_address=("10.201.2.1", 0))
+assert s.recv(16) == b"lab201"
+'
+}
+retry 50 0.2 "TCP across the area boundary" connect_check
+log "OK(4) end-to-end forwarding across the area boundary (both directions)"
+
+# 5. withdrawal propagates back out of right's kernel
+bz $NS_L prefixmgr withdraw 10.201.1.0/24 > /dev/null 2>&1 || true
+# originated-from-config prefixes withdraw via config; injected test
+# route instead: advertise + withdraw through breeze on left
+bz $NS_L prefixmgr advertise 10.202.0.0/24 > /dev/null || fail "breeze advertise"
+retry 200 0.2 "injected prefix crossed to right" \
+  sh -c "ip netns exec $NS_R ip route show | grep -q '10.202.0.0/24'"
+bz $NS_L prefixmgr withdraw 10.202.0.0/24 > /dev/null || fail "breeze withdraw"
+retry 200 0.2 "withdrawal crossed to right" \
+  sh -c "ip netns exec $NS_R ip route show | grep -q '10.202.0.0/24' && exit 1 || exit 0"
+log "OK(5) advertise + withdraw propagate across the boundary"
+
+DEBUG_KEEP=${DEBUG_KEEP:-}
+log "ALL ASSERTIONS PASSED"
+cleanup
+trap - EXIT
+exit 0

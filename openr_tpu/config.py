@@ -28,7 +28,12 @@ class AreaConfig:
 
     area_id: str = "0"
     neighbor_regexes: list[str] = field(default_factory=lambda: [".*"])
-    include_interface_regexes: list[str] = field(default_factory=list)
+    # default: claim every interface — a single-area node with no
+    # matchers configured must still form adjacencies (Spark area
+    # negotiation consults these via Config.match_neighbor_area)
+    include_interface_regexes: list[str] = field(
+        default_factory=lambda: [".*"]
+    )
     exclude_interface_regexes: list[str] = field(default_factory=list)
     redistribute_interface_regexes: list[str] = field(default_factory=list)
 

@@ -163,6 +163,9 @@ async def run_daemon(args) -> None:
         origination_policy=oc.origination_policy,
         plugins=oc.plugins,
         running_config=cfg,
+        # Spark area negotiation from the per-area regex matchers
+        # (ref Config.h:34-110 + Spark area resolution)
+        resolve_area=cfg.match_neighbor_area,
         # peers connect to the kvstore from OTHER hosts/namespaces —
         # bind the configured listen address. Fail closed: without
         # peer-plane TLS the default stays loopback (an any-address

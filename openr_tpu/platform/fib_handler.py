@@ -100,6 +100,10 @@ class NetlinkDataplane:
         self.nl = NetlinkRouteSocket()
         self._opened = False
         self.mpls: dict[int, dict] = {}
+        # last metric programmed per prefix: the kernel keys routes on
+        # (prefix, metric), so a metric change (RTT drift, redistribution
+        # distance) must DELETE the old-metric route or both coexist
+        self._metric: dict[str, int] = {}
         self.mpls_kernel = mpls_supported()
         if not self.mpls_kernel:
             logging.getLogger(__name__).info(
@@ -230,8 +234,46 @@ class NetlinkDataplane:
             op, self.table, PROTO_OPENR, packed,
         )
 
+    async def _delete_exact(self, nl_routes) -> list:
+        """Remove specific (prefix, metric) kernel entries — clearing a
+        route's OLD metric when it changes, and stale/duplicate entries
+        during sync. Already-gone (ENOENT/ESRCH) is success; anything
+        else is returned (and counted) so callers can surface it."""
+        import errno as _errno
+
+        from openr_tpu.runtime.counters import counters
+
+        failed = []
+        for r in nl_routes:
+            try:
+                await self.nl.delete_route(r)
+            except OSError as e:
+                if e.errno in (_errno.ENOENT, _errno.ESRCH):
+                    continue
+                counters.increment("platform.fib.delete_failure")
+                logging.getLogger(__name__).warning(
+                    "exact delete %s metric=%s failed: %s",
+                    r.prefix, r.metric, e,
+                )
+                failed.append(r)
+        return failed
+
+    def _stale_metric_routes(self, routes: dict[str, dict]) -> list:
+        from openr_tpu.platform.netlink import NlRoute
+
+        out = []
+        for p, r in routes.items():
+            old = self._metric.get(p)
+            new = r.get("igp_cost") or 0
+            if old is not None and old != new:
+                out.append(NlRoute(prefix=p, metric=old, table=self.table))
+        return out
+
     async def add_unicast(self, routes: dict[str, dict]) -> list[str]:
         self._ensure_open()
+        # NLM_F_REPLACE only replaces the SAME-metric route: clear the
+        # previous metric's entry first or the kernel keeps both
+        await self._delete_exact(self._stale_metric_routes(routes))
         nl_routes = [self._to_nl(p, r) for p, r in routes.items()]
         bulk = await self._bulk(0, nl_routes)
         if bulk is not None:
@@ -240,6 +282,8 @@ class NetlinkDataplane:
             # transport abort shows up as ok < len with err == 0, and
             # must not be mistaken for full success
             if err == 0 and ok == len(nl_routes):
+                for r in nl_routes:
+                    self._metric[r.prefix] = r.metric
                 return []
             # rare: re-walk per-route on the asyncio client to learn
             # WHICH prefixes failed (the native path returns counts);
@@ -248,17 +292,19 @@ class NetlinkDataplane:
         for r in nl_routes:
             try:
                 await self.nl.add_route(r)
+                self._metric[r.prefix] = r.metric
             except OSError:
                 failed.append(r.prefix)
         return failed
 
     async def delete_unicast(self, prefixes: list[str]) -> list[str]:
-        import errno as _errno
-
-        from openr_tpu.runtime.counters import counters
-
         self._ensure_open()
-        nl_routes = [self._to_nl(p, {}) for p in prefixes]
+        # delete the metric we actually programmed — a bare delete only
+        # matches one (prefix, metric) entry
+        nl_routes = [
+            self._to_nl(p, {"igp_cost": self._metric.get(p, 0)})
+            for p in prefixes
+        ]
         bulk = await self._bulk(1, nl_routes)
         if bulk is not None:
             ok, err = bulk
@@ -268,42 +314,59 @@ class NetlinkDataplane:
             # counts, not errnos, so any NACK falls through to the
             # per-route walk to be classified
             if err == 0 and ok == len(nl_routes):
+                for p in prefixes:
+                    self._metric.pop(p, None)
                 return []
-        failed = []
-        for r in nl_routes:
-            try:
-                await self.nl.delete_route(r)
-            except OSError as e:
-                # already-gone is success for a delete; anything else
-                # (EPERM, EBUSY, ...) left a stale kernel route — surface
-                # it so sync/retry logic doesn't report a clean table
-                if e.errno in (_errno.ENOENT, _errno.ESRCH):
-                    continue
-                counters.increment("platform.fib.delete_failure")
-                logging.getLogger(__name__).warning(
-                    "delete_unicast: %s failed: %s", r.prefix, e
-                )
-                failed.append(r.prefix)
+        # pop the metric record only for deletes that SUCCEED — a retry
+        # of a failed delete must target the real metric, not 0 (which
+        # the kernel would report as already-gone)
+        failed_nl = await self._delete_exact(nl_routes)
+        failed = [r.prefix for r in failed_nl]
+        for p in prefixes:
+            if p not in failed:
+                self._metric.pop(p, None)
         return failed
 
     async def sync_unicast(self, routes: dict[str, dict]) -> list[str]:
         import socket as _socket
 
-        from openr_tpu.platform.netlink import PROTO_OPENR
+        from openr_tpu.platform.netlink import NlRoute, PROTO_OPENR
 
         self._ensure_open()
-        have = set()
+        have: dict[str, set[int]] = {}
         for family in (_socket.AF_INET, _socket.AF_INET6):
             for r in await self.nl.get_routes(
                 family, table=self.table, protocol=PROTO_OPENR
             ):
-                have.add(r.prefix)
+                have.setdefault(r.prefix, set()).add(r.metric)
         failed = await self.add_unicast(routes)
-        stale = have - set(routes)
-        # a stale route that fails to delete leaves the kernel out of
-        # sync — surface it with the add failures so the Fib actor
-        # retries instead of trusting a clean table
-        failed += await self.delete_unicast(sorted(stale))
+        # stale prefixes + desired prefixes whose kernel copy also sits
+        # at an old metric (agent restart lost the metric record): the
+        # kernel keys routes on (prefix, metric), so the add above did
+        # not replace those — clear every such entry, and surface any
+        # failed delete with the add failures so the Fib actor retries
+        # instead of trusting a clean table
+        stale = set(have) - set(routes)
+        stale_nl = [
+            NlRoute(prefix=p, metric=m, table=self.table)
+            for p in sorted(stale)
+            for m in sorted(have[p])
+        ] + [
+            NlRoute(prefix=p, metric=m, table=self.table)
+            for p, r in routes.items()
+            for m in have.get(p, ())
+            if p not in failed and m != (r.get("igp_cost") or 0)
+        ]
+        if stale_nl:
+            failed_nl = await self._delete_exact(stale_nl)
+            leftover = {r.prefix for r in failed_nl}
+            for p in stale:
+                if p not in leftover:
+                    self._metric.pop(p, None)
+            failed += sorted(leftover - set(failed))
+        else:
+            for p in stale:
+                self._metric.pop(p, None)
         return failed
 
     async def add_mpls(self, routes: dict[int, dict]) -> list[int]:

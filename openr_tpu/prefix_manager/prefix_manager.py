@@ -42,6 +42,8 @@ from openr_tpu.types import (
     PrefixEntry,
     PrefixEvent,
     PrefixEventType,
+    PrefixForwardingAlgorithm,
+    PrefixForwardingType,
     PrefixType,
     parse_prefix,
     prefix_key,
@@ -132,6 +134,8 @@ class PrefixManager(Actor):
             self.originated[op.prefix] = _OriginatedState(conf=op)
         # what we currently advertise in kvstore: prefix -> (entry, areas)
         self._advertised: dict[str, tuple[PrefixEntry, tuple[str, ...]]] = {}
+        # prefixes currently re-advertised across areas as RIB transit
+        self._redistributed: set[str] = set()
         self._sync_throttle: Optional[AsyncThrottle] = None
         self._sync_throttle_s = sync_throttle_s
         self._db_synced_signalled = False
@@ -272,7 +276,12 @@ class PrefixManager(Actor):
 
     def _process_programmed_routes(self, upd: DecisionRouteUpdate) -> None:
         """Track programmed routes as supporting evidence for originated
-        covering prefixes (ref aggregation, minimum_supporting_routes)."""
+        covering prefixes (ref aggregation, minimum_supporting_routes),
+        and — with multiple areas configured — redistribute them into the
+        areas they did not come from (ref
+        redistributePrefixesAcrossAreas, PrefixManager.cpp:1662-1765)."""
+        if len(self.areas) > 1:
+            self._redistribute_across_areas(upd)
         changed = False
         for prefix, entry in upd.unicast_routes_to_update.items():
             if self._track_nexthops:
@@ -296,6 +305,73 @@ class PrefixManager(Actor):
         if changed:
             self._evaluate_originated()
             self._sync_throttled()
+
+    def _redistribute_across_areas(self, upd: DecisionRouteUpdate) -> None:
+        """Re-advertise programmed routes into the areas they did NOT
+        come from, as transit (ref PrefixManager.cpp:1662-1765):
+        provenance appends to area_stack (the key-sync loop guard skips
+        destination areas already on the stack), distance bumps by one,
+        the type normalizes to RIB (lowest rank, so a redistributed copy
+        never beats an original announcement), and non-transitive
+        attributes reset (ref resetNonTransitiveAttrs)."""
+        by_dst: dict[tuple[str, ...], list[PrefixEntry]] = {}
+        no_longer: list[str] = []
+        for prefix, route in upd.unicast_routes_to_update.items():
+            best = route.best_prefix_entry
+            if best is None or prefix in self.originated:
+                if best is None and prefix in self._redistributed:
+                    no_longer.append(prefix)
+                continue
+            src_areas = {nh.area for nh in route.nexthops if nh.area}
+            dst = tuple(a for a in self.areas if a not in src_areas)
+            if not dst:
+                # an update that stops qualifying (now reachable via
+                # every area) must retract its earlier re-advertisement,
+                # not leave a stale transit claim
+                if prefix in self._redistributed:
+                    no_longer.append(prefix)
+                continue
+            entry = replace(
+                best,
+                prefix=prefix,
+                type=PrefixType.RIB,
+                area_stack=tuple(best.area_stack)
+                + (route.best_node_area[1],),
+                metrics=replace(
+                    best.metrics, distance=best.metrics.distance + 1
+                ),
+                forwarding_type=PrefixForwardingType.IP,
+                forwarding_algorithm=PrefixForwardingAlgorithm.SP_ECMP,
+                min_nexthop=None,
+                prepend_label=None,
+                weight=None,
+            )
+            by_dst.setdefault(dst, []).append(entry)
+        if upd.type == RouteUpdateType.FULL_SYNC:
+            # a restart's full sync replaces the whole programmed set:
+            # withdraw redistributed prefixes the new RIB no longer has
+            keep = set(upd.unicast_routes_to_update)
+            stale = [
+                p for p in self._redistributed if p not in keep
+            ]
+            if stale:
+                self.withdraw_prefixes(
+                    [PrefixEntry(prefix=p) for p in stale], PrefixType.RIB
+                )
+                self._redistributed.difference_update(stale)
+        for dst, entries in by_dst.items():
+            self._redistributed.update(e.prefix for e in entries)
+            self.advertise_prefixes(entries, PrefixType.RIB, dst)
+        deleted = no_longer + [
+            p
+            for p in upd.unicast_routes_to_delete
+            if p in self._redistributed and p not in self.originated
+        ]
+        if deleted:
+            self._redistributed.difference_update(deleted)
+            self.withdraw_prefixes(
+                [PrefixEntry(prefix=p) for p in deleted], PrefixType.RIB
+            )
 
     @staticmethod
     def _supports(route_prefix: str, covering: str) -> bool:
@@ -457,7 +533,14 @@ class PrefixManager(Actor):
 
     def _areas_for(self, prefix: str, entry: PrefixEntry) -> tuple[str, ...]:
         restricted = self._dest_areas.get((prefix, entry.type))
-        return restricted if restricted else tuple(self.areas)
+        areas = restricted if restricted else tuple(self.areas)
+        # area_stack loop guard (ref addKvStoreKeyHelper,
+        # PrefixManager.cpp:495-499): never advertise a prefix back into
+        # an area it already transited; local originations have an empty
+        # stack so this is a no-op for them
+        if entry.area_stack:
+            areas = tuple(a for a in areas if a not in entry.area_stack)
+        return areas
 
     def sync_kvstore(self) -> None:
         desired = self.best_entries()

@@ -74,6 +74,7 @@ class OpenrWrapper:
         running_config=None,
         monitor=None,
         kv_listen_addr: str = "127.0.0.1",
+        resolve_area=None,
     ):
         self.node_name = node_name
         self.kv_ports = kv_ports  # shared node -> kvstore port registry
@@ -137,6 +138,11 @@ class OpenrWrapper:
             io_provider,
             self.neighbor_updates_queue,
             interface_updates_queue=self.interface_updates_queue.get_reader(),
+            # area negotiation (ref AreaConfiguration matchers): the
+            # daemon passes Config.match_neighbor_area; default = every
+            # neighbor in the first configured area
+            resolve_area=resolve_area
+            or (lambda node, iface, _a=areas[0]: _a),
         )
         self.link_monitor = LinkMonitor(
             node_name,

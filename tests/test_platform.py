@@ -320,6 +320,58 @@ class TestNetlinkKernel:
             await dp.delete_unicast(["10.254.2.0/24"])
             dp.nl.close()
 
+    @pytest.mark.skipif(not _can_net_admin(), reason="needs CAP_NET_ADMIN")
+    @run_async
+    async def test_metric_change_replaces_kernel_route(self):
+        """Regression (lab 201): the kernel keys routes on
+        (prefix, metric), so a metric change (RTT drift, redistribution
+        distance) must not leave both entries installed."""
+        from openr_tpu.platform.fib_handler import NetlinkDataplane
+
+        dp = NetlinkDataplane(table=10097)
+        nh = [{"address": "", "if_name": "lo", "weight": 0}]
+        p = "10.254.3.0/24"
+        try:
+            assert not await dp.add_unicast(
+                {p: {"nexthops": nh, "igp_cost": 17}}
+            )
+            assert not await dp.add_unicast(
+                {p: {"nexthops": nh, "igp_cost": 24}}
+            )
+            got = [
+                r
+                for r in await dp.nl.get_routes(
+                    socket.AF_INET, table=10097
+                )
+                if r.prefix == p
+            ]
+            assert len(got) == 1 and got[0].metric == 24, got
+
+            # restart (lost metric record) + sync at a third metric:
+            # the duplicate-clearing pass removes the orphan
+            dp2 = NetlinkDataplane(table=10097)
+            try:
+                assert not await dp2.sync_unicast(
+                    {p: {"nexthops": nh, "igp_cost": 31}}
+                )
+                got = [
+                    r
+                    for r in await dp2.nl.get_routes(
+                        socket.AF_INET, table=10097
+                    )
+                    if r.prefix == p
+                ]
+                assert len(got) == 1 and got[0].metric == 31, got
+                # delete removes the (prefix, metric) we programmed
+                assert not await dp2.delete_unicast([p])
+                got = await dp2.nl.get_routes(socket.AF_INET, table=10097)
+                assert not [r for r in got if r.prefix == p]
+            finally:
+                dp2.nl.close()
+        finally:
+            await dp.delete_unicast([p])
+            dp.nl.close()
+
 
 FAST_TIMERS = {
     "hello_time_s": 0.1,

@@ -24,7 +24,7 @@ from tests.conftest import run_async
 
 
 class PmHarness:
-    def __init__(self, originated=None):
+    def __init__(self, originated=None, areas=("0",)):
         self.prefix_q = ReplicateQueue("prefixUpdates")
         self.fib_q = ReplicateQueue("fibRouteUpdates")
         self.kv_req_q = ReplicateQueue("kvRequests")
@@ -33,7 +33,7 @@ class PmHarness:
         self.statics = self.static_q.get_reader("test")
         self.pm = PrefixManager(
             "node1",
-            ["0"],
+            list(areas),
             self.prefix_q.get_reader(),
             self.fib_q.get_reader(),
             self.kv_req_q,
@@ -505,3 +505,143 @@ class TestAllocatorWritesAddress:
             await w.stop()
             subprocess.run(["ip", "link", "del", name], capture_output=True)
 
+
+
+class TestCrossAreaRedistribution:
+    """Programmed routes re-advertise into the areas they did not come
+    from (ref redistributePrefixesAcrossAreas, PrefixManager.cpp:1662)."""
+
+    @staticmethod
+    def programmed(prefix, src_area, area_stack=(), distance=1):
+        from openr_tpu.types import PrefixMetrics
+
+        return RibUnicastEntry(
+            prefix=prefix,
+            nexthops=frozenset(
+                {NextHop(address="fe80::1", if_name="if0", area=src_area)}
+            ),
+            best_prefix_entry=PrefixEntry(
+                prefix=prefix,
+                type=PrefixType.LOOPBACK,
+                area_stack=tuple(area_stack),
+                metrics=PrefixMetrics(distance=distance),
+            ),
+            best_node_area=("other-node", src_area),
+        )
+
+    @run_async
+    async def test_programmed_route_leaks_to_other_area(self):
+        async with PmHarness(areas=("area1", "area2")) as h:
+            h.fib_q.push(
+                DecisionRouteUpdate(
+                    unicast_routes_to_update={
+                        "10.50.0.0/24": self.programmed(
+                            "10.50.0.0/24", "area1"
+                        )
+                    }
+                )
+            )
+            req = await h.next_req()
+            assert req.area == "area2"  # NOT back into area1
+            assert req.request_type == KeyValueRequestType.PERSIST
+            db = deserialize(req.value, PrefixDatabase)
+            e = db.prefix_entries[0]
+            assert e.type == PrefixType.RIB
+            assert e.area_stack == ("area1",)
+            assert e.metrics.distance == 2  # bumped by the transit hop
+
+    @run_async
+    async def test_area_stack_loop_guard(self):
+        """A route whose provenance already includes the only other area
+        must not be re-advertised into it."""
+        async with PmHarness(areas=("area1", "area2")) as h:
+            h.fib_q.push(
+                DecisionRouteUpdate(
+                    unicast_routes_to_update={
+                        "10.51.0.0/24": self.programmed(
+                            "10.51.0.0/24", "area1", area_stack=("area2",)
+                        )
+                    }
+                )
+            )
+            with pytest.raises(asyncio.TimeoutError):
+                await h.next_req(timeout=0.3)
+
+    @run_async
+    async def test_route_delete_withdraws_redistribution(self):
+        async with PmHarness(areas=("area1", "area2")) as h:
+            h.fib_q.push(
+                DecisionRouteUpdate(
+                    unicast_routes_to_update={
+                        "10.52.0.0/24": self.programmed(
+                            "10.52.0.0/24", "area1"
+                        )
+                    }
+                )
+            )
+            await h.next_req()
+            h.fib_q.push(
+                DecisionRouteUpdate(
+                    unicast_routes_to_delete=["10.52.0.0/24"]
+                )
+            )
+            req = await h.next_req()
+            assert req.request_type == KeyValueRequestType.SET
+            db = deserialize(req.value, PrefixDatabase)
+            assert db.delete_prefix and req.area == "area2"
+
+    @run_async
+    async def test_single_area_never_redistributes(self):
+        async with PmHarness() as h:
+            h.fib_q.push(
+                DecisionRouteUpdate(
+                    unicast_routes_to_update={
+                        "10.53.0.0/24": self.programmed("10.53.0.0/24", "0")
+                    }
+                )
+            )
+            with pytest.raises(asyncio.TimeoutError):
+                await h.next_req(timeout=0.3)
+
+
+    @run_async
+    async def test_update_that_stops_qualifying_retracts(self):
+        """An incremental update whose route becomes reachable via every
+        area must retract the earlier re-advertisement (review finding:
+        only deletes used to withdraw)."""
+        async with PmHarness(areas=("area1", "area2")) as h:
+            h.fib_q.push(
+                DecisionRouteUpdate(
+                    unicast_routes_to_update={
+                        "10.54.0.0/24": self.programmed(
+                            "10.54.0.0/24", "area1"
+                        )
+                    }
+                )
+            )
+            req = await h.next_req()
+            assert req.area == "area2"
+            # same prefix now resolves with nexthops in BOTH areas ->
+            # no destination left -> withdraw the transit claim
+            route = self.programmed("10.54.0.0/24", "area1")
+            both = RibUnicastEntry(
+                prefix=route.prefix,
+                nexthops=frozenset(
+                    {
+                        NextHop(address="fe80::1", if_name="if0", area="area1"),
+                        NextHop(address="fe80::2", if_name="if1", area="area2"),
+                    }
+                ),
+                best_prefix_entry=route.best_prefix_entry,
+                best_node_area=route.best_node_area,
+            )
+            h.fib_q.push(
+                DecisionRouteUpdate(
+                    unicast_routes_to_update={"10.54.0.0/24": both}
+                )
+            )
+            req = await h.next_req()
+            assert req.request_type == KeyValueRequestType.SET
+            db = deserialize(req.value, PrefixDatabase)
+            assert db.delete_prefix and req.area == "area2"
+            assert "10.54.0.0/24" not in h.pm._redistributed

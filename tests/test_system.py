@@ -171,3 +171,79 @@ class TestEcmpSystem:
             await wait_until(has_ecmp, timeout_s=CONVERGENCE_S)
         finally:
             await stop_all(nodes)
+
+
+class TestMultiAreaRedistribution:
+    """left --(area1)-- center --(area2)-- right (ref lab 201_areas):
+    a prefix originated in area1 must cross the area boundary via
+    center's RIB redistribution and land in right's FIB, with
+    provenance on the area stack."""
+
+    @run_async
+    async def test_prefix_crosses_areas_through_center(self):
+        mesh = MockIoMesh()
+        kv_ports: dict[str, int] = {}
+
+        def center_area(node, iface):
+            return "area1" if iface == "if-c-l" else "area2"
+
+        left = OpenrWrapper(
+            "left", mesh.provider("left"), kv_ports, areas=["area1"]
+        )
+        center = OpenrWrapper(
+            "center", mesh.provider("center"), kv_ports,
+            areas=["area1", "area2"], resolve_area=center_area,
+        )
+        right = OpenrWrapper(
+            "right", mesh.provider("right"), kv_ports, areas=["area2"]
+        )
+        mesh.connect("left", "if-l-c", "center", "if-c-l")
+        mesh.connect("center", "if-c-r", "right", "if-r-c")
+        await left.start("if-l-c")
+        await center.start("if-c-l", "if-c-r")
+        await right.start("if-r-c")
+        try:
+            left.advertise_prefix("10.31.0.0/24", dest_areas=("area1",))
+            right.advertise_prefix("10.32.0.0/24", dest_areas=("area2",))
+
+            # center programs both originals
+            await wait_until(
+                lambda: {"10.31.0.0/24", "10.32.0.0/24"}
+                <= set(center.fib_routes),
+                timeout_s=CONVERGENCE_S,
+            )
+            # the redistributed copies cross the boundary into the
+            # opposite side's kernel-facing FIB
+            await wait_until(
+                lambda: "10.31.0.0/24" in right.fib_routes,
+                timeout_s=CONVERGENCE_S,
+            )
+            await wait_until(
+                lambda: "10.32.0.0/24" in left.fib_routes,
+                timeout_s=CONVERGENCE_S,
+            )
+            # provenance: right sees center's RIB-type re-advertisement
+            # with area1 on the stack and a bumped distance
+            vals = await right.kvstore.dump_all("area2")
+            from openr_tpu.serde import deserialize
+            from openr_tpu.types import PrefixDatabase, PrefixType
+
+            key = [
+                k for k in vals
+                if "center" in k and "10.31.0.0/24" in k
+            ]
+            assert key, sorted(vals)
+            db = deserialize(vals[key[0]].value, PrefixDatabase)
+            e = db.prefix_entries[0]
+            assert e.type == PrefixType.RIB
+            assert e.area_stack == ("area1",)
+            assert e.metrics.distance >= 1
+
+            # withdrawal propagates all the way back out
+            left.withdraw_prefix("10.31.0.0/24")
+            await wait_until(
+                lambda: "10.31.0.0/24" not in right.fib_routes,
+                timeout_s=CONVERGENCE_S,
+            )
+        finally:
+            await stop_all({"l": left, "c": center, "r": right})
