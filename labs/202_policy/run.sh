@@ -1,0 +1,188 @@
+#!/usr/bin/env bash
+# Lab 202 — area import policy gates cross-area redistribution.
+# See README.md for what each assertion proves.
+set -u
+
+REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+export PYTHONPATH="$REPO"
+export OPENR_TPU_XLA_CACHE=off
+WORK="$(mktemp -d /tmp/openr-lab202.XXXXXX)"
+NS_L=orlab3-l NS_C=orlab3-c NS_R=orlab3-r
+TABLE=254
+PIDS=()
+
+log() { echo "[lab202] $*"; }
+fail() {
+  echo "[lab202] FAIL: $*" >&2
+  for ns in $NS_L $NS_C $NS_R; do
+    echo "--- $ns routes ---"; ip netns exec "$ns" ip route show 2>/dev/null
+  done
+  for f in "$WORK"/*.log; do echo "--- $f (tail) ---"; tail -5 "$f"; done
+  cleanup; exit 1
+}
+cleanup() {
+  for pid in "${PIDS[@]:-}"; do kill "$pid" 2>/dev/null; done
+  wait 2>/dev/null
+  for ns in $NS_L $NS_C $NS_R; do ip netns del "$ns" 2>/dev/null; done
+  rm -rf "$WORK"
+}
+trap cleanup EXIT
+
+retry() { # retry <tries> <sleep> <desc> <cmd...>
+  local tries=$1 delay=$2 desc=$3; shift 3
+  for _ in $(seq 1 "$tries"); do "$@" >/dev/null 2>&1 && return 0; sleep "$delay"; done
+  fail "$desc"
+}
+
+# -- PKI (mutual-TLS kvstore peer plane, as in labs 001/201) ----------------
+PKI="$WORK/pki"
+mkdir -p "$PKI"
+openssl req -x509 -newkey rsa:2048 -nodes -keyout "$PKI/ca.key" \
+  -out "$PKI/ca.crt" -days 1 -subj "/CN=lab-ca" 2>/dev/null
+for n in lab-left lab-center lab-right; do
+  openssl req -newkey rsa:2048 -nodes -keyout "$PKI/$n.key" \
+    -out "$PKI/$n.csr" -subj "/CN=$n" 2>/dev/null
+  openssl x509 -req -in "$PKI/$n.csr" -CA "$PKI/ca.crt" \
+    -CAkey "$PKI/ca.key" -CAcreateserial -out "$PKI/$n.crt" -days 1 \
+    2>/dev/null
+done
+
+# -- namespaces + veths -----------------------------------------------------
+for ns in $NS_L $NS_C $NS_R; do
+  ip netns add "$ns" || { echo "needs CAP_NET_ADMIN"; exit 1; }
+  ip netns exec "$ns" ip link set lo up
+done
+ip link add or3-lc type veth peer name or3-cl
+ip link add or3-cr type veth peer name or3-rc
+ip link set or3-lc netns $NS_L
+ip link set or3-cl netns $NS_C
+ip link set or3-cr netns $NS_C
+ip link set or3-rc netns $NS_R
+ip netns exec $NS_L ip addr add 10.102.0.1/30 dev or3-lc
+ip netns exec $NS_C ip addr add 10.102.0.2/30 dev or3-cl
+ip netns exec $NS_C ip addr add 10.102.0.5/30 dev or3-cr
+ip netns exec $NS_R ip addr add 10.102.0.6/30 dev or3-rc
+ip netns exec $NS_L ip link set or3-lc up
+ip netns exec $NS_C ip link set or3-cl up
+ip netns exec $NS_C ip link set or3-cr up
+ip netns exec $NS_R ip link set or3-rc up
+log "namespaces up: $NS_L <-area1-> $NS_C <-area2(policy)-> $NS_R"
+
+# -- configs ----------------------------------------------------------------
+tls() { # node
+cat <<JSON
+ "kvstore_config": {"enable_secure_peers": true},
+ "thrift_server": {"x509_cert_path": "$PKI/$1.crt",
+                    "x509_key_path": "$PKI/$1.key",
+                    "x509_ca_path": "$PKI/ca.crt"},
+JSON
+}
+cat > "$WORK/lab-left.json" <<JSON
+{"node_name": "lab-left",
+ "decision_config": {"solver_backend": "cpu"},
+$(tls lab-left)
+ "areas": [{"area_id": "area1",
+            "neighbor_regexes": [".*"],
+            "include_interface_regexes": ["or3-lc"]}],
+ "link_monitor_config": {"enable_netlink_interfaces": true,
+                          "include_interface_regexes": ["or3-lc"],
+                          "linkflap_initial_backoff_ms": 1,
+                          "linkflap_max_backoff_ms": 8},
+ "originated_prefixes": [{"prefix": "10.210.1.0/24"},
+                          {"prefix": "10.250.1.0/24"}]}
+JSON
+cat > "$WORK/lab-right.json" <<JSON
+{"node_name": "lab-right",
+ "decision_config": {"solver_backend": "cpu"},
+$(tls lab-right)
+ "areas": [{"area_id": "area2",
+            "neighbor_regexes": [".*"],
+            "include_interface_regexes": ["or3-rc"]}],
+ "link_monitor_config": {"enable_netlink_interfaces": true,
+                          "include_interface_regexes": ["or3-rc"],
+                          "linkflap_initial_backoff_ms": 1,
+                          "linkflap_max_backoff_ms": 8}}
+JSON
+# the boundary policy: only 10.210.0.0/16 may enter area2, and what
+# does gets tagged (ref 202_policy's ALLOW-* route-map shape)
+cat > "$WORK/lab-center.json" <<JSON
+{"node_name": "lab-center",
+ "decision_config": {"solver_backend": "cpu"},
+$(tls lab-center)
+ "policies": {"area2-import": {
+     "statements": [{"name": "allow-210",
+                      "match": {"prefixes": ["10.210.0.0/16"]},
+                      "action": {"set_tags": ["crossed-boundary"]}}],
+     "default_accept": false}},
+ "areas": [{"area_id": "area1",
+            "neighbor_regexes": [".*left.*"],
+            "include_interface_regexes": ["or3-cl"]},
+           {"area_id": "area2",
+            "neighbor_regexes": [".*right.*"],
+            "include_interface_regexes": ["or3-cr"],
+            "import_policy_name": "area2-import"}],
+ "link_monitor_config": {"enable_netlink_interfaces": true,
+                          "include_interface_regexes": ["or3-c.*"],
+                          "linkflap_initial_backoff_ms": 1,
+                          "linkflap_max_backoff_ms": 8}}
+JSON
+
+# -- platform agents + daemons ---------------------------------------------
+start_node() { # ns node ctrlport fibport iface=bind:port@iface=peer:port...
+  local ns=$1 node=$2 ctrl=$3 fib=$4; shift 4
+  ip netns exec "$ns" python -m openr_tpu.platform.main \
+    --backend netlink --table $TABLE --port "$fib" \
+    > "$WORK/$node-fib.log" 2>&1 &
+  PIDS+=($!)
+  retry 50 0.2 "$node platform agent" grep -q READY "$WORK/$node-fib.log"
+  local ifargs=()
+  for spec in "$@"; do ifargs+=(--interface "${spec%%@*}" --peer "${spec##*@}"); done
+  ip netns exec "$ns" python -m openr_tpu.main --config "$WORK/$node.json" \
+    --ctrl-port "$ctrl" --fib-service 127.0.0.1:"$fib" "${ifargs[@]}" \
+    > "$WORK/$node.log" 2>&1 &
+  PIDS+=($!)
+  retry 100 0.2 "$node daemon READY" grep -q READY "$WORK/$node.log"
+  log "$node up in $ns"
+}
+start_node $NS_L lab-left   2018 60202 "or3-lc=10.102.0.1:6680@or3-lc=10.102.0.2:6680"
+start_node $NS_C lab-center 2018 60202 \
+  "or3-cl=10.102.0.2:6680@or3-cl=10.102.0.1:6680" \
+  "or3-cr=10.102.0.5:6680@or3-cr=10.102.0.6:6680"
+start_node $NS_R lab-right  2018 60202 "or3-rc=10.102.0.6:6680@or3-rc=10.102.0.5:6680"
+
+bz() { ip netns exec "$1" python -m openr_tpu.cli.breeze --port 2018 "${@:2}"; }
+
+# 1. the allowed prefix crosses the policy boundary into right's kernel
+retry 200 0.2 "allowed prefix in right's kernel" \
+  sh -c "ip netns exec $NS_R ip route show | grep -q '10.210.1.0/24'"
+log "OK(1) allowed prefix crossed into right's kernel"
+
+# 2. the denied prefix is routed by CENTER (learned fine in area1) but
+# never reaches right's kernel or LSDB
+retry 200 0.2 "denied prefix routed by center" \
+  sh -c "ip netns exec $NS_C ip route show | grep -q '10.250.1.0/24'"
+sleep 2  # give a leak every chance to propagate before asserting absence
+ip netns exec $NS_R ip route show | grep -q "10.250.1.0/24" \
+  && fail "denied prefix leaked into right's kernel"
+bz $NS_R kvstore dump --area area2 | grep -q "10.250.1.0" \
+  && fail "denied prefix leaked into right's LSDB"
+log "OK(2) denied prefix stopped at the area boundary"
+
+# 3. the accepted re-advertisement ran THROUGH the policy: it carries
+# the action's tag
+bz $NS_R decision received-routes | python3 -c '
+import json, sys
+rows = json.load(sys.stdin)
+for pfx, (node, area), entry in rows:
+    if pfx == "10.210.1.0/24" and node == "lab-center":
+        assert "crossed-boundary" in entry["tags"], entry
+        break
+else:
+    raise SystemExit("no redistributed entry from lab-center")
+' || fail "policy transform missing on the crossed prefix"
+log "OK(3) accepted prefix carries the policy's tag"
+
+log "ALL ASSERTIONS PASSED"
+cleanup
+trap - EXIT
+exit 0

@@ -34,6 +34,11 @@ class AreaConfig:
     include_interface_regexes: list[str] = field(
         default_factory=lambda: [".*"]
     )
+    # named policy (OpenrConfig.policies) gating what this node
+    # advertises INTO the area (ref AreaConfig.import_policy_name,
+    # OpenrConfig.thrift:589 — applied per destination area at key
+    # advertisement, addKvStoreKeyHelper)
+    import_policy_name: str = ""
     exclude_interface_regexes: list[str] = field(default_factory=list)
     redistribute_interface_regexes: list[str] = field(default_factory=list)
 
@@ -484,6 +489,12 @@ class Config:
                 f"origination_policy {cfg.origination_policy!r} is not in "
                 "policies"
             )
+        for a in cfg.areas:
+            if a.import_policy_name and a.import_policy_name not in cfg.policies:
+                raise ConfigError(
+                    f"area {a.area_id}: import_policy_name "
+                    f"{a.import_policy_name!r} is not in policies"
+                )
         self._validate_policies(cfg)
 
     @staticmethod

@@ -166,6 +166,12 @@ async def run_daemon(args) -> None:
         # Spark area negotiation from the per-area regex matchers
         # (ref Config.h:34-110 + Spark area resolution)
         resolve_area=cfg.match_neighbor_area,
+        # per-destination-area import policies (ref areaToPolicy_)
+        area_policies={
+            a.area_id: a.import_policy_name
+            for a in oc.areas
+            if a.import_policy_name
+        },
         # peers connect to the kvstore from OTHER hosts/namespaces —
         # bind the configured listen address. Fail closed: without
         # peer-plane TLS the default stays loopback (an any-address

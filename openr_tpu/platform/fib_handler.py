@@ -272,8 +272,18 @@ class NetlinkDataplane:
     async def add_unicast(self, routes: dict[str, dict]) -> list[str]:
         self._ensure_open()
         # NLM_F_REPLACE only replaces the SAME-metric route: clear the
-        # previous metric's entry first or the kernel keeps both
-        await self._delete_exact(self._stale_metric_routes(routes))
+        # previous metric's entry first or the kernel keeps both. A
+        # failed old-metric delete defers the whole (re)program of that
+        # prefix: the old route keeps forwarding, _metric keeps naming
+        # it, and the Fib actor's retry re-attempts the delete — adding
+        # the new metric now would strand an untracked duplicate.
+        blocked = {
+            r.prefix
+            for r in await self._delete_exact(
+                self._stale_metric_routes(routes)
+            )
+        }
+        routes = {p: r for p, r in routes.items() if p not in blocked}
         nl_routes = [self._to_nl(p, r) for p, r in routes.items()]
         bulk = await self._bulk(0, nl_routes)
         if bulk is not None:
@@ -284,11 +294,11 @@ class NetlinkDataplane:
             if err == 0 and ok == len(nl_routes):
                 for r in nl_routes:
                     self._metric[r.prefix] = r.metric
-                return []
+                return sorted(blocked)
             # rare: re-walk per-route on the asyncio client to learn
             # WHICH prefixes failed (the native path returns counts);
             # adds use NLM_F_REPLACE so re-adding acked routes is safe
-        failed = []
+        failed = sorted(blocked)
         for r in nl_routes:
             try:
                 await self.nl.add_route(r)
@@ -364,9 +374,6 @@ class NetlinkDataplane:
                 if p not in leftover:
                     self._metric.pop(p, None)
             failed += sorted(leftover - set(failed))
-        else:
-            for p in stale:
-                self._metric.pop(p, None)
         return failed
 
     async def add_mpls(self, routes: dict[int, dict]) -> list[int]:

@@ -111,6 +111,7 @@ class PrefixManager(Actor):
         sync_throttle_s: float = 0.005,
         policy_manager=None,
         origination_policy: str = "",
+        area_policies: Optional[dict[str, str]] = None,
     ):
         super().__init__(f"prefix-manager:{node_name}")
         self.node_name = node_name
@@ -119,6 +120,10 @@ class PrefixManager(Actor):
         # PrefixManager.cpp policy application on advertisement ingress)
         self.policy_manager = policy_manager
         self.origination_policy = origination_policy
+        # per-destination-area import policies (ref areaToPolicy_,
+        # PrefixManager.cpp:76 + :506 — applied per area at key
+        # advertisement): area_id -> policy name
+        self.area_policies = dict(area_policies or {})
         self._prefix_updates = prefix_updates_queue
         self._fib_updates = fib_route_updates_queue
         self._kv_request_q = kv_request_queue
@@ -132,8 +137,14 @@ class PrefixManager(Actor):
         }
         for op in originated_prefixes or []:
             self.originated[op.prefix] = _OriginatedState(conf=op)
-        # what we currently advertise in kvstore: prefix -> (entry, areas)
-        self._advertised: dict[str, tuple[PrefixEntry, tuple[str, ...]]] = {}
+        # what we currently advertise in kvstore, post-area-policy:
+        # prefix -> {area -> PrefixEntry as advertised there}
+        self._advertised: dict[str, dict[str, PrefixEntry]] = {}
+        # (prefix, area) -> (pre-policy entry, post-policy entry|None):
+        # the throttled sync re-walks the whole desired set, so policy
+        # evaluation (and its hit counters) must only run when the
+        # pre-policy entry for that area actually changed
+        self._area_policy_memo: dict[tuple[str, str], tuple] = {}
         # prefixes currently re-advertised across areas as RIB transit
         self._redistributed: set[str] = set()
         self._sync_throttle: Optional[AsyncThrottle] = None
@@ -542,17 +553,49 @@ class PrefixManager(Actor):
             areas = tuple(a for a in areas if a not in entry.area_stack)
         return areas
 
+    def _entry_for_area(
+        self, prefix: str, entry: PrefixEntry, area: str
+    ) -> Optional[PrefixEntry]:
+        """Run the destination area's import policy (ref areaToPolicy_
+        application, PrefixManager.cpp:506-533): transformed entry, or
+        None when the policy rejects the advertisement into this area.
+        Memoized per (prefix, area) on the pre-policy entry, so steady
+        syncs don't re-match regexes or skew hit counters."""
+        name = self.area_policies.get(area)
+        if not name or self.policy_manager is None:
+            return entry
+        policy = self.policy_manager.policies.get(name)
+        memo = self._area_policy_memo.get((prefix, area))
+        # the policy OBJECT is part of the key: replacing a policy at
+        # runtime must re-evaluate even for unchanged entries
+        if memo is not None and memo[0] == entry and memo[1] is policy:
+            return memo[2]
+        out = self.policy_manager.apply(name, entry)
+        self._area_policy_memo[(prefix, area)] = (entry, policy, out)
+        return out
+
     def sync_kvstore(self) -> None:
         desired = self.best_entries()
-        # desired advertisement set per (prefix, area)
-        new_advertised: dict[str, tuple[PrefixEntry, tuple[str, ...]]] = {
-            prefix: (entry, self._areas_for(prefix, entry))
-            for prefix, entry in desired.items()
+        # desired advertisement set per (prefix, area), post-area-policy
+        new_advertised: dict[str, dict[str, PrefixEntry]] = {}
+        for prefix, entry in desired.items():
+            per_area: dict[str, PrefixEntry] = {}
+            for area in self._areas_for(prefix, entry):
+                out = self._entry_for_area(prefix, entry, area)
+                if out is not None:
+                    per_area[area] = out
+            if per_area:
+                new_advertised[prefix] = per_area
+        # drop memo entries for prefixes no longer advertised at all
+        self._area_policy_memo = {
+            k: v for k, v in self._area_policy_memo.items()
+            if k[0] in desired
         }
-        for prefix, (entry, areas) in new_advertised.items():
-            if self._advertised.get(prefix) == (entry, areas):
-                continue
-            for area in areas:
+        for prefix, per_area in new_advertised.items():
+            old = self._advertised.get(prefix)
+            for area, entry in per_area.items():
+                if old is not None and old.get(area) == entry:
+                    continue
                 self._kv_request_q.push(
                     KeyValueRequest(
                         request_type=KeyValueRequestType.PERSIST,
@@ -569,14 +612,10 @@ class PrefixManager(Actor):
                 )
         # withdrawals: one-shot delete_prefix tombstone (SET, not PERSIST —
         # it must flood once and age out, not be defended); also tombstone
-        # areas a prefix was re-scoped away from
-        for prefix, (old_entry, old_areas) in self._advertised.items():
-            now = new_advertised.get(prefix)
-            gone_areas = (
-                old_areas
-                if now is None
-                else tuple(a for a in old_areas if a not in now[1])
-            )
+        # areas a prefix was re-scoped away from (or newly policy-denied)
+        for prefix, old_per_area in self._advertised.items():
+            now = new_advertised.get(prefix, {})
+            gone_areas = tuple(a for a in old_per_area if a not in now)
             for area in gone_areas:
                 self._kv_request_q.push(
                     KeyValueRequest(
@@ -609,16 +648,21 @@ class PrefixManager(Actor):
         return self.best_entries()
 
     async def get_advertised_routes(self) -> dict[str, PrefixEntry]:
-        return {p: entry for p, (entry, _) in self._advertised.items()}
+        # per-area policies can transform entries per destination; the
+        # un-scoped view reports one representative advertisement
+        return {
+            p: next(iter(per_area.values()))
+            for p, per_area in self._advertised.items()
+        }
 
     async def get_area_advertised_routes(
         self, area: str
     ) -> dict[str, PrefixEntry]:
         """What this node advertises INTO one area (ref
         getAreaAdvertisedRoutes, OpenrCtrl.thrift:~330) — honors
-        per-(prefix,type) destination-area restrictions."""
+        destination-area restrictions AND that area's import policy."""
         return {
-            p: entry
-            for p, (entry, areas) in self._advertised.items()
-            if area in areas
+            p: per_area[area]
+            for p, per_area in self._advertised.items()
+            if area in per_area
         }

@@ -75,6 +75,7 @@ class OpenrWrapper:
         monitor=None,
         kv_listen_addr: str = "127.0.0.1",
         resolve_area=None,
+        area_policies: Optional[dict[str, str]] = None,
     ):
         self.node_name = node_name
         self.kv_ports = kv_ports  # shared node -> kvstore port registry
@@ -205,6 +206,7 @@ class OpenrWrapper:
             sync_throttle_s=0.002,
             policy_manager=policy_manager,
             origination_policy=origination_policy,
+            area_policies=area_policies,
         )
         self.fib_service = fib_service or MockFibService()
         self.fib = Fib(

@@ -359,15 +359,28 @@ class Spark(Actor):
         self._rate[sender] = (tokens - 1.0, now)
         return True
 
-    def _get_neighbor(self, if_name: str, node: str) -> _NeighborInfo:
+    def _get_neighbor(
+        self, if_name: str, node: str
+    ) -> Optional[_NeighborInfo]:
+        """None when NO configured area claims (neighbor, interface) —
+        the matchers gate admission (ref area negotiation failure), so
+        an unclaimed sender must be refused outright, not given an
+        adjacency under a phantom '' area that KvStore then rejects."""
         key = (if_name, node)
         nb = self.neighbors.get(key)
         if nb is None:
+            area = self._resolve_area(node, if_name)
+            if area is None:
+                counters.increment("spark.neighbor.no_area_match")
+                log.warning(
+                    "%s: no area claims neighbor %s on %s — refusing",
+                    self.node_name, node, if_name,
+                )
+                return None
             nb = self.neighbors[key] = _NeighborInfo(
                 node_name=node, if_name=if_name
             )
-            area = self._resolve_area(node, if_name)
-            nb.area = area if area is not None else ""
+            nb.area = area
         nb.last_msg_ts = time.monotonic()
         return nb
 
@@ -416,6 +429,8 @@ class Spark(Actor):
         if not pkt.sent_ts_us:
             pkt.sent_ts_us = hello.sent_ts_us
         nb = self._get_neighbor(pkt.from_if_name, hello.node_name)
+        if nb is None:
+            return  # no configured area admits this neighbor
         nb.their_if_name = hello.if_name
         nb.their_seq_num = hello.seq_num
         nb.their_last_sent_ts_us = pkt.sent_ts_us or hello.sent_ts_us
@@ -540,6 +555,8 @@ class Spark(Actor):
             return  # directed at someone else
         counters.increment("spark.handshake.packets_recv")
         nb = self._get_neighbor(pkt.from_if_name, msg.node_name)
+        if nb is None:
+            return  # no configured area admits this neighbor
 
         # area validation: both sides must agree (ref area negotiation)
         if msg.area and nb.area and msg.area != nb.area:

@@ -645,3 +645,94 @@ class TestCrossAreaRedistribution:
             db = deserialize(req.value, PrefixDatabase)
             assert db.delete_prefix and req.area == "area2"
             assert "10.54.0.0/24" not in h.pm._redistributed
+
+
+class TestAreaImportPolicy:
+    """Per-destination-area import policies (ref AreaConfig
+    import_policy_name + areaToPolicy_, PrefixManager.cpp:76,506)."""
+
+    @staticmethod
+    def harness():
+        from openr_tpu.policy.policy_manager import (
+            Policy,
+            PolicyAction,
+            PolicyManager,
+            PolicyMatch,
+            PolicyStatement,
+        )
+
+        pm = PolicyManager(
+            {
+                "v4-only-tagged": Policy(
+                    statements=(
+                        PolicyStatement(
+                            name="allow-10-60",
+                            match=PolicyMatch(prefixes=("10.60.0.0/16",)),
+                            action=PolicyAction(set_tags=("crossed",)),
+                        ),
+                    ),
+                    default_accept=False,
+                )
+            }
+        )
+        h = PmHarness(areas=("area1", "area2"))
+        h.pm.policy_manager = pm
+        h.pm.area_policies = {"area2": "v4-only-tagged"}
+        return h
+
+    @run_async
+    async def test_policy_gates_and_transforms_per_area(self):
+        async with self.harness() as h:
+            h.prefix_q.push(
+                PrefixEvent(
+                    event_type=PrefixEventType.ADD_PREFIXES,
+                    type=PrefixType.LOOPBACK,
+                    prefixes=[entry("10.60.1.0/24"), entry("10.99.1.0/24")],
+                )
+            )
+            got = {}
+            for _ in range(3):  # 10.60 -> both areas, 10.99 -> area1 only
+                req = await h.next_req()
+                db = deserialize(req.value, PrefixDatabase)
+                got[(req.area, db.prefix_entries[0].prefix)] = (
+                    db.prefix_entries[0]
+                )
+            assert set(got) == {
+                ("area1", "10.60.1.0/24"),
+                ("area2", "10.60.1.0/24"),
+                ("area1", "10.99.1.0/24"),
+            }
+            # the policy's transform applies only to the area it gates
+            assert "crossed" in got[("area2", "10.60.1.0/24")].tags
+            assert "crossed" not in got[("area1", "10.60.1.0/24")].tags
+            # introspection matches
+            area2 = await h.pm.get_area_advertised_routes("area2")
+            assert set(area2) == {"10.60.1.0/24"}
+            area1 = await h.pm.get_area_advertised_routes("area1")
+            assert set(area1) == {"10.60.1.0/24", "10.99.1.0/24"}
+
+    @run_async
+    async def test_policy_swap_retracts_denied_area(self):
+        """Replacing the policy binding re-runs the gate: a prefix the
+        new policy denies gets a tombstone in that area."""
+        async with self.harness() as h:
+            h.prefix_q.push(
+                PrefixEvent(
+                    event_type=PrefixEventType.ADD_PREFIXES,
+                    type=PrefixType.LOOPBACK,
+                    prefixes=[entry("10.60.2.0/24")],
+                )
+            )
+            for _ in range(2):
+                await h.next_req()
+            from openr_tpu.policy.policy_manager import Policy
+
+            h.pm.policy_manager.policies["v4-only-tagged"] = Policy(
+                statements=(), default_accept=False
+            )
+            h.pm.sync_kvstore()
+            req = await h.next_req()
+            assert req.request_type == KeyValueRequestType.SET
+            assert req.area == "area2"
+            db = deserialize(req.value, PrefixDatabase)
+            assert db.delete_prefix

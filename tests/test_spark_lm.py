@@ -614,3 +614,39 @@ class TestSoftDrain:
                 await lm.set_node_metric_increment(-5)
         finally:
             await lm.stop()
+
+
+class TestAreaAdmission:
+    """resolve_area returning None must REFUSE the neighbor — no state,
+    no adjacency under a phantom area (review finding: the matchers
+    previously failed open to area '')."""
+
+    @run_async
+    async def test_unmatched_neighbor_refused(self):
+        from openr_tpu.runtime.counters import counters
+
+        mesh = MockIoMesh()
+        a, b = SparkNode(mesh, "a"), SparkNode(mesh, "b")
+        # a admits only spine-* nodes; b has no restrictions
+        a.spark._resolve_area = (
+            lambda node, iface: "0" if node.startswith("spine-") else None
+        )
+        mesh.connect("a", "if-ab", "b", "if-ba")
+        before = counters.get_counter("spark.neighbor.no_area_match") or 0
+        await a.start("if-ab")
+        await b.start("if-ba")
+        try:
+            # b keeps helloing; a must never form state for it
+            await asyncio.sleep(0.6)
+            assert await a.spark.get_neighbors() == []
+            assert (
+                counters.get_counter("spark.neighbor.no_area_match") or 0
+            ) > before
+            # b sees a's hellos but never completes (a won't handshake)
+            nbs = await b.spark.get_neighbors()
+            assert all(
+                nb.state != SparkNeighState.ESTABLISHED for nb in nbs
+            )
+        finally:
+            await a.stop()
+            await b.stop()
