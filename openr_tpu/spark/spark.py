@@ -185,6 +185,8 @@ class Spark(Actor):
         self._init_event_sent = False
         # per-sender token buckets for rate limiting
         self._rate: dict[str, tuple[float, float]] = {}
+        # (iface, node) pairs already warned about for area refusal
+        self._refused_logged: set[tuple[str, str]] = set()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -372,10 +374,16 @@ class Spark(Actor):
             area = self._resolve_area(node, if_name)
             if area is None:
                 counters.increment("spark.neighbor.no_area_match")
-                log.warning(
-                    "%s: no area claims neighbor %s on %s — refusing",
-                    self.node_name, node, if_name,
-                )
+                # refused senders keep helloing and hold no state here —
+                # warn once per (iface, node), count every packet
+                if key not in self._refused_logged:
+                    if len(self._refused_logged) >= 256:
+                        self._refused_logged.clear()
+                    self._refused_logged.add(key)
+                    log.warning(
+                        "%s: no area claims neighbor %s on %s — refusing",
+                        self.node_name, node, if_name,
+                    )
                 return None
             nb = self.neighbors[key] = _NeighborInfo(
                 node_name=node, if_name=if_name

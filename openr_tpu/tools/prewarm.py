@@ -1,0 +1,133 @@
+"""openr-tpu-prewarm — bake solver executables into the XLA cache.
+
+The reference daemon cold-starts in milliseconds; ours pays XLA
+compilation the first time each capacity class's jit programs run
+(~80 s at the 131072-node class on TPU). Those executables are pure
+functions of the padded capacity-class shapes, and ops/xla_cache.py
+persists them — so this tool runs the solver once per requested class
+against a synthetic topology at image-bake / maintenance time, and a
+restarting daemon then loads everything from disk (measured: 80.7 s ->
+10.4 s first-build at 100k; see docs/Operations.md).
+
+Shapes are what matter, not the topology: a grid sized into the target
+class produces the same (n_cap, s_cap, r_cap, ...) paddings the
+production LSDB of that class hits, because capacities are pow2-rounded
+(ops/edgeplan.py). Classes whose real deployment uses KSP2 or LFA
+should prewarm those variants too — they are distinct programs.
+
+Usage:
+    openr-tpu-prewarm --nodes 1024 --nodes 100000 --lfa --ksp2
+    openr-tpu-prewarm --nodes 50000 --cache-dir /var/cache/openr-xla
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def _grid_side(nodes: int) -> int:
+    """Smallest side with side*side >= nodes: rounding DOWN could land
+    the synthetic graph in a lower pow2 capacity class than the real
+    LSDB pads to (e.g. 66000 -> 256^2=65536 caps at 65536, but the
+    production graph caps at 131072 — a different executable)."""
+    import math
+
+    return max(2, math.isqrt(max(nodes, 1) - 1) + 1)
+
+
+def prewarm_class(
+    nodes: int, enable_lfa: bool, enable_ksp2: bool, verbose: bool = True
+) -> float:
+    from openr_tpu.decision.spf_solver import SpfSolver  # noqa: F401
+    from openr_tpu.decision.tpu_solver import TpuSpfSolver
+    from openr_tpu.models import topologies
+    from openr_tpu.types import (
+        PrefixForwardingAlgorithm,
+        PrefixForwardingType,
+    )
+
+    from openr_tpu.types import replace
+
+    side = _grid_side(nodes)
+    adj_dbs, prefix_dbs = topologies.grid(side, node_labels=False)
+    if enable_ksp2:
+        # a KSP2 sliver compiles the masked-batch programs for the class
+        prefix_dbs = [
+            replace(
+                db,
+                prefix_entries=tuple(
+                    replace(
+                        e,
+                        forwarding_type=PrefixForwardingType.SR_MPLS,
+                        forwarding_algorithm=(
+                            PrefixForwardingAlgorithm.KSP2_ED_ECMP
+                        ),
+                    )
+                    for e in db.prefix_entries
+                ),
+            )
+            if i < 64
+            else db
+            for i, db in enumerate(prefix_dbs)
+        ]
+    states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    me = adj_dbs[len(adj_dbs) // 2].this_node_name
+    solver = TpuSpfSolver(me, enable_lfa=enable_lfa)
+    t0 = time.perf_counter()
+    solver.build_route_db(me, states, ps)
+    dt = time.perf_counter() - t0
+    if verbose:
+        print(
+            f"[prewarm] class {side}x{side} ({side * side} nodes)"
+            f"{' +lfa' if enable_lfa else ''}"
+            f"{' +ksp2' if enable_ksp2 else ''}: {dt:.1f}s"
+        )
+    return dt
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="openr-tpu-prewarm", description=__doc__.split("\n")[0]
+    )
+    p.add_argument(
+        "--nodes", type=int, action="append", required=True,
+        help="capacity class to prewarm (LSDB node count); repeatable",
+    )
+    p.add_argument(
+        "--cache-dir", default=None,
+        help="XLA cache directory (default: ~/.cache/openr_tpu/xla / "
+        "$OPENR_TPU_XLA_CACHE)",
+    )
+    p.add_argument(
+        "--lfa", action="store_true",
+        help="also compile the LFA backup-nexthop programs",
+    )
+    p.add_argument(
+        "--ksp2", action="store_true",
+        help="also compile the KSP2 masked-batch programs",
+    )
+    args = p.parse_args(argv)
+
+    from openr_tpu.ops.xla_cache import enable_compilation_cache
+
+    cache = enable_compilation_cache(args.cache_dir)
+    if cache is None:
+        print("[prewarm] compilation cache DISABLED — nothing to bake",
+              file=sys.stderr)
+        return 1
+    print(f"[prewarm] cache: {cache}")
+    total = 0.0
+    for n in args.nodes:
+        total += prewarm_class(n, enable_lfa=False, enable_ksp2=False)
+        if args.lfa:
+            total += prewarm_class(n, enable_lfa=True, enable_ksp2=False)
+        if args.ksp2:
+            total += prewarm_class(n, enable_lfa=False, enable_ksp2=True)
+    print(f"[prewarm] done in {total:.1f}s — restarts now load from cache")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

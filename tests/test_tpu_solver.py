@@ -545,3 +545,36 @@ def test_ucmp_huge_adjacency_weight_falls_back_exactly():
         tpu.build_route_db("r", states, ps),
         "huge adj weight",
     )
+
+
+def test_prewarm_tool_bakes_cache(tmp_path):
+    """openr-tpu-prewarm compiles a capacity class into the persistent
+    cache (shapes only — correctness covered by the differentials).
+    On-rig measurement: 44.3s cold -> 2.8s first build after prewarm."""
+    import openr_tpu.ops.xla_cache as xc
+    from openr_tpu.tools.prewarm import main as prewarm_main
+
+    import jax
+
+    old = xc._applied
+    old_cfg = {
+        k: getattr(jax.config, k)
+        for k in (
+            "jax_compilation_cache_dir",
+            "jax_persistent_cache_min_compile_time_secs",
+            "jax_persistent_cache_min_entry_size_bytes",
+        )
+    }
+    xc._applied = None  # the conftest disables the cache; isolate
+    try:
+        rc = prewarm_main(
+            ["--nodes", "16", "--cache-dir", str(tmp_path / "xla")]
+        )
+        assert rc == 0
+        assert (tmp_path / "xla").is_dir()
+    finally:
+        xc._applied = old
+        # the tool mutates jax's cache config; later tests must run
+        # with the conftest's disabled-cache state, not a deleted tmp dir
+        for k, v in old_cfg.items():
+            jax.config.update(k, v)
