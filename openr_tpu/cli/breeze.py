@@ -169,6 +169,159 @@ def flood_topo(ctx, area) -> None:
     _print(_call(ctx, "ctrl.kvstore.flood_topo", {"area": area}))
 
 
+@kvstore.command("nodes")
+@click.option("--area", default="0")
+@click.pass_context
+def kv_nodes(ctx, area) -> None:
+    """Node names present in the LSDB (ref breeze kvstore nodes):
+    derived from adj:/prefix: keys."""
+    from openr_tpu.types import parse_adj_key, parse_prefix_key
+
+    dump = _call(ctx, "ctrl.kvstore.dump", {"area": area})
+    nodes: dict[str, dict] = {}
+    for key in dump:
+        adj = parse_adj_key(key)
+        if adj:
+            nodes.setdefault(adj, {"adj": False, "prefixes": 0})["adj"] = True
+        parsed = parse_prefix_key(key)
+        if parsed:
+            n = nodes.setdefault(
+                parsed[0], {"adj": False, "prefixes": 0}
+            )
+            n["prefixes"] += 1
+    _print(nodes)
+
+
+@kvstore.command("snoop")
+@click.option("--area", default="0")
+@click.option("--duration", default=0.0, type=float,
+              help="seconds to snoop; 0 = forever")
+@click.option("--no-snapshot", is_flag=True,
+              help="skip the initial full dump, print deltas only")
+@click.pass_context
+def kv_snoop(ctx, area, duration, no_snapshot) -> None:
+    """Live-print KvStore deltas as they flood (ref breeze kvstore
+    snoop, clis/kvstore.py SnoopCli — on the streaming subscription)."""
+    import time as _time
+
+    async def run():
+        client = RpcClient(
+            ctx.obj["host"], ctx.obj["port"], name="breeze",
+            ssl=ctx.obj.get("ssl"),
+            expected_peer=ctx.obj.get("peer_name", ""),
+        )
+        try:
+            q = await client.subscribe(
+                "ctrl.kvstore.subscribe", {"area": area}
+            )
+            deadline = (
+                _time.monotonic() + duration if duration > 0 else None
+            )
+            while True:
+                remaining = (
+                    None if deadline is None
+                    else deadline - _time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    return
+                try:
+                    item = await asyncio.wait_for(q.get(), remaining)
+                except asyncio.TimeoutError:
+                    return
+                if isinstance(item, Exception):
+                    raise item
+                if item is None:
+                    return  # stream closed
+                if "snapshot" in item:
+                    if not no_snapshot:
+                        click.echo(json.dumps(
+                            {"snapshot_keys": sorted(item["snapshot"])},
+                            default=str,
+                        ))
+                    continue
+                click.echo(json.dumps(item, sort_keys=True, default=str))
+        finally:
+            await client.close()
+
+    asyncio.run(run())
+
+
+@kvstore.command("kv-compare")
+@click.option("--nodes", required=True,
+              help="comma-separated host:port ctrl endpoints to compare "
+              "against this node")
+@click.option("--peer-names", default="",
+              help="comma-separated TLS identity pins for --nodes (same "
+              "order); the local node uses --peer-name")
+@click.option("--area", default="0")
+@click.pass_context
+def kv_compare(ctx, nodes, peer_names, area) -> None:
+    """Diff this node's store against other nodes' (ref breeze kvstore
+    kv-compare): missing keys and (version, originator) divergence.
+    Exit code 1 on any delta."""
+    specs = [s.strip() for s in nodes.split(",") if s.strip()]
+    pins = [p.strip() for p in peer_names.split(",")] if peer_names else []
+    if pins and len(pins) != len(specs):
+        raise click.UsageError(
+            f"--peer-names has {len(pins)} entries for {len(specs)} nodes"
+        )
+    targets = []
+    for i, spec in enumerate(specs):
+        host, _, port = spec.rpartition(":")
+        if not port.isdigit():
+            raise click.UsageError(
+                f"--nodes entry {spec!r} is not host:port"
+            )
+        targets.append(
+            (spec, host or "127.0.0.1", int(port), pins[i] if pins else "")
+        )
+    if not targets:
+        raise click.UsageError("--nodes is empty")
+
+    async def dump_of(host, port, pin):
+        client = RpcClient(
+            host, port, name="breeze",
+            ssl=ctx.obj.get("ssl"),
+            expected_peer=pin,
+        )
+        try:
+            return await client.request(
+                "ctrl.kvstore.dump", {"area": area}
+            )
+        finally:
+            await client.close()
+
+    async def run():
+        mine = await dump_of(
+            ctx.obj["host"], ctx.obj["port"],
+            ctx.obj.get("peer_name", ""),
+        )
+        report = {}
+        for spec, host, port, pin in targets:
+            theirs = await dump_of(host, port, pin)
+
+            def ident(v):
+                return (v.get("version"), v.get("originator_id"))
+
+            delta = {
+                "missing_there": sorted(set(mine) - set(theirs)),
+                "missing_here": sorted(set(theirs) - set(mine)),
+                "diverged": sorted(
+                    k
+                    for k in set(mine) & set(theirs)
+                    if ident(mine[k]) != ident(theirs[k])
+                ),
+            }
+            delta["ok"] = not any(delta.values())
+            report[spec] = delta
+        return report
+
+    report = asyncio.run(run())
+    _print(report)
+    if not all(r["ok"] for r in report.values()):
+        raise SystemExit(1)
+
+
 @kvstore.command("long-poll-adj")
 @click.option("--area", default="0")
 @click.option(
@@ -256,6 +409,30 @@ def adjacencies(ctx) -> None:
     _print(_call(ctx, "ctrl.decision.adj_dbs"))
 
 
+@decision.command("path")
+@click.argument("src")
+@click.argument("dst")
+@click.option("--area", default="", help="restrict to one area")
+@click.option("--k", default=2, help="edge-disjoint paths per area")
+@click.pass_context
+def decision_path(ctx, src, dst, area, k) -> None:
+    """Paths between two nodes from the live LSDB (ref breeze decision
+    path)."""
+    _print(_call(ctx, "ctrl.decision.path",
+                 {"src": src, "dst": dst, "area": area, "k": k}))
+
+
+@decision.command("validate")
+@click.pass_context
+def decision_validate(ctx) -> None:
+    """Cross-check Decision's LSDB view against KvStore's keys (ref
+    breeze decision validate). Exit code 1 on any delta."""
+    report = _call(ctx, "ctrl.decision.validate")
+    _print(report)
+    if not all(area["ok"] for area in report.values()):
+        raise SystemExit(1)
+
+
 @decision.command("received-routes")
 @click.pass_context
 def received_routes(ctx) -> None:
@@ -310,6 +487,17 @@ def fib_mpls(ctx) -> None:
 def fib_route_detail(ctx) -> None:
     """Programmed routes with selection detail (ref getRouteDetailDb)."""
     _print(_call(ctx, "ctrl.fib.route_detail_db"))
+
+
+@fib.command("validate")
+@click.pass_context
+def fib_validate(ctx) -> None:
+    """Decision's computed routes vs Fib's programmed state (ref breeze
+    fib validate). Exit code 1 on any persistent delta."""
+    report = _call(ctx, "ctrl.fib.validate")
+    _print(report)
+    if not report["ok"]:
+        raise SystemExit(1)
 
 
 # -- perf -------------------------------------------------------------------

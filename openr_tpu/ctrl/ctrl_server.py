@@ -108,6 +108,11 @@ class CtrlServer(Actor):
             s.register(
                 "ctrl.decision.received_routes", self._decision_received
             )
+            s.register("ctrl.decision.path", self._decision_path)
+            if self.kvstore is not None:
+                s.register(
+                    "ctrl.decision.validate", self._decision_validate
+                )
             s.register("ctrl.decision.set_rib_policy", self._set_rib_policy)
             s.register("ctrl.decision.get_rib_policy", self._get_rib_policy)
             s.register(
@@ -120,6 +125,8 @@ class CtrlServer(Actor):
             s.register("ctrl.fib.mpls_filtered", self._fib_mpls_filtered)
             s.register("ctrl.fib.perf", self._fib_perf)
             s.register("ctrl.fib.route_detail_db", self._fib_route_detail_db)
+            if self.decision is not None:
+                s.register("ctrl.fib.validate", self._fib_validate)
         s.register("ctrl.subscriber_info", self._subscriber_info)
         if self.link_monitor is not None:
             s.register("ctrl.lm.links", self._lm_links)
@@ -409,6 +416,85 @@ class CtrlServer(Actor):
             and (not node or node_area[0] == node)
             and (not area or node_area[1] == area)
         ]
+
+    async def _decision_path(
+        self, src: str = "", dst: str = "", area: str = "", k: int = 2
+    ) -> list:
+        """ref `breeze decision path` (clis/decision.py PathCli): up to
+        k edge-disjoint paths between two nodes from the live LSDB."""
+        return await self.decision.get_paths(
+            src or self.node_name, dst, area=area, k=int(k)
+        )
+
+    async def _decision_validate(self) -> dict:
+        """ref DecisionValidateCmd (commands/decision.py:434): per area,
+        Decision's view of the LSDB must mirror KvStore's keys — report
+        node sets present in one but not the other."""
+        from openr_tpu.types import parse_adj_key, parse_prefix_key
+
+        out: dict[str, dict] = {}
+        adj_dbs = await self.decision.get_adj_dbs()
+        prefix_dbs = await self.decision.get_prefix_dbs()
+        areas = list(getattr(self.kvstore, "areas", None) or adj_dbs)
+        for area in areas:
+            kv = await self.kvstore.dump_all(area)
+            kv_adj = {
+                n for n in (parse_adj_key(key) for key in kv) if n
+            }
+            kv_prefix = set()
+            for key in kv:
+                parsed = parse_prefix_key(key)
+                if parsed and parsed[1] == area:
+                    kv_prefix.add(parsed[0])
+            dec_adj = set(adj_dbs.get(area, {}))
+            dec_prefix = {
+                node
+                for node, by_area in prefix_dbs.items()
+                if area in by_area
+            }
+            report = {
+                "adj_only_in_kvstore": sorted(kv_adj - dec_adj),
+                "adj_only_in_decision": sorted(dec_adj - kv_adj),
+                "prefix_only_in_kvstore": sorted(kv_prefix - dec_prefix),
+                "prefix_only_in_decision": sorted(dec_prefix - kv_prefix),
+            }
+            report["ok"] = not any(v for v in report.values())
+            out[area] = report
+        return out
+
+    async def _fib_validate(self) -> dict:
+        """ref FibValidateRoutesCmd (commands/fib.py:216): Decision's
+        computed routes vs Fib's programmed state must agree (the Fib
+        actor's dirty/retry machinery closes transient gaps — persistent
+        deltas mean routes stuck unprogrammed)."""
+        dec = await self.decision.get_decision_route_db(None)
+        fib_unicast = await self.fib.get_route_db()
+        fib_mpls = await self.fib.get_mpls_route_db()
+        dec_unicast = dict(dec.unicast_routes) if dec else {}
+        dec_mpls = dict(dec.mpls_routes) if dec else {}
+        mismatched = sorted(
+            p
+            for p in set(dec_unicast) & set(fib_unicast)
+            if dec_unicast[p].nexthops != fib_unicast[p].nexthops
+        )
+        report = {
+            "unicast_only_in_decision": sorted(
+                set(dec_unicast) - set(fib_unicast)
+            ),
+            "unicast_only_in_fib": sorted(
+                set(fib_unicast) - set(dec_unicast)
+            ),
+            "unicast_nexthop_mismatch": mismatched,
+            "mpls_only_in_decision": sorted(
+                set(dec_mpls) - set(fib_mpls)
+            ),
+            "mpls_only_in_fib": sorted(set(fib_mpls) - set(dec_mpls)),
+            "fib_synced": self.fib.synced,
+        }
+        report["ok"] = self.fib.synced and not any(
+            v for k, v in report.items() if k not in ("ok", "fib_synced")
+        )
+        return report
 
     async def _set_rib_policy(self, policy: dict) -> dict:
         from openr_tpu.decision.rib_policy import RibPolicy

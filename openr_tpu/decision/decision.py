@@ -445,6 +445,39 @@ class Decision(Actor):
     async def get_received_routes(self):
         return self.prefix_state.received_routes()
 
+    async def get_paths(
+        self, src: str, dst: str, area: str = "", k: int = 2
+    ) -> list[dict]:
+        """k edge-disjoint paths src -> dst per area (ref `breeze
+        decision path`, clis/decision.py PathCli, on LinkState's
+        getKthPaths machinery). Each path: ordered hops with the egress
+        interface and per-hop metric."""
+        out: list[dict] = []
+        for a, ls in self.area_link_states.items():
+            if area and a != area:
+                continue
+            if not (ls.has_node(src) and ls.has_node(dst)):
+                continue
+            for ki in range(1, max(1, k) + 1):
+                for path in ls.get_kth_paths(src, dst, ki):
+                    hops, cur, cost = [], src, 0
+                    for link in path:
+                        m = link.metric_from_node(cur)
+                        hops.append(
+                            {
+                                "node": cur,
+                                "iface": link.iface_from_node(cur),
+                                "next": link.other_node(cur),
+                                "metric": m,
+                            }
+                        )
+                        cost += m
+                        cur = link.other_node(cur)
+                    out.append(
+                        {"area": a, "k": ki, "cost": cost, "hops": hops}
+                    )
+        return out
+
     async def get_prefix_dbs(self):
         """Announcer -> area -> prefix -> entry, as Decision currently
         sees the network (ref getDecisionPrefixDbs)."""

@@ -40,15 +40,13 @@ def _grid_side(nodes: int) -> int:
 def prewarm_class(
     nodes: int, enable_lfa: bool, enable_ksp2: bool, verbose: bool = True
 ) -> float:
-    from openr_tpu.decision.spf_solver import SpfSolver  # noqa: F401
     from openr_tpu.decision.tpu_solver import TpuSpfSolver
     from openr_tpu.models import topologies
     from openr_tpu.types import (
         PrefixForwardingAlgorithm,
         PrefixForwardingType,
+        replace,
     )
-
-    from openr_tpu.types import replace
 
     side = _grid_side(nodes)
     adj_dbs, prefix_dbs = topologies.grid(side, node_labels=False)

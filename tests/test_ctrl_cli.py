@@ -19,7 +19,8 @@ async def start_two_node(enable_ctrl=True):
     kv_ports = {}
     a = OpenrWrapper("node-a", mesh.provider("node-a"), kv_ports,
                      enable_ctrl=enable_ctrl)
-    b = OpenrWrapper("node-b", mesh.provider("node-b"), kv_ports)
+    b = OpenrWrapper("node-b", mesh.provider("node-b"), kv_ports,
+                     enable_ctrl=enable_ctrl)
     mesh.connect("node-a", "if-ab", "node-b", "if-ba")
     await a.start("if-ab")
     await b.start("if-ba")
@@ -194,6 +195,50 @@ class TestCtrlServer:
             await b.stop()
 
     @run_async
+    async def test_validate_rpcs_catch_planted_discrepancies(self):
+        """ref decision/fib validate: a clean node reports ok; a planted
+        delta (route removed from Fib's state behind its back) is
+        flagged."""
+        mesh, a, b = await start_two_node()
+        client = RpcClient("127.0.0.1", a.ctrl.port)
+        try:
+            dec = await client.request("ctrl.decision.validate")
+            assert all(area["ok"] for area in dec.values()), dec
+            fibv = await client.request("ctrl.fib.validate")
+            assert fibv["ok"], fibv
+
+            # plant: drop a programmed route from the Fib actor's state
+            victim = next(iter(a.fib.route_state.unicast_routes))
+            del a.fib.route_state.unicast_routes[victim]
+            fibv = await client.request("ctrl.fib.validate")
+            assert not fibv["ok"]
+            assert victim in fibv["unicast_only_in_decision"]
+        finally:
+            await client.close()
+            await a.stop()
+            await b.stop()
+
+    @run_async
+    async def test_decision_path_rpc(self):
+        """ref breeze decision path: hops with egress interfaces."""
+        mesh, a, b = await start_two_node()
+        client = RpcClient("127.0.0.1", a.ctrl.port)
+        try:
+            paths = await client.request(
+                "ctrl.decision.path", {"src": "node-a", "dst": "node-b"}
+            )
+            assert paths, "no path found"
+            first = paths[0]
+            assert first["cost"] >= 1
+            assert first["hops"][0]["node"] == "node-a"
+            assert first["hops"][0]["iface"] == "if-ab"
+            assert first["hops"][-1]["next"] == "node-b"
+        finally:
+            await client.close()
+            await a.stop()
+            await b.stop()
+
+    @run_async
     async def test_fib_route_detail_db(self):
         """ref getRouteDetailDb: programmed routes carry the selection
         detail FibService never sees (best_prefix_entry, best node)."""
@@ -280,6 +325,7 @@ class TestBreezeCli:
             stop = asyncio.Event()
             mesh, a, b = await start_two_node()
             ctrl_port["port"] = a.ctrl.port
+            ctrl_port["port_b"] = b.ctrl.port
             loop_holder["loop"] = asyncio.get_running_loop()
             started.set()
             await stop.wait()
@@ -320,6 +366,58 @@ class TestBreezeCli:
 
             res = runner.invoke(cli, base + ["openr", "subscribers"], obj={})
             assert res.exit_code == 0, res.output
+
+            res = runner.invoke(cli, base + ["fib", "validate"], obj={})
+            assert res.exit_code == 0, res.output
+            assert '"ok": true' in res.output
+
+            res = runner.invoke(
+                cli, base + ["decision", "validate"], obj={}
+            )
+            assert res.exit_code == 0, res.output
+            assert '"ok": true' in res.output
+
+            res = runner.invoke(
+                cli,
+                base + ["decision", "path", "node-a", "node-b"],
+                obj={},
+            )
+            assert res.exit_code == 0, res.output
+            assert "if-ab" in res.output
+
+            res = runner.invoke(cli, base + ["kvstore", "nodes"], obj={})
+            assert res.exit_code == 0, res.output
+            assert "node-b" in res.output
+
+            res = runner.invoke(
+                cli,
+                base + ["kvstore", "snoop", "--duration", "0.3"],
+                obj={},
+            )
+            assert res.exit_code == 0, res.output
+            assert "snapshot_keys" in res.output
+
+            # genuinely cross-node: converged peers must compare clean
+            res = runner.invoke(
+                cli,
+                base
+                + [
+                    "kvstore", "kv-compare",
+                    "--nodes", f"127.0.0.1:{ctrl_port['port_b']}",
+                ],
+                obj={},
+            )
+            assert res.exit_code == 0, res.output
+            assert '"ok": true' in res.output
+
+            # malformed --nodes is a usage error, not a traceback
+            res = runner.invoke(
+                cli,
+                base + ["kvstore", "kv-compare", "--nodes", "no-port"],
+                obj={},
+            )
+            assert res.exit_code == 2, res.output
+            assert "host:port" in res.output
 
             res = runner.invoke(cli, base + ["spark", "neighbors"], obj={})
             assert res.exit_code == 0, res.output
