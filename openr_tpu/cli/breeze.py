@@ -730,6 +730,28 @@ def event_logs(ctx) -> None:
     _print(_call(ctx, "monitor.event_logs"))
 
 
+@monitor.command("heap-profile")
+@click.option("--start", "action", flag_value="start",
+              help="begin tracing allocations")
+@click.option("--dump", "action", flag_value="dump", default=True,
+              help="show top allocation sites (default)")
+@click.option("--stop", is_flag=True, help="stop tracing after dump")
+@click.option("--top", default=25)
+@click.pass_context
+def heap_profile(ctx, action, stop, top) -> None:
+    """Heap profiling (ref MonitorBase::dumpHeapProfile; tracemalloc)."""
+    if action == "start":
+        if stop:
+            raise click.UsageError(
+                "--start and --stop are exclusive; dump with --stop to "
+                "end a trace"
+            )
+        _print(_call(ctx, "monitor.heap_profile.start"))
+    else:
+        _print(_call(ctx, "monitor.heap_profile.dump",
+                     {"top": top, "stop": stop}))
+
+
 # -- tech-support -----------------------------------------------------------
 
 @cli.command("tech-support")

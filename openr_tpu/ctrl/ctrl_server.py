@@ -78,6 +78,8 @@ class CtrlServer(Actor):
         s.register("openr.build_info", self._build_info)
         s.register("monitor.counters", self._counters)
         s.register("monitor.event_logs", self._event_logs)
+        s.register("monitor.heap_profile.start", self._heap_profile_start)
+        s.register("monitor.heap_profile.dump", self._heap_profile_dump)
         s.register("ctrl.store.set", self._store_set)
         s.register("ctrl.store.get", self._store_get)
         s.register("ctrl.store.erase", self._store_erase)
@@ -247,6 +249,20 @@ class CtrlServer(Actor):
             "build_platform": _platform.platform(),
             "build_python": _platform.python_version(),
         }
+
+    async def _heap_profile_start(self, frames: int = 8) -> dict:
+        """ref MonitorBase::dumpHeapProfile hook (MonitorBase.h:54);
+        tracemalloc is process-global, no Monitor actor required."""
+        from openr_tpu.runtime.monitor import start_heap_profile
+
+        return start_heap_profile(int(frames))
+
+    async def _heap_profile_dump(
+        self, top: int = 25, stop: bool = False
+    ) -> dict:
+        from openr_tpu.runtime.monitor import dump_heap_profile
+
+        return await dump_heap_profile(int(top), bool(stop))
 
     async def _event_logs(self) -> list:
         """ref getEventLogs — Monitor's LogSample ring."""

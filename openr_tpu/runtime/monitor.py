@@ -110,6 +110,57 @@ class Monitor(Actor):
     async def get_event_logs(self) -> list[str]:
         return [s.to_json() for s in self.event_logs]
 
+# -- heap profiling (role of MonitorBase::dumpHeapProfile,
+# MonitorBase.h:54 — the reference hooks jemalloc; the Python runtime's
+# native profiler is tracemalloc). Process-global, so plain functions:
+# the ctrl server serves them with or without a Monitor actor wired. ----
+
+
+def start_heap_profile(frames: int = 1) -> dict:
+    """frames > 1 multiplies tracemalloc's per-allocation overhead; the
+    dump groups by the allocation site (top frame), so 1 is the useful
+    default — pass more only when chasing a shared helper's callers."""
+    import tracemalloc
+
+    if tracemalloc.is_tracing():
+        return {"ok": True, "already_tracing": True}
+    tracemalloc.start(max(1, frames))
+    return {"ok": True, "already_tracing": False}
+
+
+async def dump_heap_profile(top: int = 25, stop: bool = False) -> dict:
+    """Top allocation sites since start_heap_profile; optionally stops
+    tracing. Snapshot + grouping walk every live trace (seconds on a
+    busy daemon), so they run on a worker thread — the control-plane
+    event loop (Spark hellos, KvStore timers) keeps running."""
+    import asyncio
+    import tracemalloc
+
+    if not tracemalloc.is_tracing():
+        return {"ok": False, "error": "not tracing — start first"}
+
+    def _collect():
+        snap = tracemalloc.take_snapshot()
+        current, peak = tracemalloc.get_traced_memory()
+        return snap.statistics("lineno")[: max(1, top)], current, peak
+
+    stats, current, peak = await asyncio.to_thread(_collect)
+    if stop:
+        tracemalloc.stop()
+    return {
+        "ok": True,
+        "traced_current_kb": round(current / 1024, 1),
+        "traced_peak_kb": round(peak / 1024, 1),
+        "top": [
+            {
+                "site": str(s.traceback[0]) if s.traceback else "?",
+                "size_kb": round(s.size / 1024, 1),
+                "count": s.count,
+            }
+            for s in stats
+        ],
+    }
+
 
 def _default_crash_handler(reason: str) -> None:
     """ref Watchdog::fireCrash — kill the process so the supervisor

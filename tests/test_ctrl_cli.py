@@ -696,3 +696,42 @@ class TestOperatorInjection:
             await client.close()
             await a.stop()
             await b.stop()
+
+
+    @run_async
+    async def test_heap_profile_rpc(self):
+        """ref MonitorBase::dumpHeapProfile: start tracing, allocate,
+        dump shows allocation sites, stop ends tracing."""
+        import tracemalloc
+
+        if tracemalloc.is_tracing():
+            import pytest
+
+            pytest.skip("tracemalloc already active (PYTHONTRACEMALLOC?)")
+        mesh, a, b = await start_two_node()
+        client = RpcClient("127.0.0.1", a.ctrl.port)
+        try:
+            dump = await client.request("monitor.heap_profile.dump")
+            assert not dump["ok"]  # not tracing yet
+            start = await client.request("monitor.heap_profile.start")
+            assert start["ok"]
+            # some allocations on the node side
+            for _ in range(3):
+                await client.request("ctrl.kvstore.dump", {"area": "0"})
+            dump = await client.request(
+                "monitor.heap_profile.dump", {"top": 5, "stop": True}
+            )
+            assert dump["ok"] and dump["top"], dump
+            assert dump["traced_peak_kb"] > 0
+            site = dump["top"][0]
+            assert site["size_kb"] >= 0 and site["count"] >= 1
+            # stopped: a second dump refuses
+            dump = await client.request("monitor.heap_profile.dump")
+            assert not dump["ok"]
+        finally:
+            # tracing is process-global — never leak it into later tests
+            if tracemalloc.is_tracing():
+                tracemalloc.stop()
+            await client.close()
+            await a.stop()
+            await b.stop()
