@@ -250,7 +250,7 @@ class CtrlServer(Actor):
             "build_python": _platform.python_version(),
         }
 
-    async def _heap_profile_start(self, frames: int = 8) -> dict:
+    async def _heap_profile_start(self, frames: int = 1) -> dict:
         """ref MonitorBase::dumpHeapProfile hook (MonitorBase.h:54);
         tracemalloc is process-global, no Monitor actor required."""
         from openr_tpu.runtime.monitor import start_heap_profile

@@ -736,3 +736,55 @@ class TestAreaImportPolicy:
             assert req.area == "area2"
             db = deserialize(req.value, PrefixDatabase)
             assert db.delete_prefix
+
+
+    @run_async
+    async def test_non_transitive_attrs_reset_on_redistribution(self):
+        """ref resetNonTransitiveAttrs (PrefixManager.cpp:1648-1658):
+        a KSP2/UCMP prefix crossing the boundary re-advertises as plain
+        IP + SP_ECMP with min_nexthop/prepend_label/weight stripped."""
+        from openr_tpu.types import (
+            PrefixForwardingAlgorithm,
+            PrefixForwardingType,
+            PrefixMetrics,
+        )
+
+        async with PmHarness(areas=("area1", "area2")) as h:
+            route = RibUnicastEntry(
+                prefix="10.55.0.0/24",
+                nexthops=frozenset(
+                    {NextHop(address="fe80::1", if_name="if0", area="area1")}
+                ),
+                best_prefix_entry=PrefixEntry(
+                    prefix="10.55.0.0/24",
+                    type=PrefixType.LOOPBACK,
+                    forwarding_type=PrefixForwardingType.SR_MPLS,
+                    forwarding_algorithm=(
+                        PrefixForwardingAlgorithm.KSP2_ED_ECMP
+                    ),
+                    min_nexthop=2,
+                    prepend_label=65001,
+                    weight=40,
+                    metrics=PrefixMetrics(distance=1),
+                    tags=("keeps-tags",),
+                ),
+                best_node_area=("other", "area1"),
+            )
+            h.fib_q.push(
+                DecisionRouteUpdate(
+                    unicast_routes_to_update={"10.55.0.0/24": route}
+                )
+            )
+            req = await h.next_req()
+            assert req.area == "area2"
+            db = deserialize(req.value, PrefixDatabase)
+            e = db.prefix_entries[0]
+            assert e.forwarding_type == PrefixForwardingType.IP
+            assert (
+                e.forwarding_algorithm == PrefixForwardingAlgorithm.SP_ECMP
+            )
+            assert e.min_nexthop is None
+            assert e.prepend_label is None
+            assert e.weight is None
+            assert e.tags == ("keeps-tags",)  # transitive: survives
+            assert e.metrics.distance == 2

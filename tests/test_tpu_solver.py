@@ -4,6 +4,8 @@ prefixState); their full RIBs must match exactly on every topology
 generator, including drained nodes, anycast selection, metric churn, and
 link flaps. Runs on the virtual-CPU JAX platform (conftest)."""
 
+import zlib
+
 import numpy as np
 import pytest
 
@@ -578,3 +580,101 @@ def test_prewarm_tool_bakes_cache(tmp_path):
         # with the conftest's disabled-cache state, not a deleted tmp dir
         for k, v in old_cfg.items():
             jax.config.update(k, v)
+
+
+# -- randomized churn soak ---------------------------------------------------
+
+def test_churn_soak_differential():
+    """Long mixed-mutation soak: random link flaps, metric changes,
+    drains, prefix adds/withdrawals (incl. UCMP and LFA) — the CPU
+    oracle and the TPU solver must agree after EVERY step. This is the
+    strongest guard against stale-cache bugs in the incremental device
+    path (plan deltas, matrix memo, KSP2 state, UCMP memos, vantage
+    output deltas all churn together)."""
+    import random
+
+    rng = random.Random(20260730)
+    adj_dbs, prefix_dbs = topologies.random_mesh(28, seed=5)
+    states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    ls = states["0"]
+    names = [db.this_node_name for db in adj_dbs]
+    by_name = {db.this_node_name: db for db in adj_dbs}
+    me = "node-0"
+    cpu = SpfSolver(me, enable_ucmp=True, enable_lfa=True)
+    tpu = TpuSpfSolver(me, enable_ucmp=True, enable_lfa=True)
+
+    def mutate(step):
+        kind = rng.randrange(5)
+        victim = rng.choice(names[1:])  # never isolate the vantage
+        db = by_name[victim]
+        if kind == 0:  # flap down
+            ls.update_adjacency_database(
+                AdjacencyDatabase(
+                    this_node_name=victim, adjacencies=(), area="0"
+                )
+            )
+        elif kind == 1:  # restore / metric churn
+            ls.update_adjacency_database(
+                AdjacencyDatabase(
+                    this_node_name=victim,
+                    adjacencies=tuple(
+                        Adjacency(
+                            **{
+                                **a.__dict__,
+                                # crc32, not hash(): PYTHONHASHSEED must
+                                # not change the replayed sequence
+                                "metric": 1
+                                + (
+                                    step
+                                    + zlib.crc32(
+                                        a.other_node_name.encode()
+                                    )
+                                )
+                                % 9,
+                            }
+                        )
+                        for a in db.adjacencies
+                    ),
+                    area="0",
+                )
+            )
+        elif kind == 2:  # drain toggle
+            ls.update_adjacency_database(
+                AdjacencyDatabase(
+                    this_node_name=victim,
+                    adjacencies=db.adjacencies,
+                    is_overloaded=(step % 2 == 0),
+                    area="0",
+                )
+            )
+        elif kind == 3:  # anycast UCMP prefix add
+            algo = rng.choice(
+                [
+                    PrefixForwardingAlgorithm.SP_ECMP,
+                    PrefixForwardingAlgorithm.SP_UCMP_PREFIX_WEIGHT_PROPAGATION,
+                    PrefixForwardingAlgorithm.SP_UCMP_ADJ_WEIGHT_PROPAGATION,
+                ]
+            )
+            for node in rng.sample(names[1:], 3):
+                ps.update_prefix_database(
+                    prefix_db(
+                        node,
+                        f"fd00:5{step % 8}::/64",
+                        forwarding_algorithm=algo,
+                        weight=rng.randrange(1, 9),
+                    )
+                )
+        else:  # withdraw
+            node = rng.choice(names[1:])
+            ps.update_prefix_database(
+                prefix_db(node, f"fd00:5{step % 8}::/64", delete=True)
+            )
+
+    for step in range(30):
+        mutate(step)
+        cpu_db = cpu.build_route_db(me, states, ps)
+        tpu_db = tpu.build_route_db(me, states, ps)
+        if cpu_db is None:
+            assert tpu_db is None
+            continue
+        assert_rib_equal(cpu_db, tpu_db, f"soak step {step}")
