@@ -772,6 +772,9 @@ def tech_support(ctx) -> None:
         ("LINKS", "ctrl.lm.links", {}),
         ("NEIGHBORS", "ctrl.spark.neighbors", {}),
         ("ADVERTISED PREFIXES", "ctrl.prefixmgr.advertised", {}),
+        ("DECISION VALIDATE", "ctrl.decision.validate", {}),
+        ("FIB VALIDATE", "ctrl.fib.validate", {}),
+        ("SUBSCRIBERS", "ctrl.subscriber_info", {}),
         ("COUNTERS", "monitor.counters", {}),
     ]:
         click.echo(f"\n==== {title} ====")
