@@ -347,11 +347,9 @@ def long_poll_adj(ctx, area, snapshot, timeout) -> None:
     )
 
 
-@openr.command("config")
-@click.pass_context
-def running_config(ctx) -> None:
-    """The node's running config (ref getRunningConfig)."""
-    _print(_call(ctx, "ctrl.config.get"))
+# `openr config` / `openr dryrun-config` alias the config group's
+# show/dryrun — one implementation, two spellings (the reference keeps
+# config under its own group; the openr group spelling predates ours)
 
 
 @openr.command("drain-state")
@@ -359,16 +357,6 @@ def running_config(ctx) -> None:
 def drain_state(ctx) -> None:
     """Node drain + per-link overrides (ref getDrainState)."""
     _print(_call(ctx, "openr.drain_state"))
-
-
-@openr.command("dryrun-config")
-@click.argument("config_file", type=click.Path(exists=True))
-@click.pass_context
-def dryrun_config(ctx, config_file) -> None:
-    """Validate a config file against the running node's parser."""
-    with open(config_file) as fh:
-        payload = json.load(fh)
-    _print(_call(ctx, "ctrl.config.dryrun", {"config": payload}))
 
 
 # -- decision ---------------------------------------------------------------
@@ -707,6 +695,106 @@ def pm_sync_by_type(ctx, prefix_type, prefixes) -> None:
 def pm_originated(ctx) -> None:
     """Config-originated supernodes (ref getOriginatedPrefixes)."""
     _print(_call(ctx, "ctrl.prefixmgr.originated"))
+
+
+# -- config -----------------------------------------------------------------
+
+@cli.group("config")
+def config_group() -> None:
+    """Running config + persistent store (ref breeze config)."""
+
+
+@config_group.command("show")
+@click.pass_context
+def config_show(ctx) -> None:
+    """The node's running config (ref getRunningConfig)."""
+    _print(_call(ctx, "ctrl.config.get"))
+
+
+@config_group.command("dryrun")
+@click.argument("config_file", type=click.Path(exists=True))
+@click.pass_context
+def config_dryrun(ctx, config_file) -> None:
+    """Validate a config file against the live daemon's schema."""
+    with open(config_file) as f:
+        payload = json.load(f)
+    _print(_call(ctx, "ctrl.config.dryrun", {"config": payload}))
+
+
+@config_group.command("compare")
+@click.argument("config_file", type=click.Path(exists=True))
+@click.pass_context
+def config_compare(ctx, config_file) -> None:
+    """Diff the running config against a file (ref breeze config
+    compare): both sides normalize through the daemon's parser, so
+    defaults don't show as differences. Exit 1 = configs differ;
+    exit 2 = could not compare (invalid file / node has no config)."""
+    with open(config_file) as f:
+        payload = json.load(f)
+    parsed = _call(ctx, "ctrl.config.dryrun", {"config": payload})
+    if not parsed.get("ok"):
+        raise click.UsageError(f"file invalid: {parsed.get('error')}")
+    running = _call(ctx, "ctrl.config.get")
+    if not running:
+        raise click.UsageError(
+            "node has no running config to compare against"
+        )
+    candidate = parsed["config"]
+
+    def walk(a, b, path=""):
+        if isinstance(a, dict) and isinstance(b, dict):
+            diffs = []
+            for k in sorted(set(a) | set(b)):
+                diffs += walk(a.get(k), b.get(k), f"{path}.{k}" if path else k)
+            return diffs
+        # dict-vs-null (optional sections) and every scalar/list case
+        return [] if a == b else [{"key": path, "running": a, "file": b}]
+
+    diffs = walk(running, candidate)
+    _print({"differences": diffs, "ok": not diffs})
+    if diffs:
+        raise SystemExit(1)
+
+
+@config_group.command("store")
+@click.argument("key", required=False)
+@click.pass_context
+def config_store(ctx, key) -> None:
+    """Read the persistent store (ref breeze config store): pass
+    nothing for the full inventory (daemon drain/override/policy state
+    + ctrl: operator keys), or a key exactly as the inventory prints
+    it."""
+    dump = _call(ctx, "ctrl.store.dump")
+    if key:
+        if key not in dump:
+            raise click.ClickException(
+                f"{key!r} not in the store (have: {sorted(dump)})"
+            )
+        _print({key: dump[key]})
+        return
+    _print(dump)
+
+
+@config_group.command("set")
+@click.argument("key")
+@click.argument("value")
+@click.pass_context
+def config_set(ctx, key, value) -> None:
+    """Write a persistent-store key (ref setConfigKey)."""
+    _print(_call(ctx, "ctrl.store.set", {"key": key, "value": value}))
+
+
+@config_group.command("erase")
+@click.argument("key")
+@click.pass_context
+def config_erase(ctx, key) -> None:
+    """Erase a persistent-store key (ref eraseConfigKey)."""
+    _print(_call(ctx, "ctrl.store.erase", {"key": key}))
+
+
+# the historical spellings stay as aliases of the same commands
+openr.add_command(config_show, name="config")
+openr.add_command(config_dryrun, name="dryrun-config")
 
 
 # -- monitor ----------------------------------------------------------------

@@ -83,6 +83,7 @@ class CtrlServer(Actor):
         s.register("ctrl.store.set", self._store_set)
         s.register("ctrl.store.get", self._store_get)
         s.register("ctrl.store.erase", self._store_erase)
+        s.register("ctrl.store.dump", self._store_dump)
         if self.kvstore is not None:
             s.register("ctrl.kvstore.keyvals", self._kv_get)
             s.register("ctrl.kvstore.dump", self._kv_dump)
@@ -289,6 +290,20 @@ class CtrlServer(Actor):
         if self.persistent_store is None:
             raise RuntimeError("no persistent store configured")
         return {"erased": self.persistent_store.erase(f"ctrl:{key}")}
+
+    async def _store_dump(self) -> dict:
+        """Read-only inventory of EVERY persistent-store key — daemon
+        state (link-monitor drain/overrides, rib-policy, allocator
+        index) and ctrl:-namespaced operator keys — with sizes and a
+        best-effort text preview (values may be binary serde)."""
+        if self.persistent_store is None:
+            raise RuntimeError("no persistent store configured")
+        out = {}
+        for key in sorted(self.persistent_store.keys()):
+            raw = self.persistent_store.load(key) or b""
+            preview = raw[:200].decode("utf-8", errors="replace")
+            out[key] = {"bytes": len(raw), "preview": preview}
+        return out
 
     # -- kvstore -----------------------------------------------------------
 

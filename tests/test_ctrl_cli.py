@@ -14,11 +14,31 @@ from openr_tpu.spark import MockIoMesh
 from tests.conftest import run_async
 
 
+import itertools as _itertools
+import os as _os
+import tempfile as _tempfile
+
+# auto-removed at interpreter exit — per-test store files live inside
+_STORE_TD = _tempfile.TemporaryDirectory(prefix="orctl-stores-")
+_STORE_SEQ = _itertools.count()
+
+
 async def start_two_node(enable_ctrl=True):
+    from openr_tpu.config import Config, OpenrConfig
+    from openr_tpu.runtime.persistent_store import PersistentStore
+
     mesh = MockIoMesh()
     kv_ports = {}
-    a = OpenrWrapper("node-a", mesh.provider("node-a"), kv_ports,
-                     enable_ctrl=enable_ctrl)
+    a = OpenrWrapper(
+        "node-a", mesh.provider("node-a"), kv_ports,
+        enable_ctrl=enable_ctrl,
+        running_config=Config(OpenrConfig(node_name="node-a")),
+        persistent_store=PersistentStore(
+            _os.path.join(
+                _STORE_TD.name, f"store-{next(_STORE_SEQ)}.bin"
+            )
+        ),
+    )
     b = OpenrWrapper("node-b", mesh.provider("node-b"), kv_ports,
                      enable_ctrl=enable_ctrl)
     mesh.connect("node-a", "if-ab", "node-b", "if-ba")
@@ -388,6 +408,59 @@ class TestBreezeCli:
             res = runner.invoke(cli, base + ["kvstore", "nodes"], obj={})
             assert res.exit_code == 0, res.output
             assert "node-b" in res.output
+
+            # config group (ref breeze config show/store/set/erase/compare)
+            res = runner.invoke(cli, base + ["config", "show"], obj={})
+            assert res.exit_code == 0 and "node_name" in res.output
+            res = runner.invoke(
+                cli, base + ["config", "set", "op:test", "v1"], obj={}
+            )
+            assert res.exit_code == 0, res.output
+            # single-key lookup uses the key exactly as the inventory
+            # prints it (operator keys live under the ctrl: namespace)
+            res = runner.invoke(
+                cli, base + ["config", "store", "ctrl:op:test"], obj={}
+            )
+            assert res.exit_code == 0 and "v1" in res.output
+            res = runner.invoke(
+                cli, base + ["config", "store", "no-such-key"], obj={}
+            )
+            assert res.exit_code == 1 and "not in the store" in res.output
+            res = runner.invoke(
+                cli, base + ["config", "erase", "op:test"], obj={}
+            )
+            assert res.exit_code == 0, res.output
+            import json as _json
+            import os as _os
+            import tempfile
+
+            running = _json.loads(
+                runner.invoke(
+                    cli, base + ["config", "show"], obj={}
+                ).output
+            )
+            with tempfile.TemporaryDirectory() as td:
+                same = _os.path.join(td, "same.json")
+                with open(same, "w") as f:
+                    _json.dump(running, f)
+                res = runner.invoke(
+                    cli, base + ["config", "compare", same], obj={}
+                )
+                assert res.exit_code == 0, res.output
+                running["domain"] = "other-domain"
+                diff = _os.path.join(td, "diff.json")
+                with open(diff, "w") as f:
+                    _json.dump(running, f)
+                res = runner.invoke(
+                    cli, base + ["config", "compare", diff], obj={}
+                )
+                assert (
+                    res.exit_code == 1 and "other-domain" in res.output
+                )
+
+            # store inventory shows daemon + operator keys
+            res = runner.invoke(cli, base + ["config", "store"], obj={})
+            assert res.exit_code == 0, res.output
 
             res = runner.invoke(
                 cli,
