@@ -763,14 +763,20 @@ def config_store(ctx, key) -> None:
     """Read the persistent store (ref breeze config store): pass
     nothing for the full inventory (daemon drain/override/policy state
     + ctrl: operator keys), or a key exactly as the inventory prints
-    it."""
+    it. Operator (ctrl:) keys print their FULL value; daemon keys show
+    size + a text preview (their values are binary serde)."""
     dump = _call(ctx, "ctrl.store.dump")
     if key:
         if key not in dump:
             raise click.ClickException(
                 f"{key!r} not in the store (have: {sorted(dump)})"
             )
-        _print({key: dump[key]})
+        entry = dict(dump[key])
+        if key.startswith("ctrl:"):
+            entry["value"] = _call(
+                ctx, "ctrl.store.get", {"key": key[len("ctrl:"):]}
+            )
+        _print({key: entry})
         return
     _print(dump)
 
@@ -816,6 +822,15 @@ def counters(ctx, prefix) -> None:
 def event_logs(ctx) -> None:
     """Sampled event logs (ref getEventLogs)."""
     _print(_call(ctx, "monitor.event_logs"))
+
+
+@monitor.command("statistics")
+@click.option("--prefix", default="")
+@click.pass_context
+def statistics(ctx, prefix) -> None:
+    """Multi-window stat view (ref breeze monitor statistics):
+    count/sum/avg/max over 60/600/3600 s per recorded stat."""
+    _print(_call(ctx, "monitor.statistics", {"prefix": prefix}))
 
 
 @monitor.command("heap-profile")

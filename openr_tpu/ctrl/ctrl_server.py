@@ -77,6 +77,7 @@ class CtrlServer(Actor):
         s.register("openr.my_node_name", self._my_node_name)
         s.register("openr.build_info", self._build_info)
         s.register("monitor.counters", self._counters)
+        s.register("monitor.statistics", self._statistics)
         s.register("monitor.event_logs", self._event_logs)
         s.register("monitor.heap_profile.start", self._heap_profile_start)
         s.register("monitor.heap_profile.dump", self._heap_profile_dump)
@@ -204,6 +205,10 @@ class CtrlServer(Actor):
 
     async def _counters(self, prefix: str = "") -> dict:
         return counters.get_counters(prefix)
+
+    async def _statistics(self, prefix: str = "") -> dict:
+        """ref breeze monitor statistics: multi-window stat view."""
+        return counters.get_statistics(prefix)
 
     async def _watch_initialization(self, queue: ReplicateQueue) -> None:
         reader = queue.get_reader(f"{self.name}.init")

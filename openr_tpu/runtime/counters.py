@@ -36,6 +36,41 @@ class _Stat:
             "max": max(vals) if vals else 0.0,
         }
 
+    def multi_windowed(self, windows: tuple) -> dict:
+        """One pass over the ring bucketing every sample into each
+        window it falls in (60s samples are a subset of 600s etc.).
+        A window is marked truncated when the ring's eviction horizon
+        is newer than its cutoff — the ring holds the 4096 most-recent
+        samples, so a high-rate stat cannot honor long windows and must
+        SAY so rather than silently undercount."""
+        now = time.monotonic()
+        # ascending cutoff = largest window first; once a sample is too
+        # old for a window it is too old for every smaller one -> break
+        cutoffs = sorted((now - w, w) for w in windows)
+        acc = {
+            w: {"count": 0, "sum": 0.0, "max": 0.0} for _, w in cutoffs
+        }
+        for ts, v in self.samples:
+            for cutoff, w in cutoffs:
+                if ts < cutoff:
+                    break
+                a = acc[w]
+                a["count"] += 1
+                a["sum"] += v
+                if v > a["max"]:
+                    a["max"] = v
+        full = len(self.samples) == self.samples.maxlen
+        oldest = self.samples[0][0] if self.samples else now
+        out = {}
+        for cutoff, w in cutoffs:
+            a = acc[w]
+            out[str(int(w))] = {
+                **a,
+                "avg": (a["sum"] / a["count"]) if a["count"] else 0.0,
+                "truncated": full and oldest > cutoff,
+            }
+        return out
+
 
 class CounterRegistry:
     def __init__(self):
@@ -60,6 +95,20 @@ class CounterRegistry:
 
     def get_counter(self, key: str) -> Optional[float]:
         return self._counters.get(key)
+
+    def get_statistics(
+        self, prefix: str = "", windows: tuple = (60.0, 600.0, 3600.0)
+    ) -> dict[str, dict]:
+        """fb303-style multi-window stat view (ref breeze monitor
+        statistics): per stat key, count/sum/avg/max over each window,
+        single pass per stat (the registry lock blocks hot-path
+        increments while held)."""
+        with self._lock:
+            return {
+                k: st.multi_windowed(windows)
+                for k, st in self._stats.items()
+                if k.startswith(prefix)
+            }
 
     def get_counters(self, prefix: str = "") -> dict[str, float]:
         with self._lock:

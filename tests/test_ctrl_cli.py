@@ -808,3 +808,47 @@ class TestOperatorInjection:
             await client.close()
             await a.stop()
             await b.stop()
+
+
+    @run_async
+    async def test_monitor_statistics_rpc(self):
+        """ref breeze monitor statistics: windowed stat views for the
+        stats the daemon records (spf/build/convergence timings)."""
+        mesh, a, b = await start_two_node()
+        client = RpcClient("127.0.0.1", a.ctrl.port)
+        try:
+            stats = await client.request("monitor.statistics")
+            assert "decision.route_build_ms" in stats, sorted(stats)
+            w60 = stats["decision.route_build_ms"]["60"]
+            assert w60["count"] >= 1 and w60["max"] >= 0.0
+            only = await client.request(
+                "monitor.statistics", {"prefix": "fib."}
+            )
+            assert all(k.startswith("fib.") for k in only)
+        finally:
+            await client.close()
+            await a.stop()
+            await b.stop()
+
+
+    @run_async
+    async def test_config_store_full_value_roundtrip(self):
+        """Operator keys print their FULL value (not just the 200-byte
+        preview) through the single-key path."""
+        mesh, a, b = await start_two_node()
+        client = RpcClient("127.0.0.1", a.ctrl.port)
+        try:
+            big = "x" * 300
+            await client.request(
+                "ctrl.store.set", {"key": "op:big", "value": big}
+            )
+            dump = await client.request("ctrl.store.dump")
+            assert dump["ctrl:op:big"]["bytes"] == 300
+            full = await client.request(
+                "ctrl.store.get", {"key": "op:big"}
+            )
+            assert full == big
+        finally:
+            await client.close()
+            await a.stop()
+            await b.stop()
