@@ -43,33 +43,41 @@ class _Stat:
         is newer than its cutoff — the ring holds the 4096 most-recent
         samples, so a high-rate stat cannot honor long windows and must
         SAY so rather than silently undercount."""
-        now = time.monotonic()
-        # ascending cutoff = largest window first; once a sample is too
-        # old for a window it is too old for every smaller one -> break
-        cutoffs = sorted((now - w, w) for w in windows)
-        acc = {
-            w: {"count": 0, "sum": 0.0, "max": 0.0} for _, w in cutoffs
-        }
-        for ts, v in self.samples:
-            for cutoff, w in cutoffs:
-                if ts < cutoff:
-                    break
-                a = acc[w]
-                a["count"] += 1
-                a["sum"] += v
-                if v > a["max"]:
-                    a["max"] = v
-        full = len(self.samples) == self.samples.maxlen
-        oldest = self.samples[0][0] if self.samples else now
-        out = {}
+        return _aggregate_windows(
+            list(self.samples), self.samples.maxlen, windows
+        )
+
+
+def _aggregate_windows(samples: list, maxlen: int, windows: tuple) -> dict:
+    now = time.monotonic()
+    # ascending cutoff = largest window first; once a sample is too
+    # old for a window it is too old for every smaller one -> break
+    cutoffs = sorted((now - w, w) for w in windows)
+    acc = {w: {"count": 0, "sum": 0.0, "max": None} for _, w in cutoffs}
+    for ts, v in samples:
         for cutoff, w in cutoffs:
+            if ts < cutoff:
+                break
             a = acc[w]
-            out[str(int(w))] = {
-                **a,
-                "avg": (a["sum"] / a["count"]) if a["count"] else 0.0,
-                "truncated": full and oldest > cutoff,
-            }
-        return out
+            a["count"] += 1
+            a["sum"] += v
+            if a["max"] is None or v > a["max"]:
+                a["max"] = v
+    full = len(samples) == maxlen
+    oldest = samples[0][0] if samples else now
+    out = {}
+    for cutoff, w in cutoffs:
+        a = acc[w]
+        out[str(int(w))] = {
+            "count": a["count"],
+            "sum": a["sum"],
+            # empty window reports 0.0 (matches windowed()); a window
+            # of negative samples reports its true maximum
+            "max": a["max"] if a["max"] is not None else 0.0,
+            "avg": (a["sum"] / a["count"]) if a["count"] else 0.0,
+            "truncated": full and oldest > cutoff,
+        }
+    return out
 
 
 class CounterRegistry:
@@ -100,15 +108,20 @@ class CounterRegistry:
         self, prefix: str = "", windows: tuple = (60.0, 600.0, 3600.0)
     ) -> dict[str, dict]:
         """fb303-style multi-window stat view (ref breeze monitor
-        statistics): per stat key, count/sum/avg/max over each window,
-        single pass per stat (the registry lock blocks hot-path
-        increments while held)."""
+        statistics): per stat key, count/sum/avg/max over each window.
+        Only the sample-ring snapshot happens under the registry lock —
+        the aggregation runs outside it, so a statistics poll can't
+        stall hot-path add_stat_value/increment calls mid-SPF."""
         with self._lock:
-            return {
-                k: st.multi_windowed(windows)
+            snap = {
+                k: (list(st.samples), st.samples.maxlen)
                 for k, st in self._stats.items()
                 if k.startswith(prefix)
             }
+        return {
+            k: _aggregate_windows(samples, maxlen, windows)
+            for k, (samples, maxlen) in snap.items()
+        }
 
     def get_counters(self, prefix: str = "") -> dict[str, float]:
         with self._lock:

@@ -834,7 +834,13 @@ class TestOperatorInjection:
     @run_async
     async def test_config_store_full_value_roundtrip(self):
         """Operator keys print their FULL value (not just the 200-byte
-        preview) through the single-key path."""
+        preview) through the breeze single-key path."""
+        import threading as _threading
+
+        from click.testing import CliRunner
+
+        from openr_tpu.cli.breeze import cli
+
         mesh, a, b = await start_two_node()
         client = RpcClient("127.0.0.1", a.ctrl.port)
         try:
@@ -844,10 +850,27 @@ class TestOperatorInjection:
             )
             dump = await client.request("ctrl.store.dump")
             assert dump["ctrl:op:big"]["bytes"] == 300
-            full = await client.request(
-                "ctrl.store.get", {"key": "op:big"}
-            )
-            assert full == big
+
+            # drive the actual CLI branch (ctrl: prefix strip + value
+            # merge) from a thread — the CLI owns its own event loop
+            result = {}
+
+            def run_cli():
+                runner = CliRunner()
+                result["res"] = runner.invoke(
+                    cli,
+                    ["--port", str(a.ctrl.port), "config", "store",
+                     "ctrl:op:big"],
+                    obj={},
+                )
+
+            t = _threading.Thread(target=run_cli)
+            t.start()
+            while t.is_alive():
+                await asyncio.sleep(0.02)
+            res = result["res"]
+            assert res.exit_code == 0, res.output
+            assert big in res.output  # the full 300-char value, merged
         finally:
             await client.close()
             await a.stop()

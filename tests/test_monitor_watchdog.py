@@ -111,3 +111,27 @@ class TestWatchdog:
             )
         finally:
             await wd.stop()
+
+
+def test_stat_multi_windowed_single_pass():
+    """fb303-style multi-window view: nesting (60 within 600 within
+    3600), exact aggregates, and the truncation flag when the sample
+    ring cannot honor a long window."""
+    from openr_tpu.runtime.counters import _Stat
+
+    s = _Stat()
+    for i in range(10):
+        s.add(float(i))
+    out = s.multi_windowed((60.0, 600.0, 3600.0))
+    for w in ("60", "600", "3600"):
+        assert out[w]["count"] == 10
+        assert out[w]["max"] == 9.0
+        assert abs(out[w]["avg"] - 4.5) < 1e-9
+        assert out[w]["truncated"] is False
+    # overflow the ring: long windows flag truncation, a tiny window
+    # (whose cutoff is newer than the eviction horizon) does not
+    for _ in range(5000):
+        s.add(1.0)
+    out = s.multi_windowed((0.0, 3600.0))
+    assert out["3600"]["truncated"] is True
+    assert out["3600"]["count"] == 4096  # ring capacity, not a lie
