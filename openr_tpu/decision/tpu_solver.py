@@ -1197,6 +1197,11 @@ class TpuSpfSolver:
             plan.dirty_shift = []
             plan.dirty_res = []
             plan.dirty_res_nbr = False
+            # first churn after a cold build must not pay the edge
+            # locator build inside its convergence window
+            from openr_tpu.ops.edgeplan import prewarm_edge_loc
+
+            prewarm_edge_loc(plan)
         else:
             (s_idx, s_val), (r_idx, r_val), nbr_changed = drain_dirty(plan)
             scatter = _scatter_jit()
@@ -1435,7 +1440,7 @@ class TpuSpfSolver:
         openr/decision/LinkState.cpp:790-819."""
         import time as _time
 
-        from openr_tpu.ops.edgeplan import _ensure_edge_loc
+        from openr_tpu.ops.edgeplan import _ensure_edge_loc, edge_loc_of
         from openr_tpu.ops.ksp2 import (
             MaskedRowsState,
             base_dist,
@@ -1461,7 +1466,7 @@ class TpuSpfSolver:
         _t0 = _time.perf_counter()
         ad = self._sync_area(area, link_state, prefix_state, fast)
         plan = ad.plan
-        edge_loc = _ensure_edge_loc(plan)
+        _ensure_edge_loc(plan)
         root_idx = plan.node_index[my_node_name]
         node_index = plan.node_index
 
@@ -1477,7 +1482,7 @@ class TpuSpfSolver:
                 if not link.is_up():
                     continue
                 w = min(link.metric_from_node(my_node_name), 1 << 28)
-                kind, a, b = edge_loc[(link, my_node_name)]
+                kind, a, b = edge_loc_of(plan, link, my_node_name)
                 if kind == "s":
                     sw[a, b] = w
                 else:
@@ -1592,8 +1597,8 @@ class TpuSpfSolver:
             ignore = link_state.kth_paths_ignore_set(my_node_name, dest, 2)
             locs = []
             for link in ignore:
-                locs.append(edge_loc[(link, link.n1)])
-                locs.append(edge_loc[(link, link.n2)])
+                locs.append(edge_loc_of(plan, link, link.n1))
+                locs.append(edge_loc_of(plan, link, link.n2))
             jobs.append((dest, ignore, locs, c, reads1, paths1))
         _t2 = _time.perf_counter()
         if not jobs:

@@ -42,6 +42,7 @@ loop runs on.
 from __future__ import annotations
 
 import re
+import threading
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -95,10 +96,14 @@ class EdgePlan:
     node_overloaded: np.ndarray  # bool [n_cap]
     node_names: list
     node_index: dict
-    # (link_key, src_name) -> ("s", k, u_idx) | ("r", row, col).
-    # Built LAZILY from the compact location arrays below on the first
-    # delta application (cold full builds never pay the 2E-entry dict)
+    # link -> [loc_from_n1, loc_from_n2] with loc =
+    # ("s", k, u_idx) | ("r", row, col) | None. Built LAZILY from the
+    # compact location arrays below on the first delta application —
+    # or by the solver's background prewarm thread right after a cold
+    # build (guarded by _loc_lock), so the first churn doesn't pay the
+    # E-entry dict on the convergence critical path
     edge_loc: Optional[dict] = None
+    _loc_lock: object = field(default_factory=threading.Lock)
     # per-directed-edge slot locations, aligned with _links_sorted order
     # (edge 2i = links[i].n1 -> n2, edge 2i+1 the reverse)
     _links_sorted: list = field(default_factory=list)
@@ -148,25 +153,46 @@ def _effective_w(link: Link, src: str, overloaded_src: bool) -> int:
 
 
 def _ensure_edge_loc(plan: EdgePlan) -> dict:
-    """Materialize the (link, src_name) -> slot-location dict from the
-    compact per-edge arrays. Deferred so cold full builds skip it; the
-    first apply_events call pays it once per rebuild."""
-    if plan.edge_loc is None:
-        kinds = plan._loc_kind.tolist()
-        las = plan._loc_a.tolist()
-        lbs = plan._loc_b.tolist()
-        d = {}
-        for i, link in enumerate(plan._links_sorted):
-            e = 2 * i
-            d[(link, link.n1)] = (
-                ("s", las[e], lbs[e]) if kinds[e] == 0 else ("r", las[e], lbs[e])
-            )
-            e += 1
-            d[(link, link.n2)] = (
-                ("s", las[e], lbs[e]) if kinds[e] == 0 else ("r", las[e], lbs[e])
-            )
-        plan.edge_loc = d
+    """Materialize the link -> [loc_n1, loc_n2] slot-location dict from
+    the compact per-edge arrays. Deferred so cold full builds skip it;
+    the first apply_events call — or the solver's post-build prewarm
+    thread, whichever comes first — pays it once per rebuild (the lock
+    keeps the two from interleaving a build with mutations)."""
+    with plan._loc_lock:
+        if plan.edge_loc is None:
+            kinds = plan._loc_kind.tolist()
+            las = plan._loc_a.tolist()
+            lbs = plan._loc_b.tolist()
+            kk = ("s", "r")
+            d = {}
+            for i, link in enumerate(plan._links_sorted):
+                e = 2 * i
+                d[link] = [
+                    (kk[kinds[e]], las[e], lbs[e]),
+                    (kk[kinds[e + 1]], las[e + 1], lbs[e + 1]),
+                ]
+            plan.edge_loc = d
     return plan.edge_loc
+
+
+def prewarm_edge_loc(plan: EdgePlan) -> None:
+    """Build the edge locator on a background thread so the first churn
+    after a cold build doesn't pay the E-entry dict (~430 ms at 77k
+    links) inside its convergence window. Safe against an early churn:
+    _ensure_edge_loc's lock serializes the two builders, and whichever
+    runs second finds the dict already present."""
+    threading.Thread(
+        target=_ensure_edge_loc, args=(plan,), daemon=True,
+        name="edge-loc-prewarm",
+    ).start()
+
+
+def edge_loc_of(plan: EdgePlan, link: Link, src_name: str):
+    """The directed edge (link, src_name)'s slot location, or None."""
+    entry = plan.edge_loc.get(link)
+    if entry is None:
+        return None
+    return entry[0 if src_name == link.n1 else 1]
 
 
 def build_plan(
@@ -338,7 +364,7 @@ def build_plan(
 
 
 def _set_edge_w(plan: EdgePlan, link: Link, src_name: str, w: int) -> None:
-    loc = plan.edge_loc.get((link, src_name))
+    loc = edge_loc_of(plan, link, src_name)
     if loc is None:
         plan.needs_rebuild = True
         return
@@ -366,8 +392,10 @@ def _refresh_link(plan: EdgePlan, link: Link) -> None:
 
 
 def _add_link(plan: EdgePlan, link: Link) -> None:
-    for src_name, dst_name in ((link.n1, link.n2), (link.n2, link.n1)):
-        if (link, src_name) in plan.edge_loc:
+    for idx, (src_name, dst_name) in enumerate(
+        ((link.n1, link.n2), (link.n2, link.n1))
+    ):
+        if edge_loc_of(plan, link, src_name) is not None:
             _refresh_link(plan, link)
             continue
         u = plan.node_index.get(src_name)
@@ -386,7 +414,9 @@ def _add_link(plan: EdgePlan, link: Link) -> None:
                 if d == 0:
                     break
                 plan._shift_occ[k, u] = True
-                plan.edge_loc[(link, src_name)] = ("s", k, u)
+                plan.edge_loc.setdefault(link, [None, None])[idx] = (
+                    "s", k, u,
+                )
                 _set_edge_w(plan, link, src_name, w)
                 placed = True
                 break
@@ -409,7 +439,7 @@ def _add_link(plan: EdgePlan, link: Link) -> None:
         plan.res_nbr[row, col] = u
         plan.res_w[row, col] = w
         plan.k_res = max(plan.k_res, col + 1)
-        plan.edge_loc[(link, src_name)] = ("r", row, col)
+        plan.edge_loc.setdefault(link, [None, None])[idx] = ("r", row, col)
         plan.dirty_res.append((row, col, w))
         # res_nbr/res_rows changed too — consumer re-uploads those arrays
         plan.dirty_res_nbr = True

@@ -835,10 +835,6 @@ class TestOperatorInjection:
     async def test_config_store_full_value_roundtrip(self):
         """Operator keys print their FULL value (not just the 200-byte
         preview) through the breeze single-key path."""
-        import threading as _threading
-
-        from click.testing import CliRunner
-
         from openr_tpu.cli.breeze import cli
 
         mesh, a, b = await start_two_node()
@@ -864,7 +860,7 @@ class TestOperatorInjection:
                     obj={},
                 )
 
-            t = _threading.Thread(target=run_cli)
+            t = threading.Thread(target=run_cli)
             t.start()
             while t.is_alive():
                 await asyncio.sleep(0.02)
