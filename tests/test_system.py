@@ -247,3 +247,84 @@ class TestMultiAreaRedistribution:
             )
         finally:
             await stop_all({"l": left, "c": center, "r": right})
+
+
+class TestRingPartitionSoak:
+    """Randomized partition/heal soak on a 6-node ring (ref
+    OpenrSystemTest RingTopology tests, scaled): every round cuts one
+    ring link, asserts traffic reroutes the long way for every
+    affected loopback, heals it, and asserts the short paths return.
+    Exercises Spark hold-timer loss detection, KvStore re-peering +
+    full sync after heal, and Decision/Fib reconvergence repeatedly in
+    one process."""
+
+    @run_async
+    async def test_partition_heal_rounds(self):
+        import random
+
+        rng = random.Random(7)
+        n = 6
+        names = [f"node-{i}" for i in range(n)]
+        links = [
+            (
+                names[i], f"if-{i}{(i + 1) % n}",
+                names[(i + 1) % n], f"if-{(i + 1) % n}{i}",
+            )
+            for i in range(n)
+        ]
+        mesh, nodes = await start_mesh(names, links)
+        try:
+            for i, name in enumerate(names):
+                nodes[name].advertise_prefix(loopback(i))
+
+            def all_reach_all():
+                return all(
+                    loopback(j) in nodes[nm].fib_routes
+                    for nm in names
+                    for j in range(n)
+                    if names[j] != nm
+                )
+
+            await wait_until(all_reach_all, timeout_s=CONVERGENCE_S)
+
+            for round_no in range(3):
+                i = rng.randrange(n)
+                a, if_a, b, if_b = links[i]
+                lb_a, lb_b = loopback(i), loopback((i + 1) % n)
+                mesh.disconnect(a, if_a, b, if_b)
+
+                # first wait for the loss to be DETECTED ON BOTH SIDES
+                # (stale direct routes satisfy reachability until the
+                # hold timer fires): each endpoint must reroute the
+                # other's loopback away from the cut link
+                def rerouted(src, dst, lb):
+                    e = nodes[src].fib_routes.get(lb)
+                    return e is not None and all(
+                        nh.neighbor_node_name != dst for nh in e.nexthops
+                    )
+
+                await wait_until(
+                    lambda: rerouted(a, b, lb_b) and rerouted(b, a, lb_a),
+                    timeout_s=CONVERGENCE_S,
+                )
+                # the ring minus one link is a line: everyone still
+                # reaches everyone, now the long way around
+                await wait_until(all_reach_all, timeout_s=CONVERGENCE_S)
+
+                mesh.connect(a, if_a, b, if_b)
+                # heal: the direct adjacency must come back and win
+                # again on both sides
+                def direct_again(src, dst, lb):
+                    e = nodes[src].fib_routes.get(lb)
+                    return e is not None and {
+                        nh.neighbor_node_name for nh in e.nexthops
+                    } == {dst}
+
+                await wait_until(
+                    lambda: direct_again(a, b, lb_b)
+                    and direct_again(b, a, lb_a),
+                    timeout_s=CONVERGENCE_S,
+                )
+                await wait_until(all_reach_all, timeout_s=CONVERGENCE_S)
+        finally:
+            await stop_all(nodes)
