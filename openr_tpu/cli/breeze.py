@@ -257,8 +257,11 @@ def kv_snoop(ctx, area, duration, no_snapshot) -> None:
 @click.pass_context
 def kv_compare(ctx, nodes, peer_names, area) -> None:
     """Diff this node's store against other nodes' (ref breeze kvstore
-    kv-compare): missing keys and (version, originator) divergence.
-    Exit code 1 on any delta."""
+    kv-compare): missing keys and per-key divergence over (version,
+    originator, ttl_version, value hash) — two stores that agree on
+    version+originator can still hold different payloads after a
+    partition heal, and a ttl_version skew means refreshes are not
+    propagating. Exit code 1 on any delta."""
     specs = [s.strip() for s in nodes.split(",") if s.strip()]
     pins = [p.strip() for p in peer_names.split(",")] if peer_names else []
     if pins and len(pins) != len(specs):
@@ -301,7 +304,23 @@ def kv_compare(ctx, nodes, peer_names, area) -> None:
             theirs = await dump_of(host, port, pin)
 
             def ident(v):
-                return (v.get("version"), v.get("originator_id"))
+                import hashlib
+
+                val = v.get("value")
+                if isinstance(val, dict) and "__bytes__" in val:
+                    payload = bytes.fromhex(val["__bytes__"])
+                elif val is None:
+                    payload = b""
+                else:
+                    payload = json.dumps(
+                        val, sort_keys=True, default=str
+                    ).encode()
+                return (
+                    v.get("version"),
+                    v.get("originator_id"),
+                    v.get("ttl_version"),
+                    hashlib.sha256(payload).hexdigest(),
+                )
 
             delta = {
                 "missing_there": sorted(set(mine) - set(theirs)),

@@ -357,6 +357,8 @@ class Decision(Actor):
             self.rib_policy.apply_policy(new_db.unicast_routes)
 
         update = self.route_db.calculate_update(new_db)
+        if getattr(update, "fast_diff", False):
+            counters.increment("decision.fast_unicast_diffs")
         update.type = (
             RouteUpdateType.INCREMENTAL
             if self._first_build_done

@@ -163,13 +163,25 @@ class DecisionRouteDb:
     def calculate_update(self, new_db: "DecisionRouteDb") -> DecisionRouteUpdate:
         """Delta from self -> new_db (ref DecisionRouteDb::calculateUpdate)."""
         upd = DecisionRouteUpdate()
-        for prefix, entry in new_db.unicast_routes.items():
-            old = self.unicast_routes.get(prefix)
-            if old is None or old != entry:
-                upd.unicast_routes_to_update[prefix] = entry
-        for prefix in self.unicast_routes:
-            if prefix not in new_db.unicast_routes:
-                upd.unicast_routes_to_delete.append(prefix)
+        # columnar fast path: when both RIBs are lazy views over the same
+        # column stores, the device's changed-row journal bounds the
+        # entry-level compare to O(changed) instead of O(P) — the diff
+        # never materializes the unchanged bulk of either side
+        from openr_tpu.decision.columnar_rib import fast_unicast_diff
+
+        res = fast_unicast_diff(self.unicast_routes, new_db.unicast_routes)
+        if res is not None:
+            upd.unicast_routes_to_update, dels = res
+            upd.unicast_routes_to_delete = dels
+            upd.fast_diff = True  # observability (not a dataclass field)
+        else:
+            for prefix, entry in new_db.unicast_routes.items():
+                old = self.unicast_routes.get(prefix)
+                if old is None or old != entry:
+                    upd.unicast_routes_to_update[prefix] = entry
+            for prefix in self.unicast_routes:
+                if prefix not in new_db.unicast_routes:
+                    upd.unicast_routes_to_delete.append(prefix)
         for label, entry in new_db.mpls_routes.items():
             old = self.mpls_routes.get(label)
             if old is None or old != entry:

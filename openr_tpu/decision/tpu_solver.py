@@ -64,10 +64,15 @@ from typing import Optional
 
 import numpy as np
 
+from openr_tpu.decision.columnar_rib import (
+    ColumnarRib,
+    LazyUnicastRoutes,
+    unpack_words,
+)
 from openr_tpu.decision.link_state import LinkState, NodeUcmpResult
 from openr_tpu.decision.prefix_state import PrefixState
-from openr_tpu.decision.rib import DecisionRouteDb, NextHop, RibUnicastEntry
-from openr_tpu.decision.spf_solver import SpfSolver, select_best_node_area
+from openr_tpu.decision.rib import DecisionRouteDb
+from openr_tpu.decision.spf_solver import SpfSolver
 from openr_tpu.ops.csr import (
     INF32,
     EllGraph,
@@ -88,41 +93,6 @@ from openr_tpu.types import (
 INF = int(INF32)
 INF_E = int(INF32E)
 _NEG = -(2**31)
-_entry_new = object.__new__
-
-
-# fields the fast-construction loop in _build_entries always sets itself
-_ENTRY_SET_FIELDS = frozenset(
-    {
-        "prefix", "nexthops", "best_prefix_entry", "best_node_area",
-        "igp_cost", "lfa_nexthops",
-    }
-)
-
-
-def _entry_defaults() -> tuple[dict, list]:
-    """(plain defaults, per-entry default factories) of RibUnicastEntry,
-    derived from the dataclass itself so the fast constructor below
-    cannot silently desynchronize when a defaulted field is added to the
-    schema. Factory-defaulted fields the loop does not overwrite are
-    CALLED PER ENTRY — sharing one factory product across all entries
-    would alias a future mutable default."""
-    import dataclasses
-
-    plain = {}
-    factories = []
-    for f in dataclasses.fields(RibUnicastEntry):
-        if f.default is not dataclasses.MISSING:
-            plain[f.name] = f.default
-        elif f.default_factory is not dataclasses.MISSING:  # type: ignore[misc]
-            if f.name in _ENTRY_SET_FIELDS:
-                plain[f.name] = None  # placeholder; always overwritten
-            else:
-                factories.append((f.name, f.default_factory))  # type: ignore[misc]
-    return plain, factories
-
-
-_ENTRY_DEFAULTS, _ENTRY_FACTORIES = _entry_defaults()
 
 # rows shipped per delta pull; bursts changing more fall back to a full
 # pull (one extra round trip, still a single buffer)
@@ -300,29 +270,6 @@ def _pack_words(bits):
     return (bits.reshape(p, w, 16).astype(jnp.int32) * weights).sum(axis=2)
 
 
-def unpack_words(words: np.ndarray, x: int) -> np.ndarray:
-    """host inverse of _pack_words: int32 [R, W] -> bool [R, x].
-
-    Bit extraction runs through np.unpackbits over the low two bytes of
-    each little-endian word (C speed) — the shift-and-mask formulation
-    materialized a [R, W, 16] int32 temporary and cost ~0.3s per 100k-row
-    full pull."""
-    r, wn = words.shape
-    if r == 0 or wn == 0:
-        return np.zeros((r, x), bool)
-    low2 = (
-        np.ascontiguousarray(words.astype("<i4"))
-        .view(np.uint8)
-        .reshape(r, wn, 4)[:, :, :2]
-    )
-    bits = np.unpackbits(
-        np.ascontiguousarray(low2).reshape(r, wn * 2),
-        axis=1,
-        bitorder="little",
-    )
-    return bits[:, :x].astype(bool)
-
-
 def _plan_sssp(deltas, shift_w, res_rows, res_nbr, res_w, root,
                seeds_nbr, seeds_w,
                s_cap: int, has_res: bool, n_cap: int, d_cap: int,
@@ -380,18 +327,24 @@ def _plan_sssp(deltas, shift_w, res_rows, res_nbr, res_w, root,
 def _plan_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
                    has_res: bool,
                    d_cap: int, p_cap: int, a_cap: int, budget: int,
-                   lfa: bool = False):
+                   lfa: bool = False, block_v4: bool = False):
     """The fused production pipeline. Outputs:
       delta_buf int32 [2 + B + B + B*wa + B*wd (+ 2B with lfa)]: count,
                 trips, idx, metric, s3 words, nh words (and lfa slot +
                 metric) for up to B changed rows
-      full_buf  int32 [P * (1 + wa + wd (+2 with lfa)) + 1]: full packed
-                outputs + trips
+      full_buf  int32 [2 + P * (2 + wa + wd (+2 with lfa))]: DEVICE-
+                COMPACTED cold-rebuild pull — ok-row count, trips, the
+                ok row indices (route-level filter computed on device,
+                ops/compact.route_ok_device), then the packed outputs
+                GATHERED to those rows. The host scatters them straight
+                into ColumnarRib columns without an O(P*A) filter pass.
       metric, s3w, nhw, lfa_slot, lfa_metric: resident arrays (the next
                 call's prev_*; lfa arrays are passthrough when lfa=False)
     """
     import jax
     import jax.numpy as jnp
+
+    from openr_tpu.ops.compact import route_ok_device
 
     wa = -(-a_cap // 16)
     wd = -(-d_cap // 16)
@@ -408,8 +361,15 @@ def _plan_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
         path_pref = mbuf[o:o + pa].reshape(p_cap, a_cap); o += pa
         source_pref = mbuf[o:o + pa].reshape(p_cap, a_cap); o += pa
         dist_adv = mbuf[o:o + pa].reshape(p_cap, a_cap); o += pa
+        min_nh = mbuf[o:o + pa].reshape(p_cap, a_cap); o += pa
         ann_valid = (ann_flags & 1).astype(bool)
         ann_over = (ann_flags & 2).astype(bool)
+        # per-prefix v4 bit rides flag bit 2 of announcer slot 0
+        v4_blocked = (
+            (ann_flags[:, 0] & 4).astype(bool)
+            if block_v4
+            else jnp.zeros((p_cap,), bool)
+        )
 
         dist_d, trips = _plan_sssp(
             deltas, shift_w, res_rows, res_nbr, res_w, root,
@@ -496,11 +456,26 @@ def _plan_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
             s3w[safe].ravel(),
             nhw[safe].ravel(),
         ]
-        full_parts = [metric, s3w.ravel(), nhw.ravel()]
+        # cold-rebuild compaction: route-level ok computed on device;
+        # only ok rows' outputs ship (gathered to the front — pad slots
+        # past okc carry the last ok row's values and are ignored)
+        ok = route_ok_device(
+            metric, s3, nh_mask, ann_node, min_nh, v4_blocked, root,
+        )
+        okc = ok.sum().astype(jnp.int32)
+        oidx = jnp.nonzero(ok, size=p_cap, fill_value=p_cap)[0]
+        osafe = jnp.clip(oidx, 0, p_cap - 1).astype(jnp.int32)
+        full_parts = [
+            okc[None],
+            trips[None].astype(jnp.int32),
+            oidx.astype(jnp.int32),
+            metric[osafe],
+            s3w[osafe].ravel(),
+            nhw[osafe].ravel(),
+        ]
         if lfa:
             delta_parts += [lfa_slot[safe], lfa_metric[safe]]
-            full_parts += [lfa_slot, lfa_metric]
-        full_parts.append(trips[None].astype(jnp.int32))
+            full_parts += [lfa_slot[osafe], lfa_metric[osafe]]
         delta_buf = jnp.concatenate(delta_parts)
         full_buf = jnp.concatenate(full_parts)
         return delta_buf, full_buf, metric, s3w, nhw, lfa_slot, lfa_metric
@@ -520,18 +495,23 @@ def _scatter_jit():
 
 
 def _pack_matrix(matrix: PrefixMatrix, node_over: np.ndarray) -> tuple:
-    """(flags [P,A], mbuf int32 [5*P*A]) — validity and per-announcer
-    drain fold into flag bits host-side."""
+    """(flags [P,A], mbuf int32 [6*P*A]) — validity, per-announcer drain
+    and the per-prefix v4 bit (flag bit 2, announcer slot 0) fold into
+    flag bits host-side; min_nexthop ships so the device can run the
+    route-level ok filter (ops/compact.route_ok_device)."""
     idx = np.clip(matrix.ann_node, 0, None)
     flags = matrix.ann_valid.astype(np.int32) | (
         node_over[idx].astype(np.int32) << 1
     )
+    if flags.shape[1]:
+        flags[:, 0] |= matrix.is_v4.astype(np.int32) << 2
     mbuf = np.concatenate([
         matrix.ann_node.ravel(),
         flags.ravel(),
         matrix.path_pref.ravel(),
         matrix.source_pref.ravel(),
         matrix.dist_adv.ravel(),
+        matrix.min_nexthop.ravel(),
     ]).astype(np.int32, copy=False)
     return flags, mbuf
 
@@ -560,11 +540,11 @@ class _AreaDev:
 
 
 class _VantageState:
-    """Per-(area, vantage) output state: resident prev outputs + host
-    route cache for delta materialization."""
+    """Per-(area, vantage) output state: resident prev outputs + the
+    columnar RIB the host patches from delta pulls."""
 
     __slots__ = (
-        "shape_key", "matrix_version", "prev", "routes", "nh_cache",
+        "shape_key", "matrix_version", "prev", "crib",
         "links_tuple", "valid",
     )
 
@@ -572,8 +552,7 @@ class _VantageState:
         self.shape_key = None
         self.matrix_version = -1
         self.prev = None  # (metric, s3w, nhw) device handles
-        self.routes: dict[str, RibUnicastEntry] = {}
-        self.nh_cache: dict = {}
+        self.crib: Optional[ColumnarRib] = None
         self.links_tuple: tuple = ()
         self.valid = False
 
@@ -804,6 +783,20 @@ class TpuSpfSolver:
         # (jitted pipeline, device args, prev outputs) of the last fast
         # solve, for device-only throughput probes
         self._last_exec = None
+        # single worker that runs each area's blocking result pull +
+        # columnar scatter while the main thread dispatches the next
+        # area and walks the host slow path (created lazily; one worker
+        # keeps per-vantage state access serial)
+        self._mat_pool = None
+
+    def _pool(self):
+        if self._mat_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._mat_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="rib-mat"
+            )
+        return self._mat_pool
 
     # static-route passthroughs keep the Decision actor backend-agnostic
     def update_static_unicast_routes(self, to_update, to_delete) -> None:
@@ -884,8 +877,11 @@ class TpuSpfSolver:
         # a KSP2 prime with no subsequent fast-path finish must not leak
         # its timing into a later solve's breakdown
         self._ksp2_timing = {}
+        import time as _time
+
+        t_pipe0 = _time.perf_counter()
         route_db = DecisionRouteDb()
-        finishes = []
+        futures = []
         # per-area device dispatch: a prefix announced in exactly one
         # area selects over that area's announcers only (the other
         # areas' reachability filters remove nothing), so the per-area
@@ -904,11 +900,13 @@ class TpuSpfSolver:
                 # the oracle than one device round trip
                 small.extend(plist)
                 continue
-            finishes.append(
-                self._solve_fast(
-                    my_node_name, area, link_state, prefix_state, plist
-                )
+            prepare = self._solve_fast(
+                my_node_name, area, link_state, prefix_state, plist
             )
+            # the worker pulls + scatters area k's result while the main
+            # thread dispatches area k+1 and runs the host slow path —
+            # sync/exec/mat pipeline across areas instead of serializing
+            futures.append(self._pool().submit(prepare))
         # batch the per-destination second-pass SSSPs on device and prime
         # the k-paths cache; the oracle loop below then assembles KSP2
         # routes through its unchanged code path. Like the fast path,
@@ -933,8 +931,30 @@ class TpuSpfSolver:
             my_node_name, area_link_states, prefix_state,
             slow + ksp2 + small, route_db,
         )
-        for finish in finishes:
-            finish(route_db)
+        if futures:
+            views = []
+            stages = {"sync_ms": 0.0, "exec_ms": 0.0, "mat_ms": 0.0}
+            for fut in futures:
+                res = fut.result()
+                views.append(res["view"])
+                stats = res["stats"]
+                self.last_trips = stats["trips"]
+                self.last_device_stats = stats
+                for k, v in res["timing"].items():
+                    stages[k] = stages.get(k, 0.0) + v
+            # device routes shadow host/static entries for the same
+            # prefix — same override order as the seed's dict.update
+            route_db.unicast_routes = LazyUnicastRoutes(
+                route_db.unicast_routes, views
+            )
+            wall = (_time.perf_counter() - t_pipe0) * 1e3
+            self.last_timing = {
+                **stages,
+                "pipeline_wall_ms": wall,
+                "pipeline_stages_ms": sum(stages.values()),
+                **self._ksp2_timing,
+            }
+            self._ksp2_timing = {}
         return route_db
 
     def _prime_ucmp(
@@ -1123,17 +1143,21 @@ class TpuSpfSolver:
                 out_w[i, : w.shape[0]] = w
 
             lfa = self.cpu.enable_lfa
+            block_v4 = not (
+                self.cpu.enable_v4 or self.cpu.v4_over_v6_nexthop
+            )
+            use_v4_allowed = not self.cpu.v4_over_v6_nexthop
             # one vantage's measured eccentricity bound; another root's
             # can be ~2x it, so seed with 2x + 1 slack
             n_trips = max(2, 2 * self.last_trips + 1)
             cap_trips = max(4, -(-plan.n_cap // _UNROLL) + 2)
             while True:
                 try:
-                    _dist, metric, s3, nh_mask, lfa_slot, lfa_metric = (
-                        sharded_fabric_step(
-                            mesh, plan, matrix, roots, out_nbr, out_w,
-                            n_trips, lfa=lfa,
-                        )
+                    (_dist, metric, s3, nh_mask, lfa_slot, lfa_metric,
+                     ok) = sharded_fabric_step(
+                        mesh, plan, matrix, roots, out_nbr, out_w,
+                        n_trips, lfa=lfa, block_v4=block_v4,
+                        with_ok=True,
                     )
                     break
                 except Unconverged:
@@ -1146,18 +1170,26 @@ class TpuSpfSolver:
             nh_mask = np.asarray(nh_mask)
             lfa_slot = np.asarray(lfa_slot)
             lfa_metric = np.asarray(lfa_metric)
+            ok = np.asarray(ok)
             p_n = len(matrix.prefix_list)
             for i, nm in enumerate(known):
                 links = outs[i][2]
-                vs = _VantageState()
-                self._materialize_arrays(
-                    vs, nm, matrix, links, int(roots[i]),
-                    metric[i][:p_n], s3[i][:p_n], nh_mask[i][:p_n],
+                crib = ColumnarRib(
+                    nm, matrix, list(links), int(roots[i]),
+                    block_v4, use_v4_allowed, lfa,
+                )
+                crib.set_full_arrays(
+                    metric[i][:p_n].astype(np.int32), s3[i][:p_n],
+                    nh_mask[i][:p_n],
                     lfa_slot[i][:p_n] if lfa else None,
                     lfa_metric[i][:p_n] if lfa else None,
+                    ok=ok[i][:p_n],
                 )
                 db = DecisionRouteDb()
-                db.unicast_routes.update(vs.routes)
+                # routes stay columnar until a consumer iterates; slow/
+                # static host routes land in the Lazy's overrides, which
+                # shadow the view — the seed's merge order
+                db.unicast_routes = LazyUnicastRoutes({}, [crib.view()])
                 result[nm] = db
 
         for nm in known:
@@ -1260,9 +1292,12 @@ class TpuSpfSolver:
         prefixes: list[str],
     ):
         """Dispatch the device pipeline and start the async result copy;
-        returns a finish(route_db) closure that consumes the buffer and
-        materializes routes. The caller runs independent host work (the
-        CPU slow path) between the two, hiding the device round trip."""
+        returns a prepare() closure that consumes the buffer and patches
+        the vantage's columnar RIB. The caller submits prepare to the
+        materialization worker and runs independent host work (the CPU
+        slow path, further area dispatches) while it blocks on the pull.
+        Thread-safety: one worker thread, and the caller does not touch
+        this vantage's state until it collects the future."""
         import time as _time
 
         import jax
@@ -1292,6 +1327,8 @@ class TpuSpfSolver:
         if vs is None:
             vs = self._vstates[vkey] = _VantageState()
         links_tuple = tuple(links)
+        lfa = self.cpu.enable_lfa
+        block_v4 = not (self.cpu.enable_v4 or self.cpu.v4_over_v6_nexthop)
         if (
             vs.shape_key != cache_key
             or vs.matrix_version != ad.matrix_version
@@ -1311,14 +1348,15 @@ class TpuSpfSolver:
             )
             vs.shape_key = cache_key
             vs.matrix_version = ad.matrix_version
-            vs.routes = {}
-            vs.nh_cache = {}
+            vs.crib = ColumnarRib(
+                my_node_name, matrix, list(links), root_idx,
+                block_v4, not self.cpu.v4_over_v6_nexthop, lfa,
+            )
             vs.links_tuple = links_tuple
             vs.valid = False
 
         t1 = _time.perf_counter()
-        lfa = self.cpu.enable_lfa
-        run = _plan_pipeline(*shape_key, _DELTA_BUDGET, lfa)
+        run = _plan_pipeline(*shape_key, _DELTA_BUDGET, lfa, block_v4)
         delta_buf, full_buf, *new_prev = run(
             ad.d_deltas, ad.d_shift_w, ad.d_res_rows, ad.d_res_nbr,
             ad.d_res_w, ad.d_mbuf,
@@ -1341,24 +1379,26 @@ class TpuSpfSolver:
         # flies while the caller does unrelated host work
         (delta_buf if was_valid else full_buf).copy_to_host_async()
 
-        def finish(route_db: DecisionRouteDb) -> None:
-            # prev advances HERE, atomically with the route-cache update:
-            # if the interleaved host work raises before finish, the next
-            # solve still compares against the outputs it last
-            # materialized, so the aborted solve's changed rows are not
-            # silently treated as already-applied
+        def prepare() -> dict:
+            # runs on the materialization worker. prev advances HERE,
+            # atomically with the columnar update: if interleaved host
+            # work raises before collection, the next solve still
+            # compares against the outputs last applied, so the aborted
+            # solve's changed rows are not silently treated as applied
             vs.prev = tuple(new_prev)
             wa = -(-a_cap // 16)
             wd = -(-d_cap // 16)
             b = _DELTA_BUDGET
+            crib = vs.crib
             count = None
+            trips = 0
             if was_valid:
                 dbuf = np.asarray(delta_buf)  # ONE pull
                 count = int(dbuf[0])
-                self.last_trips = int(dbuf[1])
+                trips = int(dbuf[1])
             t2 = _time.perf_counter()
             full_pull = count is None or count > b
-            self.last_device_stats = {
+            stats = {
                 "n_cap": plan.n_cap,
                 "s_cap": plan.s_cap,
                 "k_res": plan.k_res,
@@ -1369,18 +1409,21 @@ class TpuSpfSolver:
             if full_pull:
                 fbuf = np.asarray(full_buf)
                 t2 = _time.perf_counter()
-                o = 0
+                okc = int(fbuf[0])
+                trips = int(fbuf[1])
+                o = 2
+                oidx = fbuf[o:o + p_cap]; o += p_cap
                 metric = fbuf[o:o + p_cap]; o += p_cap
                 s3w = fbuf[o:o + p_cap * wa].reshape(p_cap, wa); o += p_cap * wa
                 nhw = fbuf[o:o + p_cap * wd].reshape(p_cap, wd); o += p_cap * wd
                 lfa_slot = lfa_metric = None
                 if lfa:
                     lfa_slot = fbuf[o:o + p_cap]; o += p_cap
-                    lfa_metric = fbuf[o:o + p_cap]; o += p_cap
-                self.last_trips = int(fbuf[o])
-                self._materialize_full(
-                    vs, my_node_name, matrix, links, root_idx,
-                    metric, s3w, nhw, lfa_slot, lfa_metric,
+                    lfa_metric = fbuf[o:o + p_cap]
+                crib.set_full_packed(
+                    oidx[:okc], metric[:okc], s3w[:okc], nhw[:okc],
+                    None if lfa_slot is None else lfa_slot[:okc],
+                    None if lfa_metric is None else lfa_metric[:okc],
                 )
                 vs.valid = True
             elif count:
@@ -1394,26 +1437,25 @@ class TpuSpfSolver:
                     lfa_slot = dbuf[o:o + b]; o += b
                     lfa_metric = dbuf[o:o + b]
                 live = cidx < p_cap
-                self._materialize_rows(
-                    vs, my_node_name, matrix, links, root_idx,
+                crib.apply_rows(
                     cidx[live][:count], metric[live][:count],
                     s3w[live][:count], nhw[live][:count],
                     None if lfa_slot is None else lfa_slot[live][:count],
                     None if lfa_metric is None else lfa_metric[live][:count],
                 )
-            self.last_device_stats["trips"] = self.last_trips
-
-            route_db.unicast_routes.update(vs.routes)
+            stats["trips"] = trips
             t3 = _time.perf_counter()
-            self.last_timing = {
-                "sync_ms": (t1 - t0) * 1e3,
-                "exec_ms": (t2 - t1) * 1e3,
-                "mat_ms": (t3 - t2) * 1e3,
-                **self._ksp2_timing,
+            return {
+                "view": crib.view(),
+                "stats": stats,
+                "timing": {
+                    "sync_ms": (t1 - t0) * 1e3,
+                    "exec_ms": (t2 - t1) * 1e3,
+                    "mat_ms": (t3 - t2) * 1e3,
+                },
             }
-            self._ksp2_timing = {}
 
-        return finish
+        return prepare
 
     # -- device-assisted KSP2 ----------------------------------------------
 
@@ -1702,189 +1744,3 @@ class TpuSpfSolver:
             o = run(*dev_args, *o[2:])
         jax.block_until_ready(o)
         return (_time.perf_counter() - t0) * 1e3 / iters
-
-    # -- host materialization ----------------------------------------------
-
-    def _materialize_full(
-        self, vs, my_node_name, matrix, links, root_idx,
-        metric, s3w, nhw, lfa_slot=None, lfa_metric=None,
-    ) -> None:
-        """Full rebuild of the vantage route cache from packed outputs."""
-        p_n = len(matrix.prefix_list)
-        a_cap = matrix.ann_node.shape[1]
-        d_n = len(links)
-        self._materialize_arrays(
-            vs, my_node_name, matrix, links, root_idx,
-            metric[:p_n],
-            unpack_words(s3w[:p_n], a_cap),
-            unpack_words(nhw[:p_n], max(d_n, 1)),
-            lfa_slot[:p_n] if lfa_slot is not None else None,
-            lfa_metric[:p_n] if lfa_metric is not None else None,
-        )
-
-    def _materialize_arrays(
-        self, vs, my_node_name, matrix, links, root_idx,
-        met, s3, nh, lfa_slot=None, lfa_metric=None,
-    ) -> None:
-        """Full rebuild of the vantage route cache from UNPACKED per-row
-        outputs (met [P], s3 [P, A], nh [P, >=D]) — shared by the
-        single-chip full-pull path and the sharded whole-fabric path.
-        Route-level filters run vectorized; the Python loop only builds
-        entries for surviving rows."""
-        p_n = len(matrix.prefix_list)
-        ok = s3.any(axis=1) & (met < INF_E)
-        if not (self.cpu.enable_v4 or self.cpu.v4_over_v6_nexthop):
-            ok &= ~matrix.is_v4[:p_n]
-        ok &= ~(s3 & (matrix.ann_node[:p_n] == root_idx)).any(axis=1)
-        eff_min = np.where(s3, matrix.min_nexthop[:p_n], -1).max(axis=1)
-        nh_count = nh.sum(axis=1)
-        ok &= (eff_min <= nh_count) & (nh_count > 0)
-
-        vs.routes = {}
-        rows = np.flatnonzero(ok)
-        if len(rows):
-            self._build_entries(
-                vs, my_node_name, matrix, links, rows,
-                met, s3, nh, lfa_slot, lfa_metric,
-            )
-
-    def _materialize_rows(
-        self, vs, my_node_name, matrix, links, root_idx,
-        rows, metric_rows, s3w_rows, nhw_rows,
-        lfa_slot_rows=None, lfa_metric_rows=None,
-    ) -> None:
-        """Delta path: apply only changed rows to the route cache."""
-        p_n = len(matrix.prefix_list)
-        a_cap = matrix.ann_node.shape[1]
-        d_n = len(links)
-        live = rows < p_n
-        rows = rows[live]
-        if not len(rows):
-            return
-        s3 = unpack_words(s3w_rows[live], a_cap)
-        nh = unpack_words(nhw_rows[live], max(d_n, 1))
-        met = metric_rows[live]
-        lfa_s = lfa_slot_rows[live] if lfa_slot_rows is not None else None
-        lfa_m = lfa_metric_rows[live] if lfa_metric_rows is not None else None
-
-        ok = s3.any(axis=1) & (met < INF_E)
-        if not (self.cpu.enable_v4 or self.cpu.v4_over_v6_nexthop):
-            ok &= ~matrix.is_v4[rows]
-        ok &= ~(s3 & (matrix.ann_node[rows] == root_idx)).any(axis=1)
-        eff_min = np.where(s3, matrix.min_nexthop[rows], -1).max(axis=1)
-        nh_count = nh.sum(axis=1)
-        ok &= (eff_min <= nh_count) & (nh_count > 0)
-
-        # removals
-        for p in rows[~ok]:
-            vs.routes.pop(matrix.prefix_list[p], None)
-        keep = np.flatnonzero(ok)
-        if len(keep):
-            self._build_entries(
-                vs, my_node_name, matrix, links,
-                rows[keep], met, s3, nh, lfa_s, lfa_m, value_rows=keep,
-            )
-
-    def _build_entries(
-        self, vs, my_node_name, matrix, links, rows,
-        met, s3, nh, lfa_slot=None, lfa_metric=None, value_rows=None,
-    ) -> None:
-        """Construct RibUnicastEntry for the given matrix rows. met/s3/nh
-        (and lfa arrays) are indexed by value_rows (delta path) or by
-        matrix row (full)."""
-        nh_cache = vs.nh_cache
-        node_areas = matrix.node_areas
-        entry_refs = matrix.entry_refs
-        prefix_list = matrix.prefix_list
-        # row data as Python lists / flat bytes: the loop below runs for
-        # every changed route (all ~100k on a cold rebuild) and per-row
-        # numpy scalar indexing costs ~10x a list index
-        nh_bytes = np.packbits(nh, axis=1).tobytes()
-        nh_stride = -(-nh.shape[1] // 8) if len(rows) else 1
-        rows_l = rows.tolist()
-        vi_l = value_rows.tolist() if value_rows is not None else rows_l
-        met_l = met.tolist()
-        s3_l = s3.tolist()
-        nh_l = nh.tolist()
-        lfa_slot_l = lfa_slot.tolist() if lfa_slot is not None else None
-        lfa_metric_l = lfa_metric.tolist() if lfa_metric is not None else None
-        routes = vs.routes
-        no_lfa = frozenset()
-        n_links = len(links)
-        # family-aware next-hop addresses (ref createNextHop): v4
-        # prefixes take the link's v4 address unless v4-over-v6 is on.
-        # Sliced by row — the delta path calls this for a handful of
-        # rows and must not pay an O(P) conversion.
-        v4_rows_l = matrix.is_v4[rows].tolist()
-        use_v4_allowed = not self.cpu.v4_over_v6_nexthop
-        for i, p in enumerate(rows_l):
-            vi = vi_l[i]
-            row = s3_l[vi]
-            nas = node_areas[p]
-            sel = [(a, na) for a, na in enumerate(nas) if row[a]]
-            if not sel:
-                continue
-            m = met_l[vi]
-            use_v4 = use_v4_allowed and v4_rows_l[i]
-            key = (nh_bytes[vi * nh_stride:(vi + 1) * nh_stride], m, use_v4)
-            nexthops = nh_cache.get(key)
-            if nexthops is None:
-                nh_row = nh_l[vi]
-                nexthops = frozenset(
-                    NextHop(
-                        address=links[d].nh_from_node(my_node_name, use_v4),
-                        if_name=links[d].iface_from_node(my_node_name),
-                        metric=m,
-                        area=links[d].area,
-                        neighbor_node_name=links[d].other_node(my_node_name),
-                    )
-                    for d in range(n_links)
-                    if nh_row[d]
-                )
-                nh_cache[key] = nexthops
-            lfa_nexthops = no_lfa
-            if lfa_slot_l is not None:
-                d = lfa_slot_l[vi]
-                if 0 <= d < n_links:
-                    alt_m = lfa_metric_l[vi]
-                    lkey = ("lfa", d, alt_m, use_v4)
-                    lfa_nexthops = nh_cache.get(lkey)
-                    if lfa_nexthops is None:
-                        lfa_nexthops = frozenset({
-                            NextHop(
-                                address=links[d].nh_from_node(
-                                    my_node_name, use_v4
-                                ),
-                                if_name=links[d].iface_from_node(my_node_name),
-                                metric=alt_m,
-                                area=links[d].area,
-                                neighbor_node_name=links[d].other_node(
-                                    my_node_name
-                                ),
-                            )
-                        })
-                        nh_cache[lkey] = lfa_nexthops
-            if len(sel) == 1:
-                ba, best = sel[0]
-            else:
-                best = select_best_node_area(
-                    {na for _, na in sel}, my_node_name
-                )
-                ba = next(a for a, na in sel if na == best)
-            prefix = prefix_list[p]
-            # bypass the dataclass __init__ (per-field object.__setattr__
-            # x9) — this loop constructs one entry per route on a cold
-            # 100k rebuild; equality/hash read the same attributes either
-            # way, and unset fields come from the schema-derived defaults
-            entry = _entry_new(RibUnicastEntry)
-            d = dict(_ENTRY_DEFAULTS)
-            for fname, factory in _ENTRY_FACTORIES:
-                d[fname] = factory()
-            d["prefix"] = prefix
-            d["nexthops"] = nexthops
-            d["best_prefix_entry"] = entry_refs[p][ba]
-            d["best_node_area"] = best
-            d["igp_cost"] = m
-            d["lfa_nexthops"] = lfa_nexthops
-            entry.__dict__.update(d)
-            routes[prefix] = entry

@@ -67,7 +67,7 @@ def _ucmp_fn(e_cap: int, n_cap: int, use_prefix_weight: bool):
         wf0 = w0.astype(jnp.float32)
 
         def body(state):
-            _, reach, w, wf = state
+            _, reach, w, wf, it = state
             rv = reach[dst] & dag
             if use_prefix_weight:
                 per_edge = jnp.where(rv, w[dst], zero)
@@ -87,17 +87,25 @@ def _ucmp_fn(e_cap: int, n_cap: int, use_prefix_weight: bool):
             )
             new_reach = leaf_mask | (hit > 0)
             changed = jnp.any(new_reach != reach) | jnp.any(new_w != w)
-            return changed, new_reach, new_w, new_wf
+            return changed, new_reach, new_w, new_wf, it + 1
+
+        # a true DAG converges in depth <= n_cap rounds; the bound exists
+        # so that a corrupted "DAG" (a zero-weight cycle satisfies the
+        # membership predicate in both directions) terminates instead of
+        # oscillating forever — the non-convergence then surfaces as
+        # overflow=True and the caller falls back to the exact host walk
+        bound = jnp.int32(n_cap + 2)
 
         def cond(state):
-            return state[0]
+            return state[0] & (state[4] < bound)
 
-        _, reach, w, wf = jax.lax.while_loop(
-            cond, body, (jnp.bool_(True), leaf_mask, w0, wf0)
+        changed, reach, w, wf, _ = jax.lax.while_loop(
+            cond, body, (jnp.bool_(True), leaf_mask, w0, wf0, jnp.int32(0))
         )
         # float shadow saturates instead of wrapping: any node beyond
-        # 2^30 means the int32 field may have overflowed
-        overflow = jnp.any(wf > jnp.float32(1 << 30))
+        # 2^30 means the int32 field may have overflowed. `changed` still
+        # True at exit means the bound fired before the fixpoint.
+        overflow = jnp.any(wf > jnp.float32(1 << 30)) | changed
         return reach, w, overflow
 
     return jax.jit(f)
@@ -155,8 +163,16 @@ class UcmpEdges:
             if not self.adj_w_unsafe:
                 adj_w[0:e2:2] = aw[:, 0]
                 adj_w[1:e2:2] = aw[:, 1]
+            # a live zero(/negative)-metric edge makes BOTH directions
+            # satisfy the DAG predicate (du + 0 == dv both ways) — the
+            # "DAG" has a 2-cycle and the fixpoint oscillates. The host
+            # walk's explicit heap order handles it exactly; force it.
+            self.zero_w_unsafe = bool(
+                ((w_eff[:e2] < INF_E) & (w_eff[:e2] <= 0)).any()
+            )
         else:
             self.adj_w_unsafe = False
+            self.zero_w_unsafe = False
         self.e_cap = e_cap
         self.n_cap = n_cap
         self.node_index = index
@@ -178,6 +194,10 @@ def propagate(edges: UcmpEdges, d_dist, leaf_weights: dict[str, int],
     if leaf_weights and max(leaf_weights.values()) > (1 << 30):
         return None, None, True
     if not use_prefix_weight and edges.adj_w_unsafe:
+        return None, None, True
+    # zero-weight edges break DAG membership in BOTH modes (see
+    # UcmpEdges); treat exactly like adj_w_unsafe — host walk
+    if edges.zero_w_unsafe:
         return None, None, True
     leaf_mask = np.zeros(edges.n_cap, bool)
     leaf_w = np.zeros(edges.n_cap, np.int32)

@@ -92,6 +92,8 @@ def _sharded_fabric_fn(mesh, n_cap: int, s_cap: int, r_cap: int,
         path_pref,
         source_pref,
         dist_adv,
+        min_nh,      # [P, A]
+        v4_blocked,  # [P]
     ):
         my_col0 = jax.lax.axis_index("graph") * shard_cols
 
@@ -198,11 +200,26 @@ def _sharded_fabric_fn(mesh, n_cap: int, s_cap: int, r_cap: int,
             else:
                 lfa_slot = jnp.full((p_cap,), -1, jnp.int32)
                 lfa_metric = jnp.zeros((p_cap,), jnp.int32)
-            return dist, metric, s3, nh_mask, lfa_slot, lfa_metric, converged
+            # route-level ok on device (shared with the single-chip
+            # compaction) so the host skips its own O(P*A) filter pass
+            from openr_tpu.ops.compact import route_ok_device
+
+            ok = route_ok_device(
+                metric, s3, nh_mask, ann_node, min_nh, v4_blocked, root
+            )
+            return (
+                dist, metric, s3, nh_mask, lfa_slot, lfa_metric, ok,
+                converged,
+            )
 
         return jax.vmap(one_root)(roots, root_nbr, root_w)
 
-    from jax import shard_map
+    try:
+        from jax import shard_map  # jax >= 0.6
+        _check_kw = {"check_vma": False}
+    except ImportError:  # older jax: experimental module, check_rep kwarg
+        from jax.experimental.shard_map import shard_map
+        _check_kw = {"check_rep": False}
 
     return jax.jit(
         shard_map(
@@ -218,6 +235,8 @@ def _sharded_fabric_fn(mesh, n_cap: int, s_cap: int, r_cap: int,
                 P("batch", None),    # root_nbr
                 P("batch", None),    # root_w
                 P(), P(), P(), P(), P(),
+                P(),                 # min_nh
+                P(),                 # v4_blocked
             ),
             out_specs=(
                 P("batch", None),
@@ -226,9 +245,10 @@ def _sharded_fabric_fn(mesh, n_cap: int, s_cap: int, r_cap: int,
                 P("batch", None, None),
                 P("batch", None),
                 P("batch", None),
+                P("batch", None),    # ok
                 P("batch"),
             ),
-            check_vma=False,
+            **_check_kw,
         )
     )
 
@@ -247,7 +267,8 @@ def pad_to(arr: np.ndarray, size: int, fill, axis: int = 0) -> np.ndarray:
 
 def sharded_fabric_step(mesh, plan, matrix, roots, out_nbr, out_w,
                         n_trips: int, check_convergence: bool = True,
-                        lfa: bool = False):
+                        lfa: bool = False, block_v4: bool = False,
+                        with_ok: bool = False):
     """Run the sharded whole-fabric pipeline.
 
     plan: ops.edgeplan.EdgePlan; matrix: ops.csr.PrefixMatrix;
@@ -265,7 +286,10 @@ def sharded_fabric_step(mesh, plan, matrix, roots, out_nbr, out_w,
     Returns (dist [Rt, N_cap], metric [Rt, P_cap], s3 [Rt, P_cap, A]
     selected-announcer masks, nh_mask [Rt, P_cap, D], lfa_slot
     [Rt, P_cap] (-1 = none; only meaningful with lfa=True), lfa_metric
-    [Rt, P_cap]).
+    [Rt, P_cap]). With with_ok=True a seventh array is appended: the
+    device-computed route-level ok mask [Rt, P_cap]
+    (ops/compact.route_ok_device with v4 rows blocked per block_v4),
+    which ColumnarRib.set_full_arrays consumes directly.
     """
     g = mesh.shape["graph"]
     n_cap = plan.n_cap
@@ -284,16 +308,21 @@ def sharded_fabric_step(mesh, plan, matrix, roots, out_nbr, out_w,
         plan.node_overloaded[idxm].astype(np.int32) << 1
     )
 
+    v4_blocked = (
+        matrix.is_v4 if block_v4 else np.zeros(p_cap, bool)
+    )
+
     fn = _sharded_fabric_fn(
         mesh, n_cap, plan.s_cap, r_cap, kr_cap, has_res, d_cap,
         p_cap, a_cap, n_trips, lfa,
     )
-    dist, metric, s3, nh_mask, lfa_slot, lfa_metric, converged = fn(
+    dist, metric, s3, nh_mask, lfa_slot, lfa_metric, ok, converged = fn(
         plan.deltas, plan.shift_w, res_rows, res_nbr, res_w,
         roots.astype(np.int32), out_nbr.astype(np.int32),
         out_w.astype(np.int32),
         matrix.ann_node, flags, matrix.path_pref, matrix.source_pref,
         matrix.dist_adv,
+        matrix.min_nexthop.astype(np.int32), v4_blocked,
     )
     if check_convergence:
         conv = np.asarray(converged)
@@ -302,4 +331,6 @@ def sharded_fabric_step(mesh, plan, matrix, roots, out_nbr, out_w,
                 f"sharded SSSP unconverged for roots "
                 f"{np.asarray(roots)[~conv].tolist()}: raise n_trips ({n_trips})"
             )
+    if with_ok:
+        return dist, metric, s3, nh_mask, lfa_slot, lfa_metric, ok
     return dist, metric, s3, nh_mask, lfa_slot, lfa_metric

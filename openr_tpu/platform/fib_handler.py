@@ -104,6 +104,12 @@ class NetlinkDataplane:
         # (prefix, metric), so a metric change (RTT drift, redistribution
         # distance) must DELETE the old-metric route or both coexist
         self._metric: dict[str, int] = {}
+        # old-metric kernel entries whose make-before-break cleanup
+        # failed: prefix -> metrics still present in the kernel beside
+        # the live route. Retried on the next add/delete/sync touching
+        # the prefix; the duplicate forwards correctly meanwhile (the
+        # kernel prefers the lower metric)
+        self._stale: dict[str, set[int]] = {}
         self.mpls_kernel = mpls_supported()
         if not self.mpls_kernel:
             logging.getLogger(__name__).info(
@@ -258,33 +264,29 @@ class NetlinkDataplane:
                 failed.append(r)
         return failed
 
-    def _stale_metric_routes(self, routes: dict[str, dict]) -> list:
-        from openr_tpu.platform.netlink import NlRoute
-
-        out = []
-        for p, r in routes.items():
-            old = self._metric.get(p)
-            new = r.get("igp_cost") or 0
-            if old is not None and old != new:
-                out.append(NlRoute(prefix=p, metric=old, table=self.table))
-        return out
-
     async def add_unicast(self, routes: dict[str, dict]) -> list[str]:
         self._ensure_open()
-        # NLM_F_REPLACE only replaces the SAME-metric route: clear the
-        # previous metric's entry first or the kernel keeps both. A
-        # failed old-metric delete defers the whole (re)program of that
-        # prefix: the old route keeps forwarding, _metric keeps naming
-        # it, and the Fib actor's retry re-attempts the delete — adding
-        # the new metric now would strand an untracked duplicate.
-        blocked = {
-            r.prefix
-            for r in await self._delete_exact(
-                self._stale_metric_routes(routes)
-            )
-        }
-        routes = {p: r for p, r in routes.items() if p not in blocked}
+        # Make-before-break. NLM_F_REPLACE only replaces the SAME-metric
+        # route, so a metric change must clear the previous metric's
+        # entry — but deleting it BEFORE the add lands opens a forwarding
+        # gap (and blackholes the prefix outright if the add then fails).
+        # Program the new-metric route first; only after it is acked
+        # clear the old entry. A failed cleanup leaves both entries
+        # resolving (the kernel forwards via the lower metric) — it is
+        # parked in the _stale ledger and the prefix reported failed so
+        # the Fib actor's retry re-attempts the delete.
+        pending_old: dict[str, set[int]] = {}
+        for p, r in routes.items():
+            stale = set(self._stale.get(p, ()))
+            old = self._metric.get(p)
+            if old is not None and old != (r.get("igp_cost") or 0):
+                stale.add(old)
+            stale.discard(r.get("igp_cost") or 0)
+            if stale:
+                pending_old[p] = stale
         nl_routes = [self._to_nl(p, r) for p, r in routes.items()]
+        failed: list[str] = []
+        added_all = False
         bulk = await self._bulk(0, nl_routes)
         if bulk is not None:
             ok, err = bulk
@@ -294,26 +296,55 @@ class NetlinkDataplane:
             if err == 0 and ok == len(nl_routes):
                 for r in nl_routes:
                     self._metric[r.prefix] = r.metric
-                return sorted(blocked)
+                added_all = True
             # rare: re-walk per-route on the asyncio client to learn
             # WHICH prefixes failed (the native path returns counts);
             # adds use NLM_F_REPLACE so re-adding acked routes is safe
-        failed = sorted(blocked)
-        for r in nl_routes:
-            try:
-                await self.nl.add_route(r)
-                self._metric[r.prefix] = r.metric
-            except OSError:
-                failed.append(r.prefix)
-        return failed
+        if not added_all:
+            for r in nl_routes:
+                try:
+                    await self.nl.add_route(r)
+                    self._metric[r.prefix] = r.metric
+                except OSError:
+                    failed.append(r.prefix)
+        # break: clear old-metric entries only for prefixes whose new
+        # route actually landed — a failed add keeps its old route (and
+        # its _metric/_stale records) untouched for forwarding + retry
+        failed_set = set(failed)
+        old_nl = [
+            self._to_nl(p, {"igp_cost": m})
+            for p, metrics in pending_old.items()
+            if p not in failed_set
+            for m in sorted(metrics)
+        ]
+        if old_nl:
+            leftover: dict[str, set[int]] = {}
+            for r in await self._delete_exact(old_nl):
+                leftover.setdefault(r.prefix, set()).add(r.metric)
+            for p in pending_old:
+                if p in failed_set:
+                    continue
+                if p in leftover:
+                    self._stale[p] = leftover[p]
+                    failed.append(p)
+                else:
+                    self._stale.pop(p, None)
+        return sorted(set(failed))
 
     async def delete_unicast(self, prefixes: list[str]) -> list[str]:
         self._ensure_open()
         # delete the metric we actually programmed — a bare delete only
-        # matches one (prefix, metric) entry
+        # matches one (prefix, metric) entry. Any old-metric duplicates
+        # parked in _stale ride along so a withdrawn prefix leaves no
+        # kernel residue from an earlier failed make-before-break cleanup
         nl_routes = [
             self._to_nl(p, {"igp_cost": self._metric.get(p, 0)})
             for p in prefixes
+        ] + [
+            self._to_nl(p, {"igp_cost": m})
+            for p in prefixes
+            for m in sorted(self._stale.get(p, ()))
+            if m != self._metric.get(p, 0)
         ]
         bulk = await self._bulk(1, nl_routes)
         if bulk is not None:
@@ -326,15 +357,17 @@ class NetlinkDataplane:
             if err == 0 and ok == len(nl_routes):
                 for p in prefixes:
                     self._metric.pop(p, None)
+                    self._stale.pop(p, None)
                 return []
         # pop the metric record only for deletes that SUCCEED — a retry
         # of a failed delete must target the real metric, not 0 (which
         # the kernel would report as already-gone)
         failed_nl = await self._delete_exact(nl_routes)
-        failed = [r.prefix for r in failed_nl]
+        failed = sorted({r.prefix for r in failed_nl})
         for p in prefixes:
             if p not in failed:
                 self._metric.pop(p, None)
+                self._stale.pop(p, None)
         return failed
 
     async def sync_unicast(self, routes: dict[str, dict]) -> list[str]:
@@ -373,6 +406,10 @@ class NetlinkDataplane:
             for p in stale:
                 if p not in leftover:
                     self._metric.pop(p, None)
+            # the kernel dump is authoritative: every cleared prefix has
+            # no duplicate left, so its _stale ledger entry is settled
+            for p in {r.prefix for r in stale_nl} - leftover:
+                self._stale.pop(p, None)
             failed += sorted(leftover - set(failed))
         return failed
 

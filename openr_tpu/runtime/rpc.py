@@ -236,10 +236,28 @@ class RpcServer:
 
     async def _send(self, obj: dict, writer: asyncio.StreamWriter) -> None:
         try:
-            writer.write(json.dumps(obj, separators=(",", ":")).encode() + b"\n")
+            writer.write(
+                json.dumps(
+                    obj, separators=(",", ":"), default=_json_default
+                ).encode()
+                + b"\n"
+            )
             await writer.drain()
         except (ConnectionResetError, BrokenPipeError):
             pass
+
+
+def _json_default(o):
+    """Serialize lazily-materialized mappings (e.g. LazyUnicastRoutes
+    riding inside a handler's result) at the RPC boundary — iterating
+    them here is their designed consumption point."""
+    from collections.abc import Mapping
+
+    if isinstance(o, Mapping):
+        return dict(o)
+    raise TypeError(
+        f"Object of type {type(o).__name__} is not JSON serializable"
+    )
 
 
 class RpcClient:

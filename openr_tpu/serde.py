@@ -14,11 +14,34 @@ import dataclasses
 import enum
 import json
 import typing
+from collections.abc import Mapping as _Mapping
 from typing import Any, Optional, Type, TypeVar, Union
 
 T = TypeVar("T")
 
 _HINT_CACHE: dict[type, dict[str, Any]] = {}
+
+
+def _resolve_nested(tp: Any, g: dict) -> Any:
+    """Resolve forward-ref STRINGS nested inside subscripted annotations.
+    Under PEP 563 the whole annotation string is eval'd, but an inner
+    quoted name (dict[str, "X"]) evaluates to the literal str "X" —
+    get_type_hints does not recurse into it, and from_plain would then
+    pass the plain value through unconverted."""
+    import types as _pytypes
+
+    if isinstance(tp, str):
+        return g.get(tp, tp)
+    args = typing.get_args(tp)
+    if not args:
+        return tp
+    new_args = tuple(_resolve_nested(a, g) for a in args)
+    if new_args == args:
+        return tp
+    origin = typing.get_origin(tp)
+    if origin is Union or origin is _pytypes.UnionType:
+        return typing.Union[new_args]
+    return origin[new_args]
 
 
 def _type_hints(cls: type) -> dict[str, Any]:
@@ -28,6 +51,7 @@ def _type_hints(cls: type) -> dict[str, Any]:
 
         mod_globals = vars(sys.modules.get(cls.__module__, typing))
         hints = typing.get_type_hints(cls, mod_globals)
+        hints = {k: _resolve_nested(v, mod_globals) for k, v in hints.items()}
         _HINT_CACHE[cls] = hints
     return hints
 
@@ -48,6 +72,10 @@ def to_plain(obj: Any) -> Any:
     if isinstance(obj, (list, tuple, set, frozenset)):
         return [to_plain(v) for v in obj]
     if isinstance(obj, dict):
+        return {str(k): to_plain(v) for k, v in obj.items()}
+    if isinstance(obj, _Mapping):
+        # e.g. decision.columnar_rib.LazyUnicastRoutes — iterating it IS
+        # the consumption boundary where lazy routes materialize
         return {str(k): to_plain(v) for k, v in obj.items()}
     raise TypeError(f"cannot serialize {type(obj)!r}")
 

@@ -1,0 +1,52 @@
+"""Fast smoke over the bench harness (tier-1, not slow).
+
+Runs one tiny config through bench.bench_config's real code path —
+cold rebuild, forced lazy consumption, steady-state flap loop — so the
+benchmark (and the timing keys CI dashboards key on) can't silently
+rot between full bench runs. Parity vs the CPU oracle is asserted
+inside bench_config itself.
+"""
+
+
+def test_bench_config_smoke_device_path():
+    from bench import bench_config
+    from openr_tpu.models import topologies
+
+    res, tpu_ms, cpu_ms = bench_config(
+        "smoke",
+        lambda: topologies.grid(6, node_labels=False),
+        "node-3-3",
+        runs=2,
+        flap_victims=2,
+    )
+    assert tpu_ms > 0 and cpu_ms > 0
+    # cold-rebuild instrumentation (ISSUE 1): the lazy build's
+    # pipeline stages + the forced consumption pass
+    assert res["full_ms"] > 0
+    assert "cold_consume_ms" in res
+    bd = res["full_breakdown"]
+    for k in ("sync_ms", "exec_ms", "mat_ms",
+              "pipeline_wall_ms", "pipeline_stages_ms"):
+        assert k in bd, (k, bd)
+    assert bd["pipeline_wall_ms"] > 0
+    # steady-state medians are reported for every phase
+    for k in ("sync_ms", "exec_ms", "mat_ms", "tpu_ms"):
+        assert k in res, (k, res)
+    assert res["changed_rows"] is not None
+
+
+def test_bench_config_small_graph_delegation_still_reports():
+    """The auto backend's small-graph delegation path must keep the
+    result dict shape (no columnar pipeline keys, but full_ms/tpu_ms)."""
+    from bench import bench_config
+    from openr_tpu.models import topologies
+
+    res, tpu_ms, cpu_ms = bench_config(
+        "smoke-small",
+        lambda: topologies.full_mesh(4),
+        "node-0",
+        runs=2,
+        small_graph_nodes=64,
+    )
+    assert tpu_ms > 0 and res["full_ms"] > 0
+    assert "tpu_ms" in res

@@ -1,0 +1,182 @@
+"""ColumnarRib / LazyUnicastRoutes properties (ISSUE 1 tentpole).
+
+The columnar RIB keeps the solver's packed outputs as numpy columns and
+builds RibUnicastEntry objects only at consumption boundaries. These
+tests pin the load-bearing invariants:
+
+  - materialized-lazily == built-eagerly, byte-identical, on randomized
+    topologies through cold rebuilds AND steady-state delta patches
+    (the CPU oracle builds every entry eagerly through an independent
+    code path);
+  - RibView snapshots are isolated from later churn (copy-on-write);
+  - fast_unicast_diff (journal-bounded) == the brute-force full
+    compare;
+  - LazyUnicastRoutes honors MutableMapping semantics without forcing
+    surprises.
+"""
+
+import numpy as np
+import pytest
+
+from openr_tpu.decision.columnar_rib import (
+    LazyUnicastRoutes,
+    fast_unicast_diff,
+)
+from openr_tpu.decision.prefix_state import PrefixState
+from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.decision.tpu_solver import TpuSpfSolver
+from openr_tpu.models import topologies
+from openr_tpu.types import Adjacency, AdjacencyDatabase
+
+
+def _flap(states, adj_dbs, node, metric):
+    victim = next(d for d in adj_dbs if d.this_node_name == node)
+    states["0"].update_adjacency_database(
+        AdjacencyDatabase(
+            this_node_name=node,
+            adjacencies=tuple(
+                Adjacency(**{**a.__dict__, "metric": metric})
+                for a in victim.adjacencies
+            ),
+            area="0",
+        )
+    )
+
+
+def _assert_byte_identical(lazy_db, eager_db, context):
+    mat = dict(lazy_db.unicast_routes)
+    eager = eager_db.unicast_routes
+    assert mat.keys() == eager.keys(), context
+    for pfx, a in mat.items():
+        b = eager[pfx]
+        # dataclass __eq__ covers every field; repr pins the byte-level
+        # rendering (field order, frozenset contents, defaults)
+        assert a == b, f"{context}: {pfx}\n{a}\nvs\n{b}"
+        assert sorted(map(repr, a.nexthops)) == sorted(map(repr, b.nexthops))
+        assert a.__dict__.keys() == b.__dict__.keys(), (context, pfx)
+
+
+@pytest.mark.parametrize("seed,kw", [(3, {}), (17, {}),
+                                     (42, {"enable_lfa": True})])
+def test_columnar_matches_eager_on_randomized_topologies(seed, kw):
+    """Property: for random topologies, the lazily-materialized columnar
+    RIB is byte-identical to the oracle's eagerly-built entries — cold,
+    after a delta patch, and after a full invalidation."""
+    rng = np.random.default_rng(seed)
+    adj_dbs, prefix_dbs = topologies.random_mesh(28, seed=seed)
+    states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    me = "node-0"
+    cpu = SpfSolver(me, **kw)
+    tpu = TpuSpfSolver(me, **kw)
+    tpu_db = tpu.build_route_db(me, states, ps)
+    assert isinstance(tpu_db.unicast_routes, LazyUnicastRoutes)
+    _assert_byte_identical(tpu_db, cpu.build_route_db(me, states, ps),
+                           f"cold seed={seed}")
+    # steady-state: a couple of metric flaps exercise the delta patch
+    # path (apply_rows) and the journal
+    for step in range(3):
+        victim = f"node-{int(rng.integers(1, 28))}"
+        _flap(states, adj_dbs, victim, metric=int(rng.integers(2, 30)))
+        tpu_db = tpu.build_route_db(me, states, ps)
+        _assert_byte_identical(
+            tpu_db, cpu.build_route_db(me, states, ps),
+            f"delta seed={seed} step={step} victim={victim}",
+        )
+
+
+def test_view_snapshots_isolated_from_churn():
+    """A RibView snapshot taken before churn must keep answering with
+    its own generation's routes (copy-on-write), even while the solver
+    patches the live columns underneath."""
+    adj_dbs, prefix_dbs = topologies.random_mesh(24, seed=7)
+    states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    me = "node-0"
+    tpu = TpuSpfSolver(me)
+    db1 = tpu.build_route_db(me, states, ps)
+    before = dict(db1.unicast_routes)  # force + snapshot
+    # drop node-3 entirely: its prefix route must disappear
+    states["0"].update_adjacency_database(
+        AdjacencyDatabase(this_node_name="node-3", adjacencies=(), area="0")
+    )
+    db2 = tpu.build_route_db(me, states, ps)
+    after = dict(db2.unicast_routes)
+    assert before != after, "churn did not change any route"
+    # the old db still answers with the old generation
+    assert dict(db1.unicast_routes) == before
+    # and per-key lookups on the stale view agree with its snapshot
+    for pfx in list(before)[:32]:
+        assert db1.unicast_routes[pfx] == before[pfx]
+
+
+def test_fast_unicast_diff_matches_brute_force():
+    """The journal-bounded diff must produce exactly the same update set
+    as the full per-entry compare."""
+    adj_dbs, prefix_dbs = topologies.random_mesh(24, seed=5)
+    states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    me = "node-0"
+    tpu = TpuSpfSolver(me)
+    db1 = tpu.build_route_db(me, states, ps)
+    _flap(states, adj_dbs, "node-4", metric=21)
+    db2 = tpu.build_route_db(me, states, ps)
+    res = fast_unicast_diff(db1.unicast_routes, db2.unicast_routes)
+    assert res is not None, "fast path did not engage"
+    to_update, dels = res
+    old, new = dict(db1.unicast_routes), dict(db2.unicast_routes)
+    brute_update = {
+        p: e for p, e in new.items()
+        if p not in old or old[p] != e
+    }
+    brute_dels = [p for p in old if p not in new]
+    assert to_update == brute_update
+    assert sorted(dels) == sorted(brute_dels)
+    # the Fib-facing entry point reports the fast path
+    upd = db1.calculate_update(db2)
+    assert getattr(upd, "fast_diff", False)
+    assert upd.unicast_routes_to_update == brute_update
+    assert sorted(upd.unicast_routes_to_delete) == sorted(brute_dels)
+
+
+def test_fast_diff_ineligible_pairs_fall_back():
+    """Foreign mappings and unrelated lazies must return None (callers
+    then run the full compare)."""
+    adj_dbs, prefix_dbs = topologies.random_mesh(20, seed=9)
+    states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    me = "node-0"
+    db = TpuSpfSolver(me).build_route_db(me, states, ps)
+    assert fast_unicast_diff({}, db.unicast_routes) is None
+    assert fast_unicast_diff(db.unicast_routes, {}) is None
+    # two independent solvers => distinct cribs => ineligible
+    other = TpuSpfSolver(me).build_route_db(me, states, ps)
+    assert fast_unicast_diff(db.unicast_routes,
+                             other.unicast_routes) is None
+
+
+def test_lazy_mapping_semantics():
+    """LazyUnicastRoutes is the dict DecisionRouteDb carries: overrides
+    shadow views, deletes hide keys, equality is value-based."""
+    adj_dbs, prefix_dbs = topologies.random_mesh(20, seed=13)
+    states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    me = "node-0"
+    lazy = TpuSpfSolver(me).build_route_db(me, states, ps).unicast_routes
+    plain = dict(lazy)
+    assert len(lazy) == len(plain)
+    assert set(lazy) == set(plain)
+    assert lazy == plain and plain == dict(lazy)
+    pfx = next(iter(plain))
+    assert pfx in lazy and lazy[pfx] == plain[pfx]
+    assert lazy.get("no-such-prefix/128") is None
+    # override shadows the view without changing cardinality
+    import dataclasses
+
+    patched = dataclasses.replace(plain[pfx], igp_cost=999_999)
+    lazy[pfx] = patched
+    assert lazy[pfx] is patched and len(lazy) == len(plain)
+    assert lazy != plain
+    # delete hides the key
+    del lazy[pfx]
+    assert pfx not in lazy and len(lazy) == len(plain) - 1
+    with pytest.raises(KeyError):
+        del lazy["no-such-prefix/128"]
+    # re-insert restores
+    lazy[pfx] = plain[pfx]
+    assert lazy == plain

@@ -871,3 +871,54 @@ class TestOperatorInjection:
             await client.close()
             await a.stop()
             await b.stop()
+
+
+def test_kv_compare_detects_value_and_ttl_divergence(monkeypatch):
+    """Regression: kv-compare used to key divergence on
+    (version, originator) alone — two stores agreeing on both but
+    holding different payloads (partition-heal conflict) or skewed
+    ttl_versions (refreshes not propagating) compared clean."""
+    import copy
+    import json
+
+    from openr_tpu.cli import breeze as bz
+
+    mine = {
+        "k-same": {"version": 3, "originator_id": "a",
+                   "value": {"__bytes__": "aabb"}, "ttl_ms": 90_000,
+                   "ttl_version": 1},
+        "k-val": {"version": 3, "originator_id": "a",
+                  "value": {"__bytes__": "aabb"}, "ttl_ms": 90_000,
+                  "ttl_version": 1},
+        "k-ttl": {"version": 3, "originator_id": "a",
+                  "value": None, "ttl_ms": 90_000, "ttl_version": 1},
+    }
+    theirs = copy.deepcopy(mine)
+    theirs["k-val"]["value"] = {"__bytes__": "ccdd"}
+    theirs["k-ttl"]["ttl_version"] = 7
+    # a pure ttl_ms countdown difference is NOT divergence
+    theirs["k-same"]["ttl_ms"] = 42_000
+
+    class StubClient:
+        def __init__(self, host, port, **kw):
+            self.port = port
+
+        async def request(self, method, params):
+            assert method == "ctrl.kvstore.dump"
+            return mine if self.port == 1111 else theirs
+
+        async def close(self):
+            pass
+
+    monkeypatch.setattr(bz, "RpcClient", StubClient)
+    runner = CliRunner()
+    res = runner.invoke(
+        bz.cli,
+        ["--port", "1111", "kvstore", "kv-compare",
+         "--nodes", "127.0.0.1:2222"],
+        obj={},
+    )
+    assert res.exit_code == 1, res.output
+    delta = json.loads(res.output)["127.0.0.1:2222"]
+    assert delta["diverged"] == ["k-ttl", "k-val"]
+    assert not delta["missing_here"] and not delta["missing_there"]

@@ -373,6 +373,117 @@ class TestNetlinkKernel:
             dp.nl.close()
 
 
+class _ScriptedNetlink:
+    """Records the exact order of kernel mutations; optionally fails
+    specific (op, prefix, metric) calls with an errno."""
+
+    def __init__(self, fail=()):
+        self.ops: list[tuple[str, str, int]] = []
+        self.fail = dict(fail)  # (op, prefix, metric) -> errno
+
+    async def _do(self, op, r):
+        self.ops.append((op, r.prefix, r.metric))
+        eno = self.fail.get((op, r.prefix, r.metric))
+        if eno is not None:
+            raise OSError(eno, os.strerror(eno))
+
+    async def add_route(self, r):
+        await self._do("add", r)
+
+    async def delete_route(self, r):
+        await self._do("del", r)
+
+    def close(self):
+        pass
+
+
+def _scripted_dataplane(fake):
+    from openr_tpu.platform.fib_handler import NetlinkDataplane
+
+    dp = NetlinkDataplane.__new__(NetlinkDataplane)
+    dp.table = 254
+    dp.nl = fake
+    dp._opened = True
+    dp.mpls = {}
+    dp._metric = {}
+    dp._stale = {}
+    dp.mpls_kernel = False
+    return dp
+
+
+class TestMakeBeforeBreak:
+    """Regression: a metric change must program the NEW-metric kernel
+    route before deleting the old-metric one — delete-first opens a
+    forwarding gap, and blackholes the prefix if the add then fails."""
+
+    NH = [{"address": "", "if_name": "", "weight": 0}]
+
+    @run_async
+    async def test_add_precedes_old_metric_delete(self):
+        fake = _ScriptedNetlink()
+        dp = _scripted_dataplane(fake)
+        p = "10.9.0.0/24"
+        assert not await dp.add_unicast({p: {"nexthops": self.NH,
+                                             "igp_cost": 10}})
+        assert not await dp.add_unicast({p: {"nexthops": self.NH,
+                                             "igp_cost": 20}})
+        assert fake.ops == [
+            ("add", p, 10), ("add", p, 20), ("del", p, 10)
+        ]
+        assert dp._metric[p] == 20 and not dp._stale
+
+    @run_async
+    async def test_failed_add_keeps_old_route_installed(self):
+        import errno
+
+        p = "10.9.1.0/24"
+        fake = _ScriptedNetlink(fail={("add", p, 20): errno.ENOBUFS})
+        dp = _scripted_dataplane(fake)
+        assert not await dp.add_unicast({p: {"nexthops": self.NH,
+                                             "igp_cost": 10}})
+        failed = await dp.add_unicast({p: {"nexthops": self.NH,
+                                           "igp_cost": 20}})
+        assert failed == [p]
+        # the old-metric route was never deleted: forwarding holds
+        assert ("del", p, 10) not in fake.ops
+        assert dp._metric[p] == 10
+
+    @run_async
+    async def test_failed_cleanup_parks_in_stale_ledger_and_retries(self):
+        import errno
+
+        p = "10.9.2.0/24"
+        fake = _ScriptedNetlink(fail={("del", p, 10): errno.EBUSY})
+        dp = _scripted_dataplane(fake)
+        assert not await dp.add_unicast({p: {"nexthops": self.NH,
+                                             "igp_cost": 10}})
+        failed = await dp.add_unicast({p: {"nexthops": self.NH,
+                                           "igp_cost": 20}})
+        # new route IS live; the prefix is reported failed only so the
+        # Fib actor retries the duplicate cleanup
+        assert failed == [p]
+        assert dp._metric[p] == 20 and dp._stale == {p: {10}}
+        fake.fail.clear()
+        assert not await dp.add_unicast({p: {"nexthops": self.NH,
+                                             "igp_cost": 20}})
+        assert fake.ops[-1] == ("del", p, 10)
+        assert not dp._stale
+
+    @run_async
+    async def test_withdraw_clears_stale_duplicates(self):
+        import errno
+
+        p = "10.9.3.0/24"
+        fake = _ScriptedNetlink(fail={("del", p, 10): errno.EBUSY})
+        dp = _scripted_dataplane(fake)
+        await dp.add_unicast({p: {"nexthops": self.NH, "igp_cost": 10}})
+        await dp.add_unicast({p: {"nexthops": self.NH, "igp_cost": 20}})
+        fake.fail.clear()
+        assert not await dp.delete_unicast([p])
+        assert {("del", p, 20), ("del", p, 10)} <= set(fake.ops)
+        assert not dp._metric and not dp._stale
+
+
 FAST_TIMERS = {
     "hello_time_s": 0.1,
     "fastinit_hello_time_ms": 30,

@@ -549,6 +549,66 @@ def test_ucmp_huge_adjacency_weight_falls_back_exactly():
     )
 
 
+def test_ucmp_zero_metric_edge_terminates_via_host_fallback():
+    """Regression (ISSUE 1): a live zero-metric edge makes BOTH of its
+    directions satisfy the DAG membership predicate (du + 0 == dv), so
+    the device fixpoint's "DAG" has a 2-cycle and used to oscillate in
+    an unbounded while_loop — a daemon hang. The edge set now flags
+    zero_w_unsafe and the exact host walk answers instead."""
+    states = ucmp_states()
+    ls = states["0"]
+    ls.update_adjacency_database(
+        adj_db("c", [adj("c", "a"), adj("c", "l1", metric=0)])
+    )
+    ls.update_adjacency_database(
+        adj_db("l1", [adj("l1", "c", metric=0), adj("l1", "d")])
+    )
+    ps = ucmp_prefix_state(
+        PrefixForwardingAlgorithm.SP_UCMP_PREFIX_WEIGHT_PROPAGATION
+    )
+    cpu = SpfSolver("r", enable_ucmp=True)
+    tpu = TpuSpfSolver("r", enable_ucmp=True)
+    cpu_db = cpu.build_route_db("r", states, ps)
+    tpu_db = tpu.build_route_db("r", states, ps)
+    assert_rib_equal(cpu_db, tpu_db, "zero-metric ucmp")
+    # fallback memoized as a sentinel: no device round trips attempted
+    assert tpu._ucmp_accel.results
+    assert all(
+        v is NotImplemented for v in tpu._ucmp_accel.results.values()
+    )
+
+
+def test_ucmp_device_fixpoint_bounded_on_zero_weight_cycle():
+    """Defense in depth behind zero_w_unsafe: feed the raw device
+    fixpoint a zero-weight 2-cycle whose weighted path counts grow every
+    round (changed never quiesces). The iteration bound must fire and
+    surface the non-convergence as overflow=True instead of hanging."""
+    from openr_tpu.ops.ucmp import INF_E, _ucmp_fn
+
+    e_cap = n_cap = 8
+    src = np.zeros(e_cap, np.int32)
+    dst = np.zeros(e_cap, np.int32)
+    w_eff = np.full(e_cap, INF_E, np.int32)
+    adj_w = np.zeros(e_cap, np.int32)
+    # 0 <-> 1 at weight 0 (the cycle), both feeding leaf 2 at weight 1
+    for i, (s, d, w) in enumerate(
+        [(0, 1, 0), (1, 0, 0), (0, 2, 1), (1, 2, 1)]
+    ):
+        src[i], dst[i], w_eff[i] = s, d, w
+    dist = np.full(n_cap, INF_E, np.int32)
+    dist[0] = dist[1] = 5
+    dist[2] = 6
+    leaf_mask = np.zeros(n_cap, bool)
+    leaf_mask[2] = True
+    leaf_w = np.zeros(n_cap, np.int32)
+    leaf_w[2] = 3
+    fn = _ucmp_fn(e_cap, n_cap, True)
+    _reach, _w, overflow = fn(
+        src, dst, w_eff, adj_w, dist, leaf_mask, leaf_w
+    )
+    assert bool(overflow)
+
+
 def test_prewarm_tool_bakes_cache(tmp_path):
     """openr-tpu-prewarm compiles a capacity class into the persistent
     cache (shapes only — correctness covered by the differentials).
