@@ -133,7 +133,12 @@ def bench_config(name, gen, me, runs=5, flap_victims=0, cpu_baseline=True,
     cold_db = tpu2.build_route_db(me, states, ps)
     res["full_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
     tm = getattr(tpu2, "last_timing", {})
-    res["full_breakdown"] = {k: round(v, 1) for k, v in tm.items()}
+    # last_timing also carries the per-area "areas" sub-dict (trace
+    # folding); the breakdown only wants the scalar stage timings
+    res["full_breakdown"] = {
+        k: round(v, 1) for k, v in tm.items()
+        if isinstance(v, (int, float))
+    }
     # consumption boundary: force every lazy entry in one bulk pass —
     # what Fib's first full sync pays on top of full_ms. The columnar
     # rebuild moved eager per-entry construction out of full_ms into
@@ -165,16 +170,32 @@ def bench_config(name, gen, me, runs=5, flap_victims=0, cpu_baseline=True,
         tpu.build_route_db(me, states, ps)
         samples.append((time.perf_counter() - t0) * 1e3)
         for k, v in getattr(tpu, "last_timing", {}).items():
-            phases.setdefault(k, []).append(v)
+            if isinstance(v, (int, float)):
+                phases.setdefault(k, []).append(v)
     tpu_ms = statistics.median(samples)
     res["tpu_ms"] = round(tpu_ms, 1)
+    # steady-state convergence latency distribution (same interpolation
+    # as the runtime stat fabric, so BENCH and monitor.statistics agree)
+    from openr_tpu.runtime.counters import _percentile
+
+    sv = sorted(samples)
+    res["convergence_ms"] = {
+        "p50": round(_percentile(sv, 50.0), 1),
+        "p99": round(_percentile(sv, 99.0), 1),
+    }
     for k in ("sync_ms", "exec_ms", "mat_ms"):
         phases.setdefault(k, [])
+    res["stage_percentiles"] = {}
     for k, vals in phases.items():
         # a phase absent from a run contributed 0 to it — backfill so
         # medians aren't computed over only the runs where it fired
         vals = vals + [0] * (runs - len(vals))
         res[k] = round(statistics.median(vals), 1)
+        pv = sorted(vals)
+        res["stage_percentiles"][k] = {
+            "p50": round(_percentile(pv, 50.0), 1),
+            "p99": round(_percentile(pv, 99.0), 1),
+        }
     res["changed_rows"] = tpu.last_device_stats.get("changed_rows")
     # device-only: chained dispatches, one blocking sync amortized —
     # what the chip does per solve, with the rig's fixed transfer RTT

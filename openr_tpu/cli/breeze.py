@@ -446,6 +446,14 @@ def received_routes(ctx) -> None:
     _print(_call(ctx, "ctrl.decision.received_routes"))
 
 
+@decision.command("convergence")
+@click.pass_context
+def decision_convergence(ctx) -> None:
+    """Per-event convergence latency: p50/p95/p99 over closed traces
+    plus the windowed convergence_ms stat."""
+    _print(_call(ctx, "ctrl.decision.convergence"))
+
+
 @decision.command("rib-policy")
 @click.option("--clear", is_flag=True, help="remove the active policy")
 @click.option(
@@ -848,8 +856,42 @@ def event_logs(ctx) -> None:
 @click.pass_context
 def statistics(ctx, prefix) -> None:
     """Multi-window stat view (ref breeze monitor statistics):
-    count/sum/avg/max over 60/600/3600 s per recorded stat."""
+    count/sum/avg/max/p50/p95/p99 over 60/600/3600 s per recorded
+    stat."""
     _print(_call(ctx, "monitor.statistics", {"prefix": prefix}))
+
+
+@monitor.command("spans")
+@click.option("--limit", default=20, help="most-recent traces to show")
+@click.option("--trace-id", default=None, type=int,
+              help="show one trace by id")
+@click.option("--active", is_flag=True, help="include unclosed traces")
+@click.pass_context
+def monitor_spans(ctx, limit, trace_id, active) -> None:
+    """Convergence traces: span trees of recent topology events
+    (kvstore receipt -> spf -> rib materialize -> fib -> platform)."""
+    _print(_call(ctx, "monitor.traces", {
+        "limit": limit, "trace_id": trace_id, "include_active": active,
+    }))
+
+
+@monitor.command("trace-export")
+@click.option("--limit", default=20, help="most-recent traces to export")
+@click.option("--trace-id", default=None, type=int,
+              help="export one trace by id")
+@click.option("--out", default="", help="write to a file instead of stdout")
+@click.pass_context
+def monitor_trace_export(ctx, limit, trace_id, out) -> None:
+    """Export traces as Chrome trace-event JSON — open the output in
+    chrome://tracing or ui.perfetto.dev."""
+    doc = _call(ctx, "monitor.traces.export_chrome",
+                {"limit": limit, "trace_id": trace_id})
+    if out:
+        with open(out, "w") as f:
+            json.dump(doc, f)
+        click.echo(f"wrote {len(doc.get('traceEvents', []))} events to {out}")
+    else:
+        click.echo(json.dumps(doc))
 
 
 @monitor.command("heap-profile")

@@ -166,6 +166,10 @@ class WatchdogConfig:
 class MonitorConfig:
     max_event_log_entries: int = 100
     enable_event_log_submission: bool = True
+    # convergence tracing (runtime/tracing.py): span per pipeline stage
+    # kvstore -> decision -> fib -> platform; off = no spans recorded
+    # and queue pushes carry no context (one comparison on the hot path)
+    enable_tracing: bool = True
 
 
 @dataclass

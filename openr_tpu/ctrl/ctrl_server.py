@@ -19,6 +19,7 @@ from openr_tpu.messaging import QueueClosedError, ReplicateQueue
 from openr_tpu.runtime.actor import Actor
 from openr_tpu.runtime.counters import counters
 from openr_tpu.runtime.rpc import RpcServer, Stream
+from openr_tpu.runtime.tracing import tracer
 from openr_tpu.serde import from_plain, to_plain
 from openr_tpu.types import InitializationEvent, Publication
 
@@ -78,6 +79,8 @@ class CtrlServer(Actor):
         s.register("openr.build_info", self._build_info)
         s.register("monitor.counters", self._counters)
         s.register("monitor.statistics", self._statistics)
+        s.register("monitor.traces", self._traces)
+        s.register("monitor.traces.export_chrome", self._traces_chrome)
         s.register("monitor.event_logs", self._event_logs)
         s.register("monitor.heap_profile.start", self._heap_profile_start)
         s.register("monitor.heap_profile.dump", self._heap_profile_dump)
@@ -121,6 +124,9 @@ class CtrlServer(Actor):
             s.register("ctrl.decision.get_rib_policy", self._get_rib_policy)
             s.register(
                 "ctrl.decision.clear_rib_policy", self._clear_rib_policy
+            )
+            s.register(
+                "ctrl.decision.convergence", self._decision_convergence
             )
         if self.fib is not None:
             s.register("ctrl.fib.routes", self._fib_routes)
@@ -209,6 +215,33 @@ class CtrlServer(Actor):
     async def _statistics(self, prefix: str = "") -> dict:
         """ref breeze monitor statistics: multi-window stat view."""
         return counters.get_statistics(prefix)
+
+    async def _traces(
+        self,
+        limit: int = 20,
+        trace_id: Optional[int] = None,
+        include_active: bool = False,
+    ) -> list:
+        """Closed convergence traces (runtime/tracing.py span trees)."""
+        return tracer.get_traces(
+            limit=limit, trace_id=trace_id, include_active=include_active
+        )
+
+    async def _traces_chrome(
+        self, trace_id: Optional[int] = None, limit: int = 20
+    ) -> dict:
+        """Chrome trace-event JSON for chrome://tracing / Perfetto."""
+        return tracer.export_chrome(trace_id=trace_id, limit=limit)
+
+    async def _decision_convergence(self) -> dict:
+        """Per-event convergence latency: percentile summary over the
+        closed-trace ring plus the windowed convergence_ms stat."""
+        return {
+            "summary": tracer.convergence_summary(),
+            "stat": counters.get_statistics("convergence_ms").get(
+                "convergence_ms", {}
+            ),
+        }
 
     async def _watch_initialization(self, queue: ReplicateQueue) -> None:
         reader = queue.get_reader(f"{self.name}.init")

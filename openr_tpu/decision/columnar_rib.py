@@ -48,6 +48,7 @@ import numpy as np
 from openr_tpu.decision.rib import NextHop, RibUnicastEntry
 from openr_tpu.decision.spf_solver import select_best_node_area
 from openr_tpu.ops.edgeplan import INF32E
+from openr_tpu.runtime.counters import counters
 
 INF_E = int(INF32E)
 _entry_new = object.__new__
@@ -468,11 +469,18 @@ class ColumnarRib:
         """Bulk-build every ok row (the consumption-boundary path)."""
         if self.materialized:
             return self.routes
+        import time as _time
+
+        t0 = _time.perf_counter()
         self.routes = {}
         rows = self.cols.key_rows()
         if len(rows):
             self._build_rows_into(self.cols, rows, self.routes)
         self.materialized = True
+        counters.add_stat_value(
+            "decision.crib.materialize_ms",
+            (_time.perf_counter() - t0) * 1e3,
+        )
         return self.routes
 
     def entry_for_row(self, r: int, bulk: bool = False):

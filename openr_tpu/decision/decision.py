@@ -43,6 +43,7 @@ from openr_tpu.runtime.actor import Actor
 from openr_tpu.serde import from_plain, to_plain
 from openr_tpu.runtime.counters import counters
 from openr_tpu.runtime.throttle import AsyncDebounce
+from openr_tpu.runtime.tracing import TraceContext, tracer
 from openr_tpu.serde import deserialize
 from openr_tpu.types import (
     Adjacency,
@@ -70,6 +71,10 @@ class PendingUpdates:
     updated_prefixes: set[str] = field(default_factory=set)
     count: int = 0
     perf_events: Optional[PerfEvents] = None
+    # at most ONE trace context survives debounce coalescing (first
+    # wins); later publications' contexts are closed as "coalesced" so
+    # a burst doesn't multiply spans across one rebuild
+    trace: Optional[TraceContext] = None
 
     def apply_link_state_change(
         self, change: LinkStateChange, node_name: str
@@ -88,6 +93,7 @@ class PendingUpdates:
         self.updated_prefixes = set()
         self.count = 0
         self.perf_events = None
+        self.trace = None
 
 
 def make_solver(
@@ -229,12 +235,24 @@ class Decision(Actor):
 
     def process_publication(self, pub: Publication) -> None:
         area = pub.area
-        for key, value in pub.key_vals.items():
-            if value.value is None:
-                continue  # ttl refresh only
-            self._update_key_in_lsdb(area, key, value.value)
-        for key in pub.expired_keys:
-            self._delete_key_from_lsdb(area, key)
+        ctx = tracer.context_of(pub)
+        before = self.pending.count
+        with tracer.span(ctx, "decision.lsdb_apply", node=self.node_name):
+            for key, value in pub.key_vals.items():
+                if value.value is None:
+                    continue  # ttl refresh only
+                self._update_key_in_lsdb(area, key, value.value)
+            for key in pub.expired_keys:
+                self._delete_key_from_lsdb(area, key)
+        if ctx is not None:
+            if self.pending.count == before:
+                # nothing route-relevant changed; close so the trace
+                # doesn't linger until eviction
+                tracer.end_trace(ctx, status="ignored")
+            elif self.pending.trace is None:
+                self.pending.trace = ctx
+            else:
+                tracer.end_trace(ctx, status="coalesced")
         if self.pending.count > 0:
             self._trigger_rebuild()
 
@@ -327,13 +345,20 @@ class Decision(Actor):
             return
         pending = self.pending
         self.pending = PendingUpdates()
+        ctx = pending.trace
+        full = pending.needs_full_rebuild or not self._first_build_done
         t0 = time.perf_counter()
 
-        if pending.needs_full_rebuild or not self._first_build_done:
+        spf_sp = tracer.start_span(
+            ctx, "decision.spf", node=self.node_name, full=full
+        )
+        if full:
             new_db = self.solver.build_route_db(
                 self.node_name, self.area_link_states, self.prefix_state
             )
             if new_db is None:
+                tracer.end_span(spf_sp)
+                tracer.end_trace(ctx, status="not_in_lsdb")
                 return  # we are not yet in the LSDB
         else:
             # incremental: recompute only changed prefixes
@@ -352,11 +377,21 @@ class Decision(Actor):
                     new_db.unicast_routes.pop(prefix, None)
                 else:
                     new_db.unicast_routes[prefix] = route
+        tracer.end_span(spf_sp)
+        counters.add_stat_value(
+            "decision.spf_ms", (time.perf_counter() - t0) * 1e3
+        )
+        self._fold_solver_timing(ctx, spf_sp)
 
-        if self.rib_policy is not None and self.rib_policy.is_active():
-            self.rib_policy.apply_policy(new_db.unicast_routes)
+        t_mat = time.perf_counter()
+        with tracer.span(ctx, "decision.rib_diff", node=self.node_name):
+            if self.rib_policy is not None and self.rib_policy.is_active():
+                self.rib_policy.apply_policy(new_db.unicast_routes)
 
-        update = self.route_db.calculate_update(new_db)
+            update = self.route_db.calculate_update(new_db)
+        counters.add_stat_value(
+            "decision.mat_ms", (time.perf_counter() - t_mat) * 1e3
+        )
         if getattr(update, "fast_diff", False):
             counters.increment("decision.fast_unicast_diffs")
         update.type = (
@@ -373,10 +408,38 @@ class Decision(Actor):
             perf = pending.perf_events or PerfEvents()
             add_perf_event(perf, self.node_name, "ROUTE_UPDATE")
             update.perf_events = perf
-            self._route_updates_q.push(update)
+            self._route_updates_q.push(update, trace=ctx)
+        else:
+            # rebuild produced no RIB delta: the event converged here
+            tracer.end_trace(ctx, status="no_change")
         if not self._first_build_done:
             self._first_build_done = True
             self._route_updates_q.push(InitializationEvent.RIB_COMPUTED)
+
+    def _fold_solver_timing(self, ctx, spf_sp) -> None:
+        """Fold the TPU pipeline's last_timing breakdown in as timed
+        children of decision.spf: per-area sync/exec/mat stages, laid
+        back-to-back ending at the span's end (the pipeline overlaps
+        stages across areas, so per-stage wall offsets are not
+        recoverable — durations are exact, placement is indicative)."""
+        if ctx is None or spf_sp is None:
+            return
+        tm = getattr(self.solver, "last_timing", None)
+        if not isinstance(tm, dict) or spf_sp.end is None:
+            return
+        areas = tm.get("areas") or {"": tm}
+        cursor = spf_sp.end
+        for area, stages in sorted(areas.items(), reverse=True):
+            for stage in ("mat_ms", "exec_ms", "sync_ms"):
+                d = stages.get(stage)
+                if not isinstance(d, (int, float)) or d <= 0:
+                    continue
+                name = f"tpu.{stage[:-3]}" + (f"[{area}]" if area else "")
+                tracer.record_span(
+                    ctx, name, cursor - d / 1e3, cursor,
+                    parent_id=spf_sp.span_id, area=area or None,
+                )
+                cursor -= d / 1e3
 
     # -- module API (role of semifuture_* Decision.h:154-195) --------------
 

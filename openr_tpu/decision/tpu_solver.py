@@ -73,6 +73,7 @@ from openr_tpu.decision.link_state import LinkState, NodeUcmpResult
 from openr_tpu.decision.prefix_state import PrefixState
 from openr_tpu.decision.rib import DecisionRouteDb
 from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.runtime.counters import counters
 from openr_tpu.ops.csr import (
     INF32,
     EllGraph,
@@ -862,6 +863,9 @@ class TpuSpfSolver:
             ls.has_node(my_node_name) for ls in area_link_states.values()
         ):
             return None
+        # reset per-solve so a CPU-delegated or deviceless build doesn't
+        # leave a previous solve's breakdown for timing consumers
+        self.last_timing = {}
         if all(
             ls.node_count() < self.small_graph_nodes
             for ls in area_link_states.values()
@@ -906,7 +910,7 @@ class TpuSpfSolver:
             # the worker pulls + scatters area k's result while the main
             # thread dispatches area k+1 and runs the host slow path —
             # sync/exec/mat pipeline across areas instead of serializing
-            futures.append(self._pool().submit(prepare))
+            futures.append((area, self._pool().submit(prepare)))
         # batch the per-destination second-pass SSSPs on device and prime
         # the k-paths cache; the oracle loop below then assembles KSP2
         # routes through its unchanged code path. Like the fast path,
@@ -934,7 +938,8 @@ class TpuSpfSolver:
         if futures:
             views = []
             stages = {"sync_ms": 0.0, "exec_ms": 0.0, "mat_ms": 0.0}
-            for fut in futures:
+            area_timing: dict[str, dict] = {}
+            for area, fut in futures:
                 res = fut.result()
                 views.append(res["view"])
                 stats = res["stats"]
@@ -942,6 +947,16 @@ class TpuSpfSolver:
                 self.last_device_stats = stats
                 for k, v in res["timing"].items():
                     stages[k] = stages.get(k, 0.0) + v
+                area_timing[area] = dict(res["timing"])
+                # per-area solve/materialize latency percentiles
+                # (the per-event stage timing ISSUE 2 reports against)
+                counters.add_stat_value(
+                    f"decision.area.{area}.spf_ms",
+                    res["timing"]["sync_ms"] + res["timing"]["exec_ms"],
+                )
+                counters.add_stat_value(
+                    f"decision.area.{area}.mat_ms", res["timing"]["mat_ms"]
+                )
             # device routes shadow host/static entries for the same
             # prefix — same override order as the seed's dict.update
             route_db.unicast_routes = LazyUnicastRoutes(
@@ -952,6 +967,7 @@ class TpuSpfSolver:
                 **stages,
                 "pipeline_wall_ms": wall,
                 "pipeline_stages_ms": sum(stages.values()),
+                "areas": area_timing,
                 **self._ksp2_timing,
             }
             self._ksp2_timing = {}

@@ -39,6 +39,7 @@ from openr_tpu.messaging import RQueue, ReplicateQueue
 from openr_tpu.runtime.actor import Actor
 from openr_tpu.runtime.counters import counters
 from openr_tpu.runtime.throttle import ExponentialBackoff
+from openr_tpu.runtime.tracing import TraceContext, tracer
 from openr_tpu.types import (
     InitializationEvent,
     PerfEvents,
@@ -110,6 +111,10 @@ class Fib(Actor):
         self._synced_signalled = False
         self._partial_sync_published = False
         self._pending_perf: Optional[PerfEvents] = None
+        # convergence trace awaiting the pass that actually programs
+        # (first wins; later ones close as "coalesced", like pending
+        # publications do in Decision)
+        self._pending_trace: Optional[TraceContext] = None
         # convergence perf-event ring (ref PerfDatabase)
         self.perf_db: collections.deque[PerfEvents] = collections.deque(
             maxlen=32
@@ -140,15 +145,21 @@ class Fib(Actor):
         self, upd: DecisionRouteUpdate
     ) -> None:
         rs = self.route_state
+        ctx = tracer.context_of(upd)
+        sp = tracer.start_span(ctx, "fib.diff", node=self.node_name)
         rs.update(upd)
         if upd.perf_events is not None:
             add_perf_event(upd.perf_events, self.node_name, "FIB_RECEIVED")
 
         if rs.state == FibState.AWAITING_UPDATE:
+            tracer.end_span(sp)
             if upd.type != RouteUpdateType.FULL_SYNC:
+                # folded into Decision's initial snapshot; not a
+                # convergence event of its own
+                tracer.end_trace(ctx, status="pre_sync")
                 return  # wait for Decision's initial snapshot
             rs.state = FibState.SYNCING
-            await self._sync_routes(upd.perf_events)
+            await self._sync_routes(upd.perf_events, trace=ctx)
             return
 
         # SYNCED (or SYNCING retry pending): program incrementally
@@ -162,13 +173,31 @@ class Fib(Actor):
             rs.dirty_labels[label] = now
         for label in upd.mpls_routes_to_delete:
             rs.dirty_labels[label] = now + delete_delay
+        tracer.end_span(sp)
         self._pending_perf = upd.perf_events
+        if ctx is not None:
+            if self._pending_trace is None:
+                self._pending_trace = ctx
+            else:
+                tracer.end_trace(ctx, status="coalesced")
         self._retry_signal.set()
 
     # -- full sync (ref syncRoutes) ----------------------------------------
 
-    async def _sync_routes(self, perf: Optional[PerfEvents] = None) -> None:
+    async def _sync_routes(
+        self,
+        perf: Optional[PerfEvents] = None,
+        trace: Optional[TraceContext] = None,
+    ) -> None:
         rs = self.route_state
+        if trace is None:
+            # retry path: adopt the pending trace so the sync that
+            # finally lands closes the right convergence event
+            trace, self._pending_trace = self._pending_trace, None
+        sp = tracer.start_span(
+            trace, "platform.program", node=self.node_name, mode="full_sync"
+        )
+        t_prog = time.monotonic()
         # both tables are always attempted — a partial unicast failure must
         # not leave pending MPLS routes unprogrammed (ref syncRoutes covers
         # both with retry)
@@ -184,6 +213,8 @@ class Fib(Actor):
         except Exception as e:
             log.warning("%s: syncFib failed: %s", self.name, e)
             counters.increment("fib.sync_fib_failure")
+            self._end_program(sp, t_prog, ok=False)
+            self._park_trace(trace)
             self._schedule_retry()
             return
         try:
@@ -196,6 +227,8 @@ class Fib(Actor):
         except Exception as e:
             log.warning("%s: syncMplsFib failed: %s", self.name, e)
             counters.increment("fib.sync_fib_failure")
+            self._end_program(sp, t_prog, ok=False)
+            self._park_trace(trace)
             # the unicast sync already ran: publish the unicast routes that
             # DID land as an INCREMENTAL delta (additive — it must not
             # claim snapshot completeness while the MPLS table state is
@@ -222,6 +255,7 @@ class Fib(Actor):
         if failed_p or failed_l:
             # partial: only the failed subset stays dirty; publish ONLY what
             # actually landed (FIB-ACK must never claim unprogrammed routes)
+            self._end_program(sp, t_prog, ok=False)
             now = time.monotonic()
             for p in failed_p:
                 rs.dirty_prefixes[p] = now
@@ -239,21 +273,42 @@ class Fib(Actor):
                     for label, r in rs.mpls_routes.items()
                     if label not in failed_l
                 },
+                trace=trace,
             )
             self._schedule_retry()
             return
+        self._end_program(sp, t_prog, ok=True)
         rs.dirty_prefixes.clear()
         rs.dirty_labels.clear()
         self._retry_backoff.report_success()
         self._finish_sync(
-            perf, unicast=dict(rs.unicast_routes), mpls=dict(rs.mpls_routes)
+            perf,
+            unicast=dict(rs.unicast_routes),
+            mpls=dict(rs.mpls_routes),
+            trace=trace,
         )
+
+    def _end_program(self, sp, t_prog: float, ok: bool) -> None:
+        tracer.end_span(sp, ok=ok)
+        counters.add_stat_value(
+            "fib.program_ms", (time.monotonic() - t_prog) * 1000.0
+        )
+
+    def _park_trace(self, trace: Optional[TraceContext]) -> None:
+        """Hold the trace for the retry that eventually programs."""
+        if trace is None:
+            return
+        if self._pending_trace is None:
+            self._pending_trace = trace
+        else:
+            tracer.end_trace(trace, status="coalesced")
 
     def _finish_sync(
         self,
         perf: Optional[PerfEvents],
         unicast: dict[str, RibUnicastEntry],
         mpls: dict[int, RibMplsEntry],
+        trace: Optional[TraceContext] = None,
     ) -> None:
         rs = self.route_state
         rs.state = FibState.SYNCED
@@ -266,6 +321,7 @@ class Fib(Actor):
                 mpls_routes_to_update=mpls,
             ),
             perf,
+            trace=trace,
         )
         if not self._synced_signalled:
             self._synced_signalled = True
@@ -312,6 +368,12 @@ class Fib(Actor):
         now = time.monotonic()
         perf = self._pending_perf
         self._pending_perf = None
+        ctx = self._pending_trace
+        self._pending_trace = None
+        sp = tracer.start_span(
+            ctx, "platform.program", node=self.node_name, mode="incremental"
+        )
+        t_prog = now
 
         add_prefixes = [
             p
@@ -409,8 +471,13 @@ class Fib(Actor):
             log.warning("%s: delete_mpls failed: %s", self.name, e)
             ok = False
 
+        self._end_program(sp, t_prog, ok=ok)
         if not programmed.empty():
-            self._publish_programmed(programmed, perf)
+            self._publish_programmed(programmed, perf, trace=ctx)
+        else:
+            # nothing landed this pass (backoff / delayed deletes not
+            # due): hold the trace for the pass that actually programs
+            self._park_trace(ctx)
         if ok:
             self._retry_backoff.report_success()
         else:
@@ -419,7 +486,10 @@ class Fib(Actor):
     # -- programmed-delta publication (FIB-ACK) ----------------------------
 
     def _publish_programmed(
-        self, programmed: DecisionRouteUpdate, perf: Optional[PerfEvents]
+        self,
+        programmed: DecisionRouteUpdate,
+        perf: Optional[PerfEvents],
+        trace: Optional[TraceContext] = None,
     ) -> None:
         if perf is not None:
             add_perf_event(perf, self.node_name, "FIB_PROGRAMMED")
@@ -443,7 +513,14 @@ class Fib(Actor):
                     )
                 )
         counters.increment("fib.routes_programmed")
-        self._fib_updates_q.push(programmed)
+        self._fib_updates_q.push(programmed, trace=trace)
+        # programming ack published: the topology event has converged
+        tracer.end_trace(
+            trace,
+            status="ok",
+            routes=len(programmed.unicast_routes_to_update)
+            + len(programmed.unicast_routes_to_delete),
+        )
 
     # -- agent liveness (ref Fib::keepAlive) -------------------------------
 

@@ -46,6 +46,7 @@ from openr_tpu.runtime.actor import Actor
 from openr_tpu.runtime.counters import counters
 from openr_tpu.runtime.rpc import RpcClient, RpcServer
 from openr_tpu.runtime.throttle import ExponentialBackoff
+from openr_tpu.runtime.tracing import tracer
 from openr_tpu.serde import from_plain, to_plain
 from openr_tpu.types import (
     AreaPeerEvent,
@@ -379,6 +380,7 @@ class KvStore(Actor):
     # -- merge + publish + flood (ref mergePublication KvStore.cpp:3394) ---
 
     def _merge_and_flood(self, pub: Publication, sender_id: str = "") -> None:
+        t0 = time.monotonic()
         st = self.areas[pub.area]
         stats = MergeStats()
         updates = merge_key_values(st.kv, pub.key_vals, stats=stats)
@@ -411,12 +413,28 @@ class KvStore(Actor):
             node_ids=list(pub.node_ids),
             area=pub.area,
         )
-        self._publish_local(out)
+        # trace root: one topology event enters here and carries a single
+        # trace_id through decision -> fib -> platform programming ack
+        ctx = tracer.start_trace(
+            "convergence",
+            start=t0,
+            node=self.node_name,
+            area=pub.area,
+            origin=sender_id or "local",
+            num_keys=len(updates),
+            num_expired=len(pub.expired_keys),
+        )
+        if ctx is not None:
+            tracer.record_span(
+                ctx, "kvstore.publication", t0, time.monotonic(),
+                node=self.node_name, sender=sender_id or "local",
+            )
+        self._publish_local(out, trace=ctx)
         if updates:
             self._flood(st, out, sender_id=sender_id)
 
-    def _publish_local(self, pub: Publication) -> None:
-        self._updates_q.push(pub)
+    def _publish_local(self, pub: Publication, trace=None) -> None:
+        self._updates_q.push(pub, trace=trace)
 
     def _flood(self, st: KvStoreArea, pub: Publication, sender_id: str) -> None:
         """Fan out to INITIALIZED peers not already on the publication's
@@ -470,6 +488,7 @@ class KvStore(Actor):
             self._reset_peer(st, peer)
             return
         try:
+            t0 = time.monotonic()
             await peer.client.request(
                 "kvstore.set_key_vals",
                 {
@@ -477,6 +496,9 @@ class KvStore(Actor):
                     "publication": to_plain(pub),
                     "sender_id": self.node_name,
                 },
+            )
+            counters.add_stat_value(
+                "kvstore.flood_ms", (time.monotonic() - t0) * 1000.0
             )
             counters.increment(f"kvstore.{self.node_name}.thrift.num_flood_sent")
         except asyncio.CancelledError:

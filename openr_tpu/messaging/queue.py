@@ -18,6 +18,10 @@ from typing import Generic, TypeVar
 
 T = TypeVar("T")
 
+# bound on first traced push (runtime.__init__ -> actor -> messaging
+# makes a top-level import circular); None until tracing is ever used
+_tracer = None
+
 
 class QueueClosedError(RuntimeError):
     """Raised from get() once the queue is closed and drained
@@ -85,10 +89,21 @@ class ReplicateQueue(Generic[T]):
         self._readers.append(r)
         return r
 
-    def push(self, item: T) -> int:
-        """Replicate to every reader; returns replication count."""
+    def push(self, item: T, trace=None) -> int:
+        """Replicate to every reader; returns replication count.
+
+        `trace` (a runtime.tracing.TraceContext) rides along in the
+        tracer's side-table so consumers can pick it up with
+        tracing.context_of(item); when tracing is off producers pass
+        None and this costs one comparison."""
         if self._closed:
             raise QueueClosedError(self.name)
+        if trace is not None:
+            global _tracer
+            if _tracer is None:
+                from openr_tpu.runtime.tracing import tracer as _t
+                _tracer = _t
+            _tracer.attach(item, trace)
         self._writes += 1
         for r in self._readers:
             r._push(item)

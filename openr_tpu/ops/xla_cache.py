@@ -25,6 +25,48 @@ log = logging.getLogger(__name__)
 
 _DISABLE = ("0", "off", "none", "disabled")
 _applied: str | None = None
+_monitoring_hooked = False
+
+# jax._src.monitoring event names -> our counter fabric keys. The cache
+# hit/miss split is what tells an operator whether a slow cold start
+# was a cache wipe or genuinely new shapes.
+_EVENT_COUNTERS = {
+    "/jax/compilation_cache/cache_hits": "xla_cache.hits",
+    "/jax/compilation_cache/cache_misses": "xla_cache.misses",
+    "/jax/compilation_cache/compile_requests_use_cache": (
+        "xla_cache.requests"
+    ),
+    "/jax/compilation_cache/tasks_using_cache": "xla_cache.tasks",
+    "/jax/compilation_cache/task_disabled_cache": "xla_cache.disabled",
+}
+
+
+def _hook_cache_monitoring() -> bool:
+    """Forward jax's compilation-cache monitoring events into the
+    counter fabric (xla_cache.hits / xla_cache.misses / ...). Uses the
+    private jax._src.monitoring listener registry — gated so a jax
+    without it just skips the counters. Idempotent."""
+    global _monitoring_hooked
+    if _monitoring_hooked:
+        return True
+    try:
+        from jax._src import monitoring
+    except Exception:  # pragma: no cover - depends on jax internals
+        return False
+
+    from openr_tpu.runtime.counters import counters
+
+    def _on_event(event: str, **kwargs) -> None:
+        key = _EVENT_COUNTERS.get(event)
+        if key is not None:
+            counters.increment(key)
+
+    try:
+        monitoring.register_event_listener(_on_event)
+    except Exception:  # pragma: no cover
+        return False
+    _monitoring_hooked = True
+    return True
 
 
 def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
@@ -55,5 +97,6 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
         log.warning("compilation cache unavailable (%s); compiling cold", e)
         _applied = ""
         return None
+    _hook_cache_monitoring()
     _applied = d
     return d

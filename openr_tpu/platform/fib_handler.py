@@ -27,6 +27,7 @@ from typing import Optional
 
 from openr_tpu.decision.rib import RibMplsEntry, RibUnicastEntry
 from openr_tpu.fib.fib_service import FibServiceBase, FibUpdateError
+from openr_tpu.runtime.counters import counters
 from openr_tpu.runtime.rpc import RpcClient, RpcServer
 from openr_tpu.serde import to_plain
 
@@ -529,17 +530,33 @@ class FibPlatformServer:
         await self.rpc.stop()
 
     # -- handlers ----------------------------------------------------------
+    # each stamps the agent-side dataplane latency (the "program ack"
+    # stage of a convergence trace, seen from the server)
 
     async def _add_unicast(self, client_id: int, routes: dict) -> dict:
+        t0 = time.monotonic()
         failed = await self.dataplane.add_unicast(routes)
+        counters.add_stat_value(
+            "platform.fib.update_ms", (time.monotonic() - t0) * 1e3
+        )
+        counters.increment("platform.fib.routes_added", len(routes))
         return {"failed_prefixes": failed}
 
     async def _del_unicast(self, client_id: int, prefixes: list) -> dict:
+        t0 = time.monotonic()
         failed = await self.dataplane.delete_unicast(prefixes)
+        counters.add_stat_value(
+            "platform.fib.update_ms", (time.monotonic() - t0) * 1e3
+        )
+        counters.increment("platform.fib.routes_deleted", len(prefixes))
         return {"failed_prefixes": failed}
 
     async def _sync_fib(self, client_id: int, routes: dict) -> dict:
+        t0 = time.monotonic()
         failed = await self.dataplane.sync_unicast(routes)
+        counters.add_stat_value(
+            "platform.fib.sync_ms", (time.monotonic() - t0) * 1e3
+        )
         return {"failed_prefixes": failed}
 
     async def _add_mpls(self, client_id: int, routes: dict) -> dict:

@@ -9,7 +9,9 @@ the ctrl API and the monitor module.
 
 from __future__ import annotations
 
+import bisect
 import collections
+import math
 import threading
 import time
 from typing import Optional
@@ -48,33 +50,46 @@ class _Stat:
         )
 
 
+def _percentile(sorted_vals: list, q: float) -> float:
+    """numpy-style linear interpolation (method="linear") so tests can
+    compare against np.percentile bit-for-bit on the same samples."""
+    n = len(sorted_vals)
+    if not n:
+        return 0.0
+    idx = (q / 100.0) * (n - 1)
+    lo, hi = math.floor(idx), math.ceil(idx)
+    if lo == hi:
+        return float(sorted_vals[lo])
+    frac = idx - lo
+    return sorted_vals[lo] * (1.0 - frac) + sorted_vals[hi] * frac
+
+
 def _aggregate_windows(samples: list, maxlen: int, windows: tuple) -> dict:
     now = time.monotonic()
-    # ascending cutoff = largest window first; once a sample is too
-    # old for a window it is too old for every smaller one -> break
-    cutoffs = sorted((now - w, w) for w in windows)
-    acc = {w: {"count": 0, "sum": 0.0, "max": None} for _, w in cutoffs}
-    for ts, v in samples:
-        for cutoff, w in cutoffs:
-            if ts < cutoff:
-                break
-            a = acc[w]
-            a["count"] += 1
-            a["sum"] += v
-            if a["max"] is None or v > a["max"]:
-                a["max"] = v
+    # samples arrive via time.monotonic() so the ring is time-ordered:
+    # each window's members are a suffix, found by bisect on the ts
+    # column; quantiles then sort just that suffix once per window
+    ts_col = [ts for ts, _ in samples]
+    vals = [v for _, v in samples]
     full = len(samples) == maxlen
-    oldest = samples[0][0] if samples else now
+    oldest = ts_col[0] if ts_col else now
     out = {}
-    for cutoff, w in cutoffs:
-        a = acc[w]
+    for w in sorted(windows):
+        cutoff = now - w
+        sub = vals[bisect.bisect_left(ts_col, cutoff):]
+        n = len(sub)
+        total = sum(sub)
+        ordered = sorted(sub)
         out[str(int(w))] = {
-            "count": a["count"],
-            "sum": a["sum"],
+            "count": n,
+            "sum": total,
             # empty window reports 0.0 (matches windowed()); a window
             # of negative samples reports its true maximum
-            "max": a["max"] if a["max"] is not None else 0.0,
-            "avg": (a["sum"] / a["count"]) if a["count"] else 0.0,
+            "max": ordered[-1] if n else 0.0,
+            "avg": (total / n) if n else 0.0,
+            "p50": _percentile(ordered, 50.0),
+            "p95": _percentile(ordered, 95.0),
+            "p99": _percentile(ordered, 99.0),
             "truncated": full and oldest > cutoff,
         }
     return out
@@ -102,7 +117,10 @@ class CounterRegistry:
             st.add(value)
 
     def get_counter(self, key: str) -> Optional[float]:
-        return self._counters.get(key)
+        # lock held: solver worker threads increment concurrently and a
+        # dict resize mid-read is a torn view on some interpreters
+        with self._lock:
+            return self._counters.get(key)
 
     def get_statistics(
         self, prefix: str = "", windows: tuple = (60.0, 600.0, 3600.0)

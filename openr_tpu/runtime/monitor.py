@@ -29,15 +29,30 @@ from openr_tpu.config import MonitorConfig, WatchdogConfig
 from openr_tpu.messaging import ReplicateQueue, RQueue
 from openr_tpu.runtime.actor import Actor
 from openr_tpu.runtime.counters import counters
+from openr_tpu.runtime.tracing import tracer
 
 log = logging.getLogger(__name__)
 
 # ru_maxrss units differ by platform: Linux reports KB, macOS bytes
 _RSS_DIVISOR = 1024.0 * 1024.0 if sys.platform == "darwin" else 1024.0
+_PAGE_SIZE = resource.getpagesize()
 
 
 def rss_mb() -> float:
+    """PEAK resident set (high-water mark) — ru_maxrss never decreases.
+    Right for the Watchdog memory ceiling; wrong for a live gauge."""
     return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / _RSS_DIVISOR
+
+
+def current_rss_mb() -> float:
+    """Current resident set from /proc/self/statm field 2 (resident
+    pages); falls back to the peak where procfs is unavailable."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * _PAGE_SIZE / (1024.0 * 1024.0)
+    except (OSError, IndexError, ValueError):
+        return rss_mb()
 
 
 @dataclass
@@ -80,6 +95,10 @@ class Monitor(Actor):
             maxlen=config.max_event_log_entries
         )
         self._process_start = time.monotonic()
+        # the monitor owns the observability config, so the tracing
+        # kill-switch rides on it (ISSUE: disabled tracing must cost no
+        # more than a dict lookup per queue push)
+        tracer.configure(enabled=config.enable_tracing)
 
     async def on_start(self) -> None:
         self.add_task(self._log_loop(), name=f"{self.name}.logs")
@@ -96,7 +115,8 @@ class Monitor(Actor):
         """Process gauges (role of SystemMetrics.{h,cpp})."""
         while True:
             usage = resource.getrusage(resource.RUSAGE_SELF)
-            counters.set_counter("process.memory.rss_mb", rss_mb())
+            counters.set_counter("process.memory.rss_mb", current_rss_mb())
+            counters.set_counter("process.memory.max_rss_mb", rss_mb())
             counters.set_counter(
                 "process.cpu.total_s", usage.ru_utime + usage.ru_stime
             )
@@ -228,13 +248,22 @@ class Watchdog(Actor):
     def _export_queue_stats(self) -> None:
         for q in self._watched_queues:
             stats = q.stats()
+            base = f"messaging.queue.{stats['name']}"
+            counters.set_counter(f"{base}.max_depth", stats["max_depth"])
+            counters.set_counter(f"{base}.writes", stats["writes"])
+            # per-reader depth/reads + replica count: a wedged reader
+            # (depth growing, reads flat) is visible here long before
+            # the thread-timeout crash fires
             counters.set_counter(
-                f"messaging.queue.{stats['name']}.max_depth",
-                stats["max_depth"],
+                f"{base}.replicas", len(stats["readers"])
             )
-            counters.set_counter(
-                f"messaging.queue.{stats['name']}.writes", stats["writes"]
-            )
+            for r in stats["readers"]:
+                counters.set_counter(
+                    f"{base}.reader.{r['name']}.depth", r["depth"]
+                )
+                counters.set_counter(
+                    f"{base}.reader.{r['name']}.reads", r["reads"]
+                )
 
     def _fire(self, reason: str) -> None:
         if self.fired is None:

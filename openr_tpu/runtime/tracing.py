@@ -1,0 +1,469 @@
+"""Convergence tracing fabric — spans + trace-context propagation.
+
+Role of the perf-event breadcrumbs the reference threads through
+thrift::PerfEvents (Decision.cpp addPerfEvent, Fib.cpp logPerfEvents),
+generalised into a proper span tree: one topology event entering
+KvStore carries a single trace_id through decision → tpu_solver →
+columnar RIB materialization → fib → platform programming ack, and the
+closed trace exports as Chrome trace-event JSON (chrome://tracing /
+Perfetto).
+
+Design constraints:
+- Process-wide singleton (like runtime.counters.counters) because the
+  pipeline crosses actor and thread boundaries (the TPU solver's
+  "rib-mat" worker thread records materialization spans).
+- The queue items (Publication, DecisionRouteUpdate) are mutable
+  dataclasses with eq=True — unhashable — so the context rides in a
+  side-table keyed by id(item), cleaned up by weakref.finalize. Items
+  that are not weakref-able simply don't carry context.
+- Opt-out cheap: with tracing disabled start_trace returns None and
+  every other entry point takes the None fast path (one attribute
+  check); context_of is one dict lookup.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import math
+import os
+import threading
+import time
+import weakref
+from typing import Any, Optional
+
+from openr_tpu.runtime.counters import counters
+
+# ring of closed traces kept for monitor.traces / export
+MAX_CLOSED_TRACES = 256
+# safety valve: a trace that never closes (e.g. FIB never acks because
+# the platform is down) must not leak — oldest active is force-closed
+# with status "evicted" once this many are in flight
+MAX_ACTIVE_TRACES = 256
+
+
+class Span:
+    """One timed stage. start/end are time.monotonic() seconds; the
+    tracer's wall-clock anchor maps them to epoch µs at export time."""
+
+    __slots__ = (
+        "span_id", "trace_id", "parent_id", "name",
+        "start", "end", "attributes", "thread",
+    )
+
+    def __init__(
+        self,
+        span_id: int,
+        trace_id: int,
+        name: str,
+        start: float,
+        parent_id: Optional[int] = None,
+        attributes: Optional[dict] = None,
+        thread: str = "",
+    ):
+        self.span_id = span_id
+        self.trace_id = trace_id
+        self.parent_id = parent_id
+        self.name = name
+        self.start = start
+        self.end: Optional[float] = None
+        self.attributes: dict = attributes or {}
+        self.thread = thread
+
+    @property
+    def duration_ms(self) -> Optional[float]:
+        if self.end is None:
+            return None
+        return (self.end - self.start) * 1000.0
+
+    def to_dict(self) -> dict:
+        return {
+            "span_id": self.span_id,
+            "trace_id": self.trace_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "start": self.start,
+            "end": self.end,
+            "duration_ms": self.duration_ms,
+            "attributes": dict(self.attributes),
+            "thread": self.thread,
+        }
+
+
+class TraceContext:
+    """Lightweight handle that rides through the queues. Only identity
+    lives here; span storage is in the tracer so any thread can add."""
+
+    __slots__ = ("trace_id", "root_span_id")
+
+    def __init__(self, trace_id: int, root_span_id: int):
+        self.trace_id = trace_id
+        self.root_span_id = root_span_id
+
+    def __repr__(self) -> str:  # breeze-friendly
+        return f"TraceContext(trace_id={self.trace_id})"
+
+
+class _Trace:
+    __slots__ = ("trace_id", "name", "spans", "status", "started", "ended")
+
+    def __init__(self, trace_id: int, name: str, started: float):
+        self.trace_id = trace_id
+        self.name = name
+        self.spans: list[Span] = []
+        self.status = "active"
+        self.started = started
+        self.ended: Optional[float] = None
+
+
+class _NullSpan:
+    """No-op context manager handed out when tracing is off or the
+    context is None — hot paths need no branches beyond `with`."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> None:
+        return None
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def set(self, **attrs) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _LiveSpan:
+    """Context manager wrapping an open Span; closes it on exit."""
+
+    __slots__ = ("_tracer", "span")
+
+    def __init__(self, tracer: "Tracer", span: Span):
+        self._tracer = tracer
+        self.span = span
+
+    def __enter__(self) -> "_LiveSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc_type is not None:
+            self.span.attributes["error"] = repr(exc)
+        self._tracer.end_span(self.span)
+        return False
+
+    def set(self, **attrs) -> None:
+        self.span.attributes.update(attrs)
+
+
+class Tracer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.enabled = True
+        self._trace_seq = itertools.count(1)
+        self._span_seq = itertools.count(1)
+        self._active: dict[int, _Trace] = {}
+        self._closed: "list[_Trace]" = []
+        # side-table: id(item) -> TraceContext, scrubbed by finalizers
+        self._ctx_by_id: dict[int, TraceContext] = {}
+        # anchor for monotonic -> wall-clock µs mapping in exports
+        self._wall_anchor = time.time()
+        self._mono_anchor = time.monotonic()
+
+    # -- config -----------------------------------------------------------
+
+    def configure(self, enabled: bool) -> None:
+        self.enabled = enabled
+
+    # -- context propagation (messaging/queue.py) -------------------------
+
+    def attach(self, item: Any, ctx: Optional[TraceContext]) -> bool:
+        """Associate ctx with a queue item. Returns False when the item
+        cannot carry context (not weakref-able) or ctx is None."""
+        if ctx is None:
+            return False
+        key = id(item)
+        try:
+            weakref.finalize(item, self._ctx_by_id.pop, key, None)
+        except TypeError:
+            return False
+        self._ctx_by_id[key] = ctx
+        return True
+
+    def context_of(self, item: Any) -> Optional[TraceContext]:
+        """One dict lookup; safe on any object."""
+        return self._ctx_by_id.get(id(item))
+
+    def detach(self, item: Any) -> Optional[TraceContext]:
+        return self._ctx_by_id.pop(id(item), None)
+
+    # -- span lifecycle ---------------------------------------------------
+
+    def start_trace(
+        self, name: str, start: Optional[float] = None, **attributes
+    ) -> Optional[TraceContext]:
+        """Open a new trace; returns None when tracing is disabled so
+        producers can pass the context straight through push(trace=...).
+        `start` (time.monotonic()) backdates the root to cover work
+        already done when the producer decides the event is traceworthy."""
+        if not self.enabled:
+            return None
+        now = start if start is not None else time.monotonic()
+        with self._lock:
+            trace_id = next(self._trace_seq)
+            span_id = next(self._span_seq)
+            root = Span(
+                span_id, trace_id, name, now,
+                attributes=dict(attributes),
+                thread=threading.current_thread().name,
+            )
+            tr = _Trace(trace_id, name, now)
+            tr.spans.append(root)
+            self._active[trace_id] = tr
+            evicted = None
+            if len(self._active) > MAX_ACTIVE_TRACES:
+                oldest_id = min(
+                    self._active, key=lambda t: self._active[t].started
+                )
+                evicted = self._active.pop(oldest_id)
+        if evicted is not None:
+            self._finish(evicted, now, status="evicted")
+        return TraceContext(trace_id, span_id)
+
+    def start_span(
+        self,
+        ctx: Optional[TraceContext],
+        name: str,
+        parent_id: Optional[int] = None,
+        **attributes,
+    ) -> Optional[Span]:
+        if ctx is None or not self.enabled:
+            return None
+        now = time.monotonic()
+        with self._lock:
+            tr = self._active.get(ctx.trace_id)
+            if tr is None:
+                return None
+            span = Span(
+                next(self._span_seq), ctx.trace_id, name, now,
+                parent_id=parent_id or ctx.root_span_id,
+                attributes=dict(attributes),
+                thread=threading.current_thread().name,
+            )
+            tr.spans.append(span)
+            return span
+
+    def end_span(self, span: Optional[Span], **attributes) -> None:
+        if span is None:
+            return
+        span.end = time.monotonic()
+        if attributes:
+            span.attributes.update(attributes)
+
+    def span(
+        self,
+        ctx: Optional[TraceContext],
+        name: str,
+        parent_id: Optional[int] = None,
+        **attributes,
+    ):
+        """`with tracer.span(ctx, "decision.spf"): ...` — no-op when ctx
+        is None / tracing off."""
+        sp = self.start_span(ctx, name, parent_id, **attributes)
+        if sp is None:
+            return _NULL_SPAN
+        return _LiveSpan(self, sp)
+
+    def record_span(
+        self,
+        ctx: Optional[TraceContext],
+        name: str,
+        start: float,
+        end: float,
+        parent_id: Optional[int] = None,
+        **attributes,
+    ) -> Optional[Span]:
+        """Retroactively add an already-timed stage (e.g. folding the
+        TPU solver's last_timing sync/exec/mat breakdown). start/end are
+        time.monotonic() seconds."""
+        if ctx is None or not self.enabled:
+            return None
+        with self._lock:
+            tr = self._active.get(ctx.trace_id)
+            if tr is None:
+                return None
+            span = Span(
+                next(self._span_seq), ctx.trace_id, name, start,
+                parent_id=parent_id or ctx.root_span_id,
+                attributes=dict(attributes),
+                thread=threading.current_thread().name,
+            )
+            span.end = end
+            tr.spans.append(span)
+            return span
+
+    def end_trace(
+        self, ctx: Optional[TraceContext], status: str = "ok", **attributes
+    ) -> None:
+        """Close the root span, move the trace to the closed ring, and
+        stamp the end-to-end convergence_ms stat (status "ok" only —
+        coalesced/no_change closures are not convergence events)."""
+        if ctx is None:
+            return
+        now = time.monotonic()
+        with self._lock:
+            tr = self._active.pop(ctx.trace_id, None)
+        if tr is None:
+            return
+        if attributes:
+            tr.spans[0].attributes.update(attributes)
+        self._finish(tr, now, status=status)
+
+    def _finish(self, tr: _Trace, now: float, status: str) -> None:
+        root = tr.spans[0]
+        if root.end is None:
+            root.end = now
+        tr.ended = now
+        tr.status = status
+        root.attributes.setdefault("status", status)
+        with self._lock:
+            self._closed.append(tr)
+            if len(self._closed) > MAX_CLOSED_TRACES:
+                del self._closed[: len(self._closed) - MAX_CLOSED_TRACES]
+        if status == "ok":
+            counters.add_stat_value(
+                "convergence_ms", (now - tr.started) * 1000.0
+            )
+            counters.increment("tracing.traces_closed")
+        else:
+            counters.increment(f"tracing.traces_{status}")
+
+    # -- introspection (ctrl server / breeze) -----------------------------
+
+    def get_traces(
+        self,
+        limit: int = 20,
+        trace_id: Optional[int] = None,
+        include_active: bool = False,
+    ) -> list[dict]:
+        with self._lock:
+            picked: list[_Trace] = list(self._closed)
+            if include_active:
+                picked += list(self._active.values())
+        if trace_id is not None:
+            picked = [t for t in picked if t.trace_id == trace_id]
+        picked = picked[-max(1, limit):]
+        return [
+            {
+                "trace_id": t.trace_id,
+                "name": t.name,
+                "status": t.status,
+                "duration_ms": (
+                    (t.ended - t.started) * 1000.0
+                    if t.ended is not None else None
+                ),
+                "num_spans": len(t.spans),
+                "spans": [s.to_dict() for s in t.spans],
+            }
+            for t in picked
+        ]
+
+    def export_chrome(
+        self, trace_id: Optional[int] = None, limit: int = 20
+    ) -> dict:
+        """Chrome trace-event JSON (the `{"traceEvents": [...]}` object
+        form): one "X" complete event per closed span with ts/dur in
+        wall-clock µs, plus "M" thread_name metadata rows. Load in
+        chrome://tracing or ui.perfetto.dev."""
+        with self._lock:
+            picked = [
+                t for t in self._closed
+                if trace_id is None or t.trace_id == trace_id
+            ][-max(1, limit):]
+            wall0, mono0 = self._wall_anchor, self._mono_anchor
+        pid = os.getpid()
+        tids: dict[str, int] = {}
+        events: list[dict] = []
+        for t in picked:
+            for s in t.spans:
+                if s.end is None:
+                    continue
+                tid = tids.setdefault(s.thread or "main", len(tids) + 1)
+                ts_us = (wall0 + (s.start - mono0)) * 1e6
+                events.append({
+                    "name": s.name,
+                    "cat": t.name,
+                    "ph": "X",
+                    "ts": ts_us,
+                    "dur": max(0.0, (s.end - s.start) * 1e6),
+                    "pid": pid,
+                    "tid": tid,
+                    "args": {
+                        "trace_id": s.trace_id,
+                        "span_id": s.span_id,
+                        "parent_id": s.parent_id,
+                        **{
+                            k: v for k, v in s.attributes.items()
+                            if isinstance(v, (str, int, float, bool))
+                            or v is None
+                        },
+                    },
+                })
+        meta = [
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": tid,
+                "args": {"name": thread},
+            }
+            for thread, tid in sorted(tids.items(), key=lambda kv: kv[1])
+        ]
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+    def export_chrome_json(
+        self, trace_id: Optional[int] = None, limit: int = 20
+    ) -> str:
+        return json.dumps(self.export_chrome(trace_id, limit))
+
+    def convergence_summary(self) -> dict:
+        """p50/p95/p99/max over the closed-trace ring (status ok) —
+        the per-event incremental-convergence view DeltaPath measures."""
+        with self._lock:
+            raw = [
+                (t.ended - t.started) * 1000.0
+                for t in self._closed
+                if t.status == "ok" and t.ended is not None
+            ]
+        durs = sorted(raw)
+        n = len(durs)
+
+        def pct(q: float) -> float:
+            if not n:
+                return 0.0
+            idx = (q / 100.0) * (n - 1)
+            lo, hi = math.floor(idx), math.ceil(idx)
+            if lo == hi:
+                return float(durs[lo])
+            frac = idx - lo
+            return durs[lo] * (1.0 - frac) + durs[hi] * frac
+
+        return {
+            "count": n,
+            "p50_ms": pct(50.0),
+            "p95_ms": pct(95.0),
+            "p99_ms": pct(99.0),
+            "max_ms": durs[-1] if n else 0.0,
+            "last_ms": raw[-1] if n else 0.0,
+        }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._active.clear()
+            self._closed.clear()
+            self._ctx_by_id.clear()
+
+
+# the process-wide instance (pattern of runtime.counters.counters)
+tracer = Tracer()

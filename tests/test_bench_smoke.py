@@ -33,6 +33,16 @@ def test_bench_config_smoke_device_path():
     for k in ("sync_ms", "exec_ms", "mat_ms", "tpu_ms"):
         assert k in res, (k, res)
     assert res["changed_rows"] is not None
+    # breakdown values must stay scalars even though last_timing now
+    # carries the per-area "areas" sub-dict for trace folding
+    assert all(isinstance(v, (int, float)) for v in bd.values()), bd
+    # convergence latency distribution + per-stage percentiles (ISSUE 2)
+    conv = res["convergence_ms"]
+    assert conv["p50"] > 0 and conv["p99"] >= conv["p50"], conv
+    sp = res["stage_percentiles"]
+    for k in ("sync_ms", "exec_ms", "mat_ms"):
+        assert {"p50", "p99"} <= set(sp[k]), (k, sp)
+        assert sp[k]["p99"] >= sp[k]["p50"], (k, sp)
 
 
 def test_bench_config_small_graph_delegation_still_reports():

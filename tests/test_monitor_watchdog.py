@@ -46,8 +46,26 @@ class TestMonitor:
             )
             assert counters.get_counter("process.memory.rss_mb") > 0
             assert counters.get_counter("process.uptime_s") is not None
+            # the live gauge and the high-water mark are distinct
+            # counters; current can never (meaningfully) exceed peak
+            max_rss = counters.get_counter("process.memory.max_rss_mb")
+            assert max_rss is not None and max_rss > 0
+            assert (
+                counters.get_counter("process.memory.rss_mb")
+                <= max_rss * 1.05
+            )
         finally:
             await mon.stop()
+
+    def test_current_rss_is_live_not_peak(self):
+        """ru_maxrss is a high-water mark; the live gauge must come
+        from /proc/self/statm and sit at or under the peak."""
+        from openr_tpu.runtime.monitor import current_rss_mb, rss_mb
+
+        cur, peak = current_rss_mb(), rss_mb()
+        assert cur > 0 and peak > 0
+        # small slop: the peak snapshot races the current read
+        assert cur <= peak * 1.05, (cur, peak)
 
 
 class TestWatchdog:
@@ -109,6 +127,18 @@ class TestWatchdog:
                 lambda: counters.get_counter("messaging.queue.testq.max_depth")
                 == 7
             )
+            # per-reader visibility: a wedged reader (depth growing,
+            # reads flat) must be observable from the counter fabric
+            base = "messaging.queue.testq"
+            assert counters.get_counter(f"{base}.replicas") == 1
+            assert counters.get_counter(f"{base}.reader.r.depth") == 7
+            assert counters.get_counter(f"{base}.reader.r.reads") == 0
+            for _ in range(3):
+                await reader.get()
+            await wait_until(
+                lambda: counters.get_counter(f"{base}.reader.r.reads") == 3
+            )
+            assert counters.get_counter(f"{base}.reader.r.depth") == 4
         finally:
             await wd.stop()
 

@@ -1,0 +1,374 @@
+"""Convergence tracing fabric tests (runtime/tracing.py).
+
+Three layers: Tracer unit semantics (span trees, disabled fast path,
+eviction), context propagation through ReplicateQueue and through a
+real multi-node in-process daemon, and the export surfaces (Chrome
+trace-event schema, percentile math vs numpy).
+"""
+
+import gc
+import json
+import random
+
+from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.runtime.counters import CounterRegistry, _percentile
+from openr_tpu.runtime.tracing import Tracer, tracer
+from tests.conftest import run_async
+
+
+class _Item:
+    """Weakref-able stand-in for a queue payload."""
+
+
+class TestTracerUnit:
+    def test_span_tree_closes_ok(self):
+        t = Tracer()
+        ctx = t.start_trace("convergence", node="n0", origin="local")
+        assert ctx is not None
+        with t.span(ctx, "decision.spf", node="n0") as sp:
+            sp.set(full=True)
+        t.record_span(ctx, "tpu.exec", 1.0, 1.5, area="0")
+        t.end_trace(ctx, status="ok", routes=3)
+        (tr,) = t.get_traces()
+        assert tr["status"] == "ok"
+        assert tr["duration_ms"] >= 0
+        names = [s["name"] for s in tr["spans"]]
+        assert names == ["convergence", "decision.spf", "tpu.exec"]
+        root = tr["spans"][0]
+        assert root["attributes"]["routes"] == 3
+        # children default-parent to the root span
+        for s in tr["spans"][1:]:
+            assert s["parent_id"] == root["span_id"]
+        spf = tr["spans"][1]
+        assert spf["attributes"]["full"] is True
+        assert spf["duration_ms"] is not None and spf["duration_ms"] >= 0
+        exec_sp = tr["spans"][2]
+        assert abs(exec_sp["duration_ms"] - 500.0) < 1e-6
+
+    def test_disabled_is_null_path(self):
+        t = Tracer()
+        t.configure(enabled=False)
+        assert t.start_trace("convergence") is None
+        assert t.attach(_Item(), None) is False
+        # every entry point must take the None fast path silently
+        with t.span(None, "x") as sp:
+            assert sp is None
+        t.end_span(None)
+        t.end_trace(None)
+        assert t.get_traces() == []
+        t.configure(enabled=True)
+        assert t.start_trace("convergence") is not None
+
+    def test_non_ok_statuses_do_not_count_convergence(self):
+        t = Tracer()
+        for status in ("coalesced", "no_change", "ignored"):
+            ctx = t.start_trace("convergence")
+            t.end_trace(ctx, status=status)
+        assert [tr["status"] for tr in t.get_traces()] == [
+            "coalesced", "no_change", "ignored"
+        ]
+        assert t.convergence_summary()["count"] == 0
+
+    def test_active_trace_eviction_valve(self):
+        from openr_tpu.runtime import tracing
+
+        t = Tracer()
+        for _ in range(tracing.MAX_ACTIVE_TRACES + 1):
+            t.start_trace("convergence")
+        evicted = [
+            tr for tr in t.get_traces(limit=1000) if tr["status"] == "evicted"
+        ]
+        assert len(evicted) == 1
+        # the oldest trace (trace_id 1) is the one sacrificed
+        assert evicted[0]["trace_id"] == 1
+
+    def test_convergence_summary_percentiles(self):
+        t = Tracer()
+        ctxs = [t.start_trace("convergence") for _ in range(40)]
+        for ctx in ctxs:
+            t.end_trace(ctx, status="ok")
+        summary = t.convergence_summary()
+        assert summary["count"] == 40
+        assert summary["p50_ms"] <= summary["p95_ms"] <= summary["p99_ms"]
+        assert summary["p99_ms"] <= summary["max_ms"]
+
+
+class TestQueuePropagation:
+    @run_async
+    async def test_context_rides_replicate_queue(self):
+        q = ReplicateQueue("trace-test")
+        reader = q.get_reader("r0")
+        ctx = tracer.start_trace("convergence", node="n0")
+        item = _Item()
+        q.push(item, trace=ctx)
+        got = await reader.get()
+        assert got is item
+        assert tracer.context_of(got) is ctx
+        tracer.end_trace(ctx, status="ok")
+        q.close()
+
+    @run_async
+    async def test_push_without_trace_leaves_no_entry(self):
+        q = ReplicateQueue("trace-test-2")
+        reader = q.get_reader("r0")
+        item = _Item()
+        q.push(item)
+        got = await reader.get()
+        assert tracer.context_of(got) is None
+        q.close()
+
+    @run_async
+    async def test_side_table_scrubbed_on_gc(self):
+        q = ReplicateQueue("trace-test-3")
+        reader = q.get_reader("r0")
+        ctx = tracer.start_trace("convergence", node="n0")
+        item = _Item()
+        key = id(item)
+        q.push(item, trace=ctx)
+        got = await reader.get()
+        tracer.end_trace(ctx, status="ok")
+        del item, got
+        gc.collect()
+        assert key not in tracer._ctx_by_id
+        q.close()
+
+
+class TestQuantileMath:
+    def test_percentile_matches_numpy(self):
+        import numpy as np
+
+        rng = random.Random(42)
+        vals = [rng.uniform(0.1, 500.0) for _ in range(257)]
+        ordered = sorted(vals)
+        for q in (50.0, 95.0, 99.0, 0.0, 100.0, 37.5):
+            ours = _percentile(ordered, q)
+            theirs = float(np.percentile(vals, q))
+            assert abs(ours - theirs) < 1e-9, (q, ours, theirs)
+
+    def test_stat_windows_report_percentiles(self):
+        import numpy as np
+
+        reg = CounterRegistry()
+        rng = random.Random(7)
+        vals = [rng.uniform(1.0, 100.0) for _ in range(100)]
+        for v in vals:
+            reg.add_stat_value("lat_ms", v)
+        win = reg.get_statistics("lat_ms")["lat_ms"]["3600"]
+        assert win["count"] == 100
+        for q, key in ((50.0, "p50"), (95.0, "p95"), (99.0, "p99")):
+            assert abs(win[key] - float(np.percentile(vals, q))) < 1e-9
+        assert win["max"] == max(vals)
+
+    def test_empty_stat_window_is_zeroed(self):
+        reg = CounterRegistry()
+        reg.add_stat_value("once", 5.0)
+        win = reg.get_statistics("once")["once"]["3600"]
+        assert win["p50"] == win["p95"] == win["p99"] == 5.0
+
+
+class TestChromeExport:
+    def test_export_schema(self):
+        t = Tracer()
+        ctx = t.start_trace("convergence", node="n0", origin="local")
+        with t.span(ctx, "decision.spf"):
+            pass
+        t.record_span(ctx, "tpu.exec", 1.0, 1.25, area="0")
+        t.end_trace(ctx, status="ok")
+        doc = json.loads(t.export_chrome_json())
+        assert doc["displayTimeUnit"] == "ms"
+        events = doc["traceEvents"]
+        metas = [e for e in events if e["ph"] == "M"]
+        xs = [e for e in events if e["ph"] == "X"]
+        assert metas and all(e["name"] == "thread_name" for e in metas)
+        assert len(xs) == 3  # root + 2 children
+        for e in xs:
+            assert isinstance(e["ts"], float) and e["ts"] > 0
+            assert isinstance(e["dur"], float) and e["dur"] >= 0
+            assert e["pid"] and e["tid"]
+            assert e["cat"] == "convergence"
+            assert "trace_id" in e["args"] and "span_id" in e["args"]
+        # only closed spans export: an active trace contributes nothing
+        ctx2 = t.start_trace("convergence")
+        doc2 = t.export_chrome()
+        assert len([e for e in doc2["traceEvents"] if e["ph"] == "X"]) == 3
+        t.end_trace(ctx2, status="ok")
+
+    def test_export_filters_by_trace_id(self):
+        t = Tracer()
+        c1 = t.start_trace("convergence")
+        t.end_trace(c1, status="ok")
+        c2 = t.start_trace("convergence")
+        t.end_trace(c2, status="ok")
+        doc = t.export_chrome(trace_id=c1.trace_id)
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert len(xs) == 1 and xs[0]["args"]["trace_id"] == c1.trace_id
+
+
+class TestTwoNodeTracePropagation:
+    """ISSUE acceptance: one topology event entering node-a's KvStore
+    must carry a single trace_id kvstore -> decision -> fib on the node
+    whose routes change — across ReplicateQueues inside a real two-node
+    in-process daemon."""
+
+    @run_async
+    async def test_one_trace_spans_pipeline(self):
+        from openr_tpu.kvstore.wrapper import wait_until
+        from openr_tpu.runtime.openr_wrapper import OpenrWrapper
+        from openr_tpu.spark import MockIoMesh
+
+        tracer.clear()
+        mesh = MockIoMesh()
+        kv_ports: dict[str, int] = {}
+        a = OpenrWrapper("node-a", mesh.provider("node-a"), kv_ports)
+        b = OpenrWrapper("node-b", mesh.provider("node-b"), kv_ports)
+        mesh.connect("node-a", "if-ab", "node-b", "if-ba")
+        await a.start("if-ab")
+        await b.start("if-ba")
+        try:
+            b.advertise_prefix("10.7.0.0/24")
+            await wait_until(
+                lambda: "10.7.0.0/24" in a.fib_routes, timeout_s=20
+            )
+
+            def node_a_ok_traces():
+                return [
+                    tr for tr in tracer.get_traces(limit=200)
+                    if tr["status"] == "ok"
+                    and tr["spans"][0]["attributes"].get("node") == "node-a"
+                ]
+
+            # the FIB ack (end_trace) can land just after the route shows
+            # up in fib_routes — wait for the closure too
+            await wait_until(lambda: len(node_a_ok_traces()) > 0,
+                             timeout_s=10)
+            tr = node_a_ok_traces()[-1]
+            names = {s["name"] for s in tr["spans"]}
+            assert "convergence" in names
+            assert "kvstore.publication" in names
+            assert "decision.spf" in names
+            assert "fib.diff" in names
+            assert "platform.program" in names
+            # every span belongs to the one trace
+            ids = {s["trace_id"] for s in tr["spans"]}
+            assert ids == {tr["trace_id"]}
+        finally:
+            for w in (a, b):
+                await w.stop()
+
+
+class TestSystemConvergenceTrace:
+    """ISSUE acceptance (system): 3-node topology, one link-metric
+    change -> a single closed trace with >= 5 pipeline stages on the
+    rerouting node; its Chrome JSON parses; monitor.statistics (ctrl)
+    reports a non-zero decision.spf_ms p99."""
+
+    @run_async
+    async def test_link_metric_change_single_trace(self):
+        from openr_tpu.kvstore.wrapper import wait_until
+        from openr_tpu.runtime.openr_wrapper import OpenrWrapper
+        from openr_tpu.runtime.rpc import RpcClient
+        from openr_tpu.spark import MockIoMesh
+
+        mesh = MockIoMesh()
+        kv_ports: dict[str, int] = {}
+        names = ["node-0", "node-1", "node-2"]
+        nodes = {
+            n: OpenrWrapper(
+                n, mesh.provider(n), kv_ports,
+                enable_ctrl=(n == "node-0"),
+            )
+            for n in names
+        }
+        links = [
+            ("node-0", "if-01", "node-1", "if-10"),
+            ("node-1", "if-12", "node-2", "if-21"),
+            ("node-2", "if-20", "node-0", "if-02"),
+        ]
+        for x, ifx, y, ify in links:
+            mesh.connect(x, ifx, y, ify)
+        ifaces = {n: [] for n in names}
+        for x, ifx, y, ify in links:
+            ifaces[x].append(ifx)
+            ifaces[y].append(ify)
+        for n, w in nodes.items():
+            await w.start(*ifaces[n])
+        try:
+            for i, n in enumerate(names):
+                nodes[n].advertise_prefix(f"10.0.0.{i + 1}/32")
+            await wait_until(
+                lambda: all(
+                    f"10.0.0.{j + 1}/32" in nodes[n].fib_routes
+                    for n in names
+                    for j in range(3)
+                    if names[j] != n
+                ),
+                timeout_s=20,
+            )
+            # direct next hop before the change
+            entry = nodes["node-0"].fib_routes["10.0.0.2/32"]
+            assert {nh.neighbor_node_name for nh in entry.nexthops} == {
+                "node-1"
+            }
+
+            # quiesce, then ONE topology event: node-0's link to node-1
+            # becomes expensive, so node-0 must reroute via node-2
+            tracer.clear()
+            await nodes["node-0"].link_monitor.set_link_metric("if-01", 100)
+
+            def rerouted():
+                e = nodes["node-0"].fib_routes.get("10.0.0.2/32")
+                return e is not None and {
+                    nh.neighbor_node_name for nh in e.nexthops
+                } == {"node-2"}
+
+            await wait_until(rerouted, timeout_s=20)
+
+            def node0_ok_traces():
+                return [
+                    tr for tr in tracer.get_traces(limit=200)
+                    if tr["status"] == "ok"
+                    and tr["spans"][0]["attributes"].get("node") == "node-0"
+                ]
+
+            await wait_until(lambda: len(node0_ok_traces()) > 0,
+                             timeout_s=10)
+            oks = node0_ok_traces()
+            # the one metric change produces exactly one convergence
+            # event on node-0 (debounce coalesces, echo floods are no-ops)
+            assert len(oks) == 1, [t["trace_id"] for t in oks]
+            tr = oks[0]
+            assert tr["num_spans"] >= 5, [s["name"] for s in tr["spans"]]
+            assert tr["duration_ms"] > 0
+
+            # Chrome export of that trace parses and carries its spans
+            doc = json.loads(
+                tracer.export_chrome_json(trace_id=tr["trace_id"])
+            )
+            xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+            assert len(xs) == tr["num_spans"]
+
+            # ctrl surface: monitor.statistics has a non-zero spf p99,
+            # and the convergence endpoint reflects the closed trace
+            client = RpcClient("127.0.0.1", nodes["node-0"].ctrl.port)
+            try:
+                stats = await client.request(
+                    "monitor.statistics", {"prefix": "decision.spf_ms"}
+                )
+                assert stats["decision.spf_ms"]["3600"]["p99"] > 0
+                conv = await client.request("ctrl.decision.convergence")
+                assert conv["summary"]["count"] >= 1
+                assert conv["summary"]["p99_ms"] > 0
+                chrome = await client.request(
+                    "monitor.traces.export_chrome",
+                    {"trace_id": tr["trace_id"]},
+                )
+                assert chrome["traceEvents"]
+                listed = await client.request(
+                    "monitor.traces", {"trace_id": tr["trace_id"]}
+                )
+                assert listed and listed[0]["trace_id"] == tr["trace_id"]
+            finally:
+                await client.close()
+        finally:
+            for w in nodes.values():
+                await w.stop()
