@@ -197,6 +197,14 @@ def bench_config(name, gen, me, runs=5, flap_victims=0, cpu_baseline=True,
             "p99": round(_percentile(pv, 99.0), 1),
         }
     res["changed_rows"] = tpu.last_device_stats.get("changed_rows")
+    # peak HBM across devices at end of the churn loop — None on backends
+    # (cpu) that don't expose memory_stats()
+    from openr_tpu.runtime.device_stats import peak_hbm_mb
+
+    peak_mb, backend = peak_hbm_mb()
+    res["backend"] = backend
+    if peak_mb is not None:
+        res["peak_hbm_mb"] = round(peak_mb, 1)
     # device-only: chained dispatches, one blocking sync amortized —
     # what the chip does per solve, with the rig's fixed transfer RTT
     # (rig_rtt_ms) excluded

@@ -845,10 +845,21 @@ def counters(ctx, prefix) -> None:
 
 
 @monitor.command("logs")
+@click.option("--category", default=None,
+              help="filter by event name, prefix, or sample category")
 @click.pass_context
-def event_logs(ctx) -> None:
+def event_logs(ctx, category) -> None:
     """Sampled event logs (ref getEventLogs)."""
-    _print(_call(ctx, "monitor.event_logs"))
+    _print(_call(ctx, "ctrl.monitor.logs", {"category": category}))
+
+
+@monitor.command("fleet")
+@click.pass_context
+def monitor_fleet(ctx) -> None:
+    """Fleet health: every node's monitor:health:<node> advertisement
+    as seen from this node's KvStore — watchdog state, worst queue
+    depth, convergence p99, HBM in use, sentinel anomalies."""
+    _print(_call(ctx, "ctrl.monitor.fleet"))
 
 
 @monitor.command("statistics")
@@ -914,6 +925,52 @@ def heap_profile(ctx, action, stop, top) -> None:
     else:
         _print(_call(ctx, "monitor.heap_profile.dump",
                      {"top": top, "stop": stop}))
+
+
+# -- tpu --------------------------------------------------------------------
+
+@cli.group()
+def tpu() -> None:
+    """Device-plane observability (profiler, kernels, HBM)."""
+
+
+@tpu.command("profile")
+@click.option("--seconds", default=5.0, type=float,
+              help="capture duration")
+@click.option("--out", "out_dir", default="",
+              help="trace output directory (default: server-side tmpdir)")
+@click.pass_context
+def tpu_profile(ctx, seconds, out_dir) -> None:
+    """Capture a JAX profiler trace on the node: starts the trace,
+    waits --seconds client-side, stops it, and prints the trace
+    directory (open in TensorBoard / xprof)."""
+    import time as _time
+
+    started = _call(ctx, "ctrl.tpu.profiler.start",
+                    {"out_dir": out_dir or None})
+    if not started.get("ok", True):
+        _print(started)
+        raise SystemExit(1)
+    click.echo(f"capturing to {started.get('out_dir')} "
+               f"for {seconds:.1f} s ...")
+    _time.sleep(seconds)
+    _print(_call(ctx, "ctrl.tpu.profiler.stop"))
+
+
+@tpu.command("kernels")
+@click.pass_context
+def tpu_kernels(ctx) -> None:
+    """XLA kernel cost ledger joined with achieved solver timings:
+    estimated FLOPs/bytes per compiled pipeline plus achieved
+    GFLOP/s and GB/s from the last solve."""
+    _print(_call(ctx, "ctrl.tpu.kernels"))
+
+
+@tpu.command("devices")
+@click.pass_context
+def tpu_devices(ctx) -> None:
+    """Per-device HBM gauges + live-buffer census."""
+    _print(_call(ctx, "ctrl.tpu.devices"))
 
 
 # -- tech-support -----------------------------------------------------------

@@ -123,6 +123,11 @@ class DecisionConfig:
     # recompilation (ops/xla_cache.py). "" = default resolution
     # ($OPENR_TPU_XLA_CACHE, then ~/.cache/openr_tpu/xla); "off" disables.
     xla_cache_dir: str = ""
+    # numerical-health sentinels (decision/tpu_solver.py): cheap
+    # on-device reductions after each exec counting unreachable rows,
+    # metric-overflow saturation, and bad UCMP weights; anomalies feed
+    # counters + a LogSample + a span attribute. Kill-switch, default on.
+    enable_numerical_sentinels: bool = True
     # capacity classes for static-shape padding (ops/csr.py)
     max_nodes_hint: int = 0  # 0 = grow on demand
 
@@ -170,6 +175,15 @@ class MonitorConfig:
     # kvstore -> decision -> fib -> platform; off = no spans recorded
     # and queue pushes carry no context (one comparison on the hot path)
     enable_tracing: bool = True
+    # device-plane gauges (runtime/device_stats.py): per-device HBM
+    # in-use/peak/allocs + live-array census, polled every metrics
+    # interval. No-op where jax was never imported or the backend keeps
+    # no memory accounting (CPU).
+    enable_device_telemetry: bool = True
+    # advertise this node's health card into KvStore as a TTL'd
+    # monitor:health:<node> key so `breeze monitor fleet` reads every
+    # node from any node
+    enable_fleet_health: bool = True
 
 
 @dataclass

@@ -82,8 +82,17 @@ class CtrlServer(Actor):
         s.register("monitor.traces", self._traces)
         s.register("monitor.traces.export_chrome", self._traces_chrome)
         s.register("monitor.event_logs", self._event_logs)
+        s.register("ctrl.monitor.logs", self._event_logs)
+        s.register("ctrl.monitor.fleet", self._monitor_fleet)
         s.register("monitor.heap_profile.start", self._heap_profile_start)
         s.register("monitor.heap_profile.dump", self._heap_profile_dump)
+        # device plane (runtime/device_stats.py + ops/xla_cache.ledger):
+        # all of these degrade gracefully on CPU-only hosts
+        s.register("ctrl.tpu.profiler.start", self._tpu_profiler_start)
+        s.register("ctrl.tpu.profiler.stop", self._tpu_profiler_stop)
+        s.register("ctrl.tpu.profiler.status", self._tpu_profiler_status)
+        s.register("ctrl.tpu.kernels", self._tpu_kernels)
+        s.register("ctrl.tpu.devices", self._tpu_devices)
         s.register("ctrl.store.set", self._store_set)
         s.register("ctrl.store.get", self._store_get)
         s.register("ctrl.store.erase", self._store_erase)
@@ -303,11 +312,128 @@ class CtrlServer(Actor):
 
         return await dump_heap_profile(int(top), bool(stop))
 
-    async def _event_logs(self) -> list:
-        """ref getEventLogs — Monitor's LogSample ring."""
+    async def _event_logs(self, category: Optional[str] = None) -> list:
+        """ref getEventLogs — Monitor's LogSample ring, optionally
+        filtered by event category (exact event, dotted prefix, or
+        values["category"])."""
         if self.monitor is None:
             return []
-        return await self.monitor.get_event_logs()
+        return await self.monitor.get_event_logs(category=category)
+
+    # -- device plane ------------------------------------------------------
+
+    async def _tpu_profiler_start(
+        self,
+        seconds: Optional[float] = None,
+        out_dir: Optional[str] = None,
+    ) -> dict:
+        """On-demand XLA trace capture from the live daemon. Single-
+        flight (the profiler is process-global); `seconds` arms an
+        auto-stop so an abandoned capture cannot run forever."""
+        from openr_tpu.runtime import device_stats
+
+        try:
+            return device_stats.profiler_start(
+                out_dir or None,
+                float(seconds) if seconds else None,
+            )
+        except RuntimeError as e:
+            return {"ok": False, "error": str(e)}
+
+    async def _tpu_profiler_stop(self) -> dict:
+        from openr_tpu.runtime import device_stats
+
+        try:
+            return device_stats.profiler_stop()
+        except RuntimeError as e:
+            return {"ok": False, "error": str(e)}
+
+    async def _tpu_profiler_status(self) -> dict:
+        from openr_tpu.runtime import device_stats
+
+        return device_stats.profiler_status()
+
+    async def _tpu_devices(self) -> dict:
+        """Per-device memory snapshot + live-array census (gauges'
+        structured twin). backend="cpu" with bare device entries is the
+        graceful no-HBM-accounting answer."""
+        from openr_tpu.runtime import device_stats
+
+        return device_stats.export_device_gauges()
+
+    async def _tpu_kernels(self) -> dict:
+        """The kernel cost ledger joined with the solver's measured
+        exec times: per instrumented executable, compile cost + XLA's
+        estimated flops/bytes; per area, the last solve's achieved
+        throughput against the kernel that ran it."""
+        from openr_tpu.ops.xla_cache import ledger
+        from openr_tpu.runtime import device_stats
+
+        kernels = ledger.snapshot()
+        solver = (
+            getattr(self.decision, "solver", None)
+            if self.decision is not None
+            else None
+        )
+        last_timing = getattr(solver, "last_timing", None) or {}
+        achieved: list[dict] = []
+        for area, stages in (last_timing.get("areas") or {}).items():
+            kname = stages.get("kernel")
+            exec_ms = stages.get("exec_ms")
+            entry = kernels.get(kname)
+            if not kname or entry is None or not exec_ms:
+                continue
+            row = {
+                "area": area,
+                "kernel": kname,
+                "exec_ms": round(exec_ms, 3),
+            }
+            # exec_ms includes the result pull, so achieved numbers are
+            # a lower bound on raw kernel throughput
+            flops = entry.get("flops")
+            if flops:
+                row["estimated_gflops"] = round(flops / 1e9, 6)
+                row["achieved_gflops_s"] = round(
+                    flops / (exec_ms / 1e3) / 1e9, 3
+                )
+            nbytes = entry.get("bytes_accessed")
+            if nbytes:
+                row["achieved_gb_s"] = round(
+                    nbytes / (exec_ms / 1e3) / 1e9, 3
+                )
+            achieved.append(row)
+        return {
+            "backend": device_stats.collect_device_stats()["backend"],
+            "kernels": kernels,
+            "achieved": achieved,
+            "last_timing": last_timing,
+            "sentinels": getattr(solver, "last_sentinels", None) or {},
+        }
+
+    async def _monitor_fleet(self) -> dict:
+        """Every node's TTL'd `monitor:health:<node>` card as flooded
+        into KvStore — fleet health from any single node's ctrl port.
+        A node missing here either never advertised or let its TTL
+        lapse (both triage-worthy)."""
+        import json as _json
+
+        nodes: dict[str, dict] = {}
+        if self.kvstore is not None:
+            for area in list(getattr(self.kvstore, "areas", None) or []):
+                vals = await self.kvstore.dump_all(area, "monitor:health:")
+                for key, val in vals.items():
+                    node = key[len("monitor:health:"):]
+                    try:
+                        card = _json.loads(val.value.decode())
+                    except (ValueError, UnicodeDecodeError):
+                        card = {"error": "unparseable health payload"}
+                    cur = nodes.get(node)
+                    if (
+                        cur is None
+                        or card.get("ts_ms", 0) > cur.get("ts_ms", 0)
+                    ):
+                        nodes[node] = card
+        return {"local_node": self.node_name, "nodes": nodes}
 
     # -- persistent config store (ref setConfigKey/getConfigKey/eraseConfigKey,
     # OpenrCtrl.thrift:648-661) -----------------------------------------------

@@ -105,6 +105,7 @@ def make_solver(
     dwarfs small solves)."""
     if backend == "cpu":
         kwargs.pop("xla_cache_dir", None)
+        kwargs.pop("enable_numerical_sentinels", None)
         return SpfSolver(node_name, **kwargs)
     if backend in ("tpu", "auto"):
         try:
@@ -119,6 +120,7 @@ def make_solver(
             log.warning("tpu solver unavailable; falling back to cpu")
             kwargs.pop("xla_cache_dir", None)
             kwargs.pop("small_graph_nodes", None)
+            kwargs.pop("enable_numerical_sentinels", None)
             return SpfSolver(node_name, **kwargs)
     raise ValueError(f"unknown solver backend {backend!r}")
 
@@ -136,6 +138,7 @@ class Decision(Actor):
         solver_backend: Optional[str] = None,
         solver_kwargs: Optional[dict] = None,
         persistent_store=None,
+        log_sample_queue=None,
     ):
         super().__init__(f"decision:{node_name}")
         # crash-safe RibPolicy home (ref FLAGS_rib_policy_file role;
@@ -146,6 +149,9 @@ class Decision(Actor):
         self._kvstore_updates = kvstore_updates_queue
         self._static_routes = static_routes_queue
         self._route_updates_q = route_updates_queue
+        # push side of the Monitor's LogSample queue (optional): the
+        # sentinel anomaly path emits a structured event log through it
+        self._log_samples = log_sample_queue
 
         self.area_link_states: dict[str, LinkState] = {}
         self.prefix_state = PrefixState()
@@ -157,6 +163,10 @@ class Decision(Actor):
             # "" -> default resolution (env var, then ~/.cache); "off"
             # disables (ops/xla_cache.py)
             skw.setdefault("xla_cache_dir", config.xla_cache_dir or None)
+            skw.setdefault(
+                "enable_numerical_sentinels",
+                config.enable_numerical_sentinels,
+            )
         self.solver = make_solver(
             node_name,
             backend,
@@ -382,6 +392,7 @@ class Decision(Actor):
             "decision.spf_ms", (time.perf_counter() - t0) * 1e3
         )
         self._fold_solver_timing(ctx, spf_sp)
+        self._emit_sentinels(spf_sp)
 
         t_mat = time.perf_counter()
         with tracer.span(ctx, "decision.rib_diff", node=self.node_name):
@@ -415,6 +426,40 @@ class Decision(Actor):
         if not self._first_build_done:
             self._first_build_done = True
             self._route_updates_q.push(InitializationEvent.RIB_COMPUTED)
+
+    def _emit_sentinels(self, spf_sp) -> None:
+        """Surface the solver's numerical-health sentinels
+        (tpu_solver.last_sentinels): gauges always; when anomalous —
+        metric saturation or bad UCMP weights, values that still parse
+        as routes but are numerically suspect — also a counter bump, a
+        structured LogSample, and an attribute on the spf span so the
+        convergence trace carries the evidence."""
+        sent = getattr(self.solver, "last_sentinels", None)
+        if not isinstance(sent, dict) or not sent:
+            return
+        for k, v in sent.items():
+            counters.set_counter(f"decision.sentinel.{k}", v)
+        anomalous = (
+            sent.get("saturated_rows", 0) > 0
+            or sent.get("ucmp_bad_weights", 0) > 0
+        )
+        if not anomalous:
+            return
+        counters.increment("decision.sentinel.anomalies")
+        if spf_sp is not None:
+            spf_sp.attributes["sentinel_anomaly"] = True
+            for k, v in sent.items():
+                spf_sp.attributes[f"sentinel_{k}"] = v
+        if self._log_samples is not None:
+            from openr_tpu.runtime.monitor import LogSample
+
+            self._log_samples.push(
+                LogSample(
+                    event="DECISION_SENTINEL_ANOMALY",
+                    node_name=self.node_name,
+                    values={"category": "sentinel", **sent},
+                )
+            )
 
     def _fold_solver_timing(self, ctx, spf_sp) -> None:
         """Fold the TPU pipeline's last_timing breakdown in as timed

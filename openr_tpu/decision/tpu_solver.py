@@ -67,7 +67,6 @@ import numpy as np
 from openr_tpu.decision.columnar_rib import (
     ColumnarRib,
     LazyUnicastRoutes,
-    unpack_words,
 )
 from openr_tpu.decision.link_state import LinkState, NodeUcmpResult
 from openr_tpu.decision.prefix_state import PrefixState
@@ -102,6 +101,25 @@ _DELTA_BUDGET = 4096
 # relaxation steps fused per while_loop trip (steps past the fixpoint are
 # no-ops; fusing amortizes per-trip dispatch)
 _UNROLL = 8
+
+# numerical-health sentinel threshold: finite metrics past 2^28 sit one
+# metric-add away from the 2^29 INF_E encoding — saturation territory
+# the int32 metric algebra cannot flag on its own
+_SENTINEL_SAT = 1 << 28
+
+
+def _ucmp_weight_anomalies(w) -> int:
+    """Count numerically-unhealthy entries in a UCMP weight field:
+    non-finite (NaN/inf) values for float dtypes — a diverged fixpoint —
+    and negative values for signed-int dtypes (int32 wraparound that
+    slipped past propagate's overflow guard). Unsigned ints cannot
+    express either failure mode."""
+    arr = np.asarray(w)
+    if arr.dtype.kind == "f":
+        return int((~np.isfinite(arr)).sum())
+    if arr.dtype.kind == "i":
+        return int((arr < 0).sum())
+    return 0
 
 
 # ---------------------------------------------------------------------------
@@ -328,7 +346,8 @@ def _plan_sssp(deltas, shift_w, res_rows, res_nbr, res_w, root,
 def _plan_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
                    has_res: bool,
                    d_cap: int, p_cap: int, a_cap: int, budget: int,
-                   lfa: bool = False, block_v4: bool = False):
+                   lfa: bool = False, block_v4: bool = False,
+                   sentinels: bool = True):
     """The fused production pipeline. Outputs:
       delta_buf int32 [2 + B + B + B*wa + B*wd (+ 2B with lfa)]: count,
                 trips, idx, metric, s3 words, nh words (and lfa slot +
@@ -477,11 +496,57 @@ def _plan_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
         if lfa:
             delta_parts += [lfa_slot[safe], lfa_metric[safe]]
             full_parts += [lfa_slot[osafe], lfa_metric[osafe]]
+        if sentinels:
+            # numerical-health sentinels: two scalar reductions riding
+            # the tail of BOTH pull buffers (free — the pull happens
+            # anyway). unreachable = rows with a live announcer but no
+            # finite metric; saturated = finite metrics past 2^28,
+            # within one metric-add of the 2^29 INF_E encoding — the
+            # overflow precursor the encoding cannot represent failing.
+            unreach = (
+                (ann_valid.any(axis=1) & (metric >= INF_E))
+                .sum()
+                .astype(jnp.int32)
+            )
+            saturated = (
+                ((metric < INF_E) & (metric > _SENTINEL_SAT))
+                .sum()
+                .astype(jnp.int32)
+            )
+            delta_parts += [unreach[None], saturated[None]]
+            full_parts += [unreach[None], saturated[None]]
         delta_buf = jnp.concatenate(delta_parts)
         full_buf = jnp.concatenate(full_parts)
         return delta_buf, full_buf, metric, s3w, nhw, lfa_slot, lfa_metric
 
     return jax.jit(pipeline)
+
+
+@functools.lru_cache(maxsize=None)
+def _instrumented_pipeline(
+    n_cap: int, s_cap: int, r_cap: int, kr_cap: int, has_res: bool,
+    d_cap: int, p_cap: int, a_cap: int, budget: int,
+    lfa: bool, block_v4: bool, sentinels: bool,
+) -> tuple:
+    """(kernel name, instrumented callable) for a pipeline shape class.
+    The wrapper AOT-compiles on first call, recording compile time +
+    XLA cost_analysis into the kernel ledger (ops/xla_cache.ledger) so
+    ctrl.tpu.kernels can report estimated vs achieved throughput.
+    lru-cached on the same key as _plan_pipeline: one wrapper instance
+    per shape class keeps the compile-once state stable."""
+    from openr_tpu.ops.xla_cache import instrument_jit
+
+    name = (
+        f"pipeline[n={n_cap},s={s_cap},d={d_cap},p={p_cap},a={a_cap}"
+        + (",res" if has_res else "")
+        + (",lfa" if lfa else "")
+        + "]"
+    )
+    jitted = _plan_pipeline(
+        n_cap, s_cap, r_cap, kr_cap, has_res, d_cap, p_cap, a_cap,
+        budget, lfa, block_v4, sentinels,
+    )
+    return name, instrument_jit(name, jitted)
 
 
 @functools.lru_cache(maxsize=None)
@@ -661,6 +726,22 @@ class _UcmpAccel:
         reach, w, overflow = ucmp_ops.propagate(
             edges, d_base, dst_weights, use_prefix_weight
         )
+        if solver.enable_sentinels:
+            if overflow:
+                solver.last_sentinels["ucmp_overflow"] = (
+                    solver.last_sentinels.get("ucmp_overflow", 0) + 1
+                )
+                counters.increment("decision.sentinel.ucmp_overflow")
+            bad = _ucmp_weight_anomalies(w)
+            if bad:
+                # weights that are NaN/inf/negative would quietly become
+                # garbage next-hop ratios — flag before assembly
+                solver.last_sentinels["ucmp_bad_weights"] = (
+                    solver.last_sentinels.get("ucmp_bad_weights", 0) + bad
+                )
+                counters.increment(
+                    "decision.sentinel.ucmp_bad_weights", bad
+                )
         if overflow:
             # weighted path counts exceeded int32 — the host walk's
             # Python ints are exact. Memoize the fallback sentinel so
@@ -740,7 +821,8 @@ class TpuSpfSolver:
 
     def __init__(
         self, my_node_name: str, small_graph_nodes: int = 0,
-        xla_cache_dir: str | None = None, **solver_kwargs
+        xla_cache_dir: str | None = None,
+        enable_numerical_sentinels: bool = True, **solver_kwargs
     ):
         # a restarting daemon must not pay the ~80s 100k-node compile
         # again — load executables from the persistent cache
@@ -748,6 +830,13 @@ class TpuSpfSolver:
 
         enable_compilation_cache(xla_cache_dir)
         self.my_node_name = my_node_name
+        # numerical-health sentinels: on-device unreachable/saturation
+        # reductions ride the pull buffers; UCMP weight checks run on
+        # the pulled field (config kill-switch, DecisionConfig)
+        self.enable_sentinels = enable_numerical_sentinels
+        # aggregated per solve by build_route_db (+ UCMP hook); the
+        # Decision actor turns anomalies into counter/LogSample/span
+        self.last_sentinels: dict = {}
         # graphs below this node count solve entirely on the CPU oracle:
         # the fixed device dispatch + result-pull round trip exceeds the
         # whole CPU solve there (the "auto" backend sets this)
@@ -789,6 +878,34 @@ class TpuSpfSolver:
         # area and walks the host slow path (created lazily; one worker
         # keeps per-vantage state access serial)
         self._mat_pool = None
+        # live-buffer census attribution (runtime/device_stats.py):
+        # weakref so a dropped solver's pool reads empty instead of
+        # pinning the solver (and its device mirrors) forever
+        import weakref
+
+        from openr_tpu.runtime.device_stats import register_pool
+
+        ref = weakref.ref(self)
+
+        def _pool_arrays():
+            s = ref()
+            return [] if s is None else list(s._device_arrays())
+
+        register_pool(f"tpu_solver:{my_node_name}", _pool_arrays)
+
+    def _device_arrays(self):
+        """Device buffers this solver pins: per-area topology mirrors
+        plus per-vantage resident pipeline outputs."""
+        for ad in self._area_dev.values():
+            for attr in (
+                "d_deltas", "d_shift_w", "d_res_rows", "d_res_nbr",
+                "d_res_w", "d_mbuf",
+            ):
+                arr = getattr(ad, attr, None)
+                if arr is not None:
+                    yield arr
+        for vs in self._vstates.values():
+            yield from (getattr(vs, "prev", None) or ())
 
     def _pool(self):
         if self._mat_pool is None:
@@ -866,6 +983,9 @@ class TpuSpfSolver:
         # reset per-solve so a CPU-delegated or deviceless build doesn't
         # leave a previous solve's breakdown for timing consumers
         self.last_timing = {}
+        # sentinel aggregation restarts per solve; the UCMP hook below
+        # and the per-area pipelines both add into it
+        self.last_sentinels = {}
         if all(
             ls.node_count() < self.small_graph_nodes
             for ls in area_link_states.values()
@@ -948,6 +1068,14 @@ class TpuSpfSolver:
                 for k, v in res["timing"].items():
                     stages[k] = stages.get(k, 0.0) + v
                 area_timing[area] = dict(res["timing"])
+                # the shape-class kernel this area executed, for the
+                # ctrl.tpu.kernels estimated-vs-achieved join
+                if stats.get("kernel"):
+                    area_timing[area]["kernel"] = stats["kernel"]
+                for sk, sv in (stats.get("sentinels") or {}).items():
+                    self.last_sentinels[sk] = (
+                        self.last_sentinels.get(sk, 0) + sv
+                    )
                 # per-area solve/materialize latency percentiles
                 # (the per-event stage timing ISSUE 2 reports against)
                 counters.add_stat_value(
@@ -1372,7 +1500,10 @@ class TpuSpfSolver:
             vs.valid = False
 
         t1 = _time.perf_counter()
-        run = _plan_pipeline(*shape_key, _DELTA_BUDGET, lfa, block_v4)
+        sentinels = self.enable_sentinels
+        kernel_name, run = _instrumented_pipeline(
+            *shape_key, _DELTA_BUDGET, lfa, block_v4, sentinels
+        )
         delta_buf, full_buf, *new_prev = run(
             ad.d_deltas, ad.d_shift_w, ad.d_res_rows, ad.d_res_nbr,
             ad.d_res_w, ad.d_mbuf,
@@ -1421,6 +1552,7 @@ class TpuSpfSolver:
                 "n_prefixes": len(matrix.prefix_list),
                 "changed_rows": count,
                 "full_pull": full_pull,
+                "kernel": kernel_name,
             }
             if full_pull:
                 fbuf = np.asarray(full_buf)
@@ -1459,6 +1591,15 @@ class TpuSpfSolver:
                     None if lfa_slot is None else lfa_slot[live][:count],
                     None if lfa_metric is None else lfa_metric[live][:count],
                 )
+            if sentinels:
+                # the sentinel scalars ride the tail of whichever
+                # buffer this solve pulled (appended last in
+                # _plan_pipeline, after the lfa columns)
+                sbuf = fbuf if full_pull else dbuf
+                stats["sentinels"] = {
+                    "unreachable_rows": int(sbuf[-2]),
+                    "saturated_rows": int(sbuf[-1]),
+                }
             stats["trips"] = trips
             t3 = _time.perf_counter()
             return {

@@ -59,7 +59,6 @@ from openr_tpu.types import (
     Publication,
     TTL_INFINITY,
     Value,
-    compute_hash,
 )
 
 log = logging.getLogger(__name__)

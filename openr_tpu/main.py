@@ -25,7 +25,6 @@ import signal
 import sys
 
 from openr_tpu.config import Config
-from openr_tpu.ctrl import CtrlServer
 from openr_tpu.prefix_manager import OriginatedPrefix
 from openr_tpu.runtime.monitor import Monitor, Watchdog
 from openr_tpu.runtime.openr_wrapper import OpenrWrapper
@@ -245,7 +244,9 @@ async def run_daemon(args) -> None:
         oc.monitor_config,
         node.log_sample_queue.get_reader("monitor"),
     )
-    node.set_monitor(monitor)
+    node.set_monitor(monitor)  # also wires kvstore for fleet health
+    if watchdog is not None:
+        monitor.attach_fleet_sources(watchdog=watchdog)
 
     # -- start (ref start order Main.cpp) ---------------------------------
     if watchdog is not None:

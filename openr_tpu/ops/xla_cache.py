@@ -20,6 +20,8 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
+import time
 
 log = logging.getLogger(__name__)
 
@@ -100,3 +102,112 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
     _hook_cache_monitoring()
     _applied = d
     return d
+
+
+# -- kernel cost ledger -----------------------------------------------------
+#
+# The cache above answers "did we recompile?"; the ledger answers "what
+# did the compiler think each kernel costs?". Per instrumented
+# executable it keeps compile time plus XLA's own cost_analysis()
+# (flops, bytes accessed) so ctrl.tpu.kernels can report estimated vs
+# achieved throughput next to the solver's measured exec times.
+
+
+def _extract_cost(compiled) -> dict:
+    """Pull the headline numbers out of compiled.cost_analysis(), which
+    is a flat dict on current jax and a [dict] on older releases; keys
+    are XLA's spellings ("bytes accessed")."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if not isinstance(ca, dict):
+        return {}
+    out = {}
+    for src, dst in (
+        ("flops", "flops"),
+        ("bytes accessed", "bytes_accessed"),
+        ("transcendentals", "transcendentals"),
+    ):
+        v = ca.get(src)
+        if isinstance(v, (int, float)):
+            out[dst] = float(v)
+    return out
+
+
+class KernelLedger:
+    """Compile-cost bookkeeping per instrumented executable."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: dict[str, dict] = {}
+
+    def record(
+        self, name: str, compile_ms: float | None, cost: dict,
+        aot: bool = True,
+    ) -> None:
+        from openr_tpu.runtime.counters import counters
+
+        with self._lock:
+            self._entries[name] = {
+                "name": name,
+                "compile_ms": (
+                    round(compile_ms, 3) if compile_ms is not None else None
+                ),
+                "aot": aot,
+                "calls": 0,
+                **cost,
+            }
+        if compile_ms is not None:
+            counters.add_stat_value("xla_cache.compile_ms", compile_ms)
+        counters.increment("xla_cache.kernels_recorded")
+
+    def bump_calls(self, name: str) -> None:
+        with self._lock:
+            e = self._entries.get(name)
+            if e is not None:
+                e["calls"] += 1
+
+    def snapshot(self) -> dict[str, dict]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._entries.items()}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+ledger = KernelLedger()
+
+
+def instrument_jit(name: str, jitted):
+    """Wrap a jitted callable so its first invocation AOT-compiles
+    (lower().compile()), recording compile time + cost_analysis into
+    the ledger, and every later invocation hits the compiled executable
+    directly. Callers must keep argument shapes/dtypes fixed per
+    instrumented instance — true for the solver's shape-keyed pipeline
+    factories, whose lru key IS the shape class. Where AOT fails (e.g.
+    a backend quirk) the wrapper degrades to the plain jitted fn and
+    the ledger says so."""
+
+    state: dict = {"fn": None}
+
+    def wrapper(*args, **kwargs):
+        fn = state["fn"]
+        if fn is None:
+            try:
+                t0 = time.perf_counter()
+                fn = jitted.lower(*args, **kwargs).compile()
+                compile_ms = (time.perf_counter() - t0) * 1e3
+                ledger.record(name, compile_ms, _extract_cost(fn))
+            except Exception as e:
+                log.debug("AOT compile failed for %s (%s)", name, e)
+                fn = jitted
+                ledger.record(name, None, {}, aot=False)
+            state["fn"] = fn
+        ledger.bump_calls(name)
+        return fn(*args, **kwargs)
+
+    return wrapper

@@ -28,7 +28,6 @@ from openr_tpu.platform.netlink import (
     RTMGRP_IPV6_IFADDR,
     RTMGRP_LINK,
     NetlinkRouteSocket,
-    NlAddr,
     NlLink,
 )
 from openr_tpu.types import InterfaceInfo

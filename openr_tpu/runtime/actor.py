@@ -17,7 +17,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
-from typing import Any, Awaitable, Callable, Coroutine, Optional
+from typing import Any, Callable, Coroutine, Optional
 
 from openr_tpu.messaging import QueueClosedError
 from openr_tpu.runtime.tasks import spawn_logged

@@ -152,6 +152,28 @@ class CounterRegistry:
                     out[f"{k}.sum.60"] = w["sum"]
             return out
 
+    def erase(self, key: str) -> bool:
+        """Drop one counter/stat. Returns whether anything existed —
+        idempotent, so sweepers can erase speculatively."""
+        with self._lock:
+            had = self._counters.pop(key, None) is not None
+            had = (self._stats.pop(key, None) is not None) or had
+            return had
+
+    def erase_prefix(self, prefix: str) -> int:
+        """Drop every counter/stat under a prefix; returns the number
+        erased. Callers own the trailing-dot discipline: pass
+        "q.reader.r." (not "q.reader.r") so reader "r" never swallows
+        reader "r2"'s gauges."""
+        n = 0
+        with self._lock:
+            for table in (self._counters, self._stats):
+                stale = [k for k in table if k.startswith(prefix)]
+                for k in stale:
+                    del table[k]
+                n += len(stale)
+        return n
+
     def clear(self) -> None:
         with self._lock:
             self._counters.clear()

@@ -1,0 +1,274 @@
+"""Device-plane telemetry: HBM gauges, live-buffer census, and
+on-demand JAX profiler capture.
+
+The host-side fabric (counters.py, tracing.py) stops at the device
+boundary; this module crosses it. Three concerns live here:
+
+- **gauges** — `export_device_gauges()` reads
+  `jax.local_devices()[i].memory_stats()` and publishes
+  `device.<i>.hbm_in_use_mb` / `.peak_mb` / `.num_allocs` into the
+  counter fabric, plus a `jax.live_arrays()` census attributed to
+  registered solver pools. CPU backends expose no memory_stats — the
+  snapshot then carries only the backend label, never an error.
+- **pools** — long-lived device-buffer owners (the TPU solver's
+  per-area mirrors) register a provider so the census can split live
+  bytes into "pool X" vs "unattributed" — the shape of an HBM leak.
+- **profiler** — single-flight `jax.profiler.start_trace`/`stop_trace`
+  with an optional auto-stop timer, served by the ctrl API so an
+  operator captures a Perfetto-compatible XLA trace from a live daemon.
+
+Passive polling (the Monitor's metrics loop) must not *cause* a jax
+import in processes that never touched the device — `_jax()` only
+returns the module if something else already imported it. Explicit
+requests (profiler start, bench) import it on purpose.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Callable, Iterable, Optional
+
+from openr_tpu.runtime.counters import counters
+
+log = logging.getLogger(__name__)
+
+_BYTES_PER_MB = 1024.0 * 1024.0
+
+# -- solver-pool registry ---------------------------------------------------
+
+_pools: dict[str, Callable[[], Iterable[Any]]] = {}
+_pools_lock = threading.Lock()
+
+
+def register_pool(name: str, arrays_fn: Callable[[], Iterable[Any]]) -> None:
+    """Register a named owner of long-lived device buffers. `arrays_fn`
+    returns the arrays the pool currently holds; the census charges
+    their bytes to the pool. Re-registering a name replaces it."""
+    with _pools_lock:
+        _pools[name] = arrays_fn
+
+
+def unregister_pool(name: str) -> None:
+    with _pools_lock:
+        _pools.pop(name, None)
+        counters.erase_prefix(f"device.pool.{name}.")
+
+
+def _jax(allow_import: bool):
+    if allow_import:
+        try:
+            import jax
+
+            return jax
+        except Exception:  # pragma: no cover - jax is baked into the image
+            return None
+    return sys.modules.get("jax")
+
+
+# -- device snapshot --------------------------------------------------------
+
+
+def collect_device_stats(allow_import: bool = False) -> dict:
+    """One snapshot of every local device's memory stats. Backends
+    without memory_stats (CPU) yield devices with only id/platform —
+    the caller distinguishes "no HBM accounting" from "no devices"."""
+    jax = _jax(allow_import)
+    if jax is None:
+        return {"backend": "unavailable", "devices": []}
+    try:
+        backend = jax.default_backend()
+        devices = jax.local_devices()
+    except Exception as e:  # pragma: no cover - backend init failure
+        return {"backend": "error", "error": str(e), "devices": []}
+    out: dict = {"backend": backend, "devices": []}
+    for i, dev in enumerate(devices):
+        entry: dict = {"id": i, "platform": getattr(dev, "platform", backend)}
+        try:
+            ms = dev.memory_stats()
+        except Exception:
+            ms = None
+        if ms:
+            entry["hbm_in_use_mb"] = round(
+                ms.get("bytes_in_use", 0) / _BYTES_PER_MB, 3
+            )
+            entry["peak_mb"] = round(
+                ms.get("peak_bytes_in_use", 0) / _BYTES_PER_MB, 3
+            )
+            entry["num_allocs"] = int(ms.get("num_allocs", 0))
+        out["devices"].append(entry)
+    return out
+
+
+def live_buffer_census(allow_import: bool = False) -> dict:
+    """Count/bytes of every live jax array, split by registered pool.
+    `other_bytes` is what no pool claims — a growing `other` with flat
+    pools is the classic leak signature."""
+    jax = _jax(allow_import)
+    if jax is None:
+        return {"count": 0, "bytes": 0, "pools": {}, "other_bytes": 0}
+    try:
+        arrays = jax.live_arrays()
+    except Exception:
+        arrays = []
+    total_n, total_b = 0, 0
+    for a in arrays:
+        total_n += 1
+        total_b += int(getattr(a, "nbytes", 0) or 0)
+    pools_out: dict[str, dict] = {}
+    attributed = 0
+    with _pools_lock:
+        providers = list(_pools.items())
+    for name, fn in providers:
+        n, b = 0, 0
+        try:
+            for a in fn():
+                n += 1
+                b += int(getattr(a, "nbytes", 0) or 0)
+        except Exception:
+            pass  # a torn-down pool reads as empty, not as a crash
+        pools_out[name] = {"count": n, "bytes": b}
+        attributed += b
+    return {
+        "count": total_n,
+        "bytes": total_b,
+        "pools": pools_out,
+        "other_bytes": max(0, total_b - attributed),
+    }
+
+
+def export_device_gauges(allow_import: bool = False) -> dict:
+    """Publish the snapshot into the counter fabric (the Monitor calls
+    this every interval). Returns the snapshot for callers that want
+    the structured form too."""
+    snap = collect_device_stats(allow_import)
+    counters.set_counter("device.count", len(snap["devices"]))
+    for entry in snap["devices"]:
+        if "hbm_in_use_mb" not in entry:
+            continue
+        base = f"device.{entry['id']}"
+        counters.set_counter(f"{base}.hbm_in_use_mb", entry["hbm_in_use_mb"])
+        counters.set_counter(f"{base}.peak_mb", entry["peak_mb"])
+        counters.set_counter(f"{base}.num_allocs", entry["num_allocs"])
+    census = live_buffer_census(allow_import)
+    snap["live"] = census
+    counters.set_counter("device.live_arrays.count", census["count"])
+    counters.set_counter(
+        "device.live_arrays.bytes_mb", round(census["bytes"] / _BYTES_PER_MB, 3)
+    )
+    counters.set_counter(
+        "device.live_arrays.other_mb",
+        round(census["other_bytes"] / _BYTES_PER_MB, 3),
+    )
+    for name, p in census["pools"].items():
+        counters.set_counter(f"device.pool.{name}.count", p["count"])
+        counters.set_counter(
+            f"device.pool.{name}.bytes_mb", round(p["bytes"] / _BYTES_PER_MB, 3)
+        )
+    return snap
+
+
+def peak_hbm_mb(allow_import: bool = True) -> tuple[Optional[float], str]:
+    """(max over devices of peak_bytes_in_use, backend label) — bench
+    records this next to wall-time. None where the backend keeps no
+    HBM accounting (CPU)."""
+    snap = collect_device_stats(allow_import)
+    peaks = [e["peak_mb"] for e in snap["devices"] if "peak_mb" in e]
+    return (max(peaks) if peaks else None), snap["backend"]
+
+
+# -- profiler capture -------------------------------------------------------
+
+_prof_lock = threading.Lock()
+_prof_state: Optional[dict] = None
+
+
+def profiler_start(
+    out_dir: Optional[str] = None, seconds: Optional[float] = None
+) -> dict:
+    """Start a jax profiler trace. Single-flight: a second start while
+    one is capturing raises (the XLA profiler is process-global). With
+    `seconds`, a daemon timer stops the capture even if the requesting
+    client vanishes — a forgotten trace must not run forever."""
+    global _prof_state
+    import jax  # explicit request: importing jax here is the point
+
+    with _prof_lock:
+        if _prof_state is not None:
+            raise RuntimeError(
+                f"profiler already capturing to {_prof_state['out_dir']}"
+            )
+        out = out_dir or tempfile.mkdtemp(prefix="openr-tpu-trace-")
+        os.makedirs(out, exist_ok=True)
+        jax.profiler.start_trace(out)
+        timer = None
+        if seconds is not None and seconds > 0:
+            timer = threading.Timer(seconds, _profiler_auto_stop)
+            timer.daemon = True
+            timer.start()
+        _prof_state = {
+            "out_dir": out,
+            "started_ts": time.time(),
+            "seconds": seconds,
+            "timer": timer,
+        }
+    counters.increment("device.profiler.starts")
+    log.info("profiler capture started -> %s", out)
+    return {"ok": True, "out_dir": out, "auto_stop_s": seconds}
+
+
+def profiler_stop() -> dict:
+    """Stop the active capture; returns the trace directory and how
+    many files the profiler wrote there (>0 is the smoke signal that
+    the capture actually produced a trace)."""
+    global _prof_state
+    with _prof_lock:
+        if _prof_state is None:
+            raise RuntimeError("profiler is not capturing")
+        state, _prof_state = _prof_state, None
+    timer = state.get("timer")
+    if timer is not None:
+        timer.cancel()
+    import jax
+
+    jax.profiler.stop_trace()
+    files = 0
+    for _, _, names in os.walk(state["out_dir"]):
+        files += len(names)
+    counters.increment("device.profiler.stops")
+    duration = round(time.time() - state["started_ts"], 3)
+    log.info(
+        "profiler capture stopped after %.1fs -> %s (%d files)",
+        duration,
+        state["out_dir"],
+        files,
+    )
+    return {
+        "ok": True,
+        "out_dir": state["out_dir"],
+        "duration_s": duration,
+        "files": files,
+    }
+
+
+def _profiler_auto_stop() -> None:
+    try:
+        profiler_stop()
+    except RuntimeError:
+        pass  # operator beat the timer to it
+
+
+def profiler_status() -> dict:
+    with _prof_lock:
+        if _prof_state is None:
+            return {"capturing": False}
+        return {
+            "capturing": True,
+            "out_dir": _prof_state["out_dir"],
+            "elapsed_s": round(time.time() - _prof_state["started_ts"], 3),
+            "auto_stop_s": _prof_state["seconds"],
+        }

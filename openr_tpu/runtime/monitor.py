@@ -27,6 +27,7 @@ from typing import Callable, Optional
 
 from openr_tpu.config import MonitorConfig, WatchdogConfig
 from openr_tpu.messaging import ReplicateQueue, RQueue
+from openr_tpu.runtime import device_stats
 from openr_tpu.runtime.actor import Actor
 from openr_tpu.runtime.counters import counters
 from openr_tpu.runtime.tracing import tracer
@@ -95,19 +96,46 @@ class Monitor(Actor):
             maxlen=config.max_event_log_entries
         )
         self._process_start = time.monotonic()
+        # fleet-health sources, wired post-construction (the kvstore and
+        # watchdog outlive/predate the monitor differently per harness)
+        self._kvstore = None
+        self._watchdog = None
+        # seed from wall clock so a restarted node's first advertisement
+        # beats the TTL'd remnant of its previous incarnation
+        self._health_version = int(time.time())
         # the monitor owns the observability config, so the tracing
         # kill-switch rides on it (ISSUE: disabled tracing must cost no
         # more than a dict lookup per queue push)
         tracer.configure(enabled=config.enable_tracing)
 
+    def attach_fleet_sources(self, kvstore=None, watchdog=None) -> None:
+        """Wire the health summary's inputs: the KvStore actor to
+        advertise `monitor:health:<node>` through, and the watchdog
+        whose fired-state the summary reports. Either may stay None —
+        the health loop skips advertisement without a kvstore."""
+        if kvstore is not None:
+            self._kvstore = kvstore
+        if watchdog is not None:
+            self._watchdog = watchdog
+
     async def on_start(self) -> None:
         self.add_task(self._log_loop(), name=f"{self.name}.logs")
         self.add_task(self._metrics_loop(), name=f"{self.name}.metrics")
+        if self.cfg.enable_fleet_health:
+            self.add_task(self._health_loop(), name=f"{self.name}.health")
 
     async def _log_loop(self) -> None:
         while True:
             sample = await self._log_samples.get()
             if isinstance(sample, LogSample):
+                if (
+                    self.event_logs.maxlen is not None
+                    and len(self.event_logs) >= self.event_logs.maxlen
+                ):
+                    # the bounded deque evicts the oldest silently —
+                    # make the loss visible (satellite: dropped samples
+                    # looked like they never happened)
+                    counters.increment("monitor.event_logs.dropped")
                 self.event_logs.append(sample)
                 counters.increment("monitor.event_logs")
 
@@ -123,12 +151,110 @@ class Monitor(Actor):
             counters.set_counter(
                 "process.uptime_s", time.monotonic() - self._process_start
             )
+            if self.cfg.enable_device_telemetry:
+                try:
+                    # passive poll: only reads jax if something else
+                    # already imported it (device_stats._jax)
+                    device_stats.export_device_gauges()
+                except Exception:
+                    log.debug("device gauge export failed", exc_info=True)
             await asyncio.sleep(self._interval_s)
+
+    # -- fleet health (advertised over the flooding fabric) ----------------
+
+    def health_summary(self) -> dict:
+        """One node's health card: the fields an operator triages a
+        fleet by. Everything reads from the counter fabric or attached
+        sources — cheap enough for every health interval."""
+        wd = self._watchdog
+        depths = counters.get_counters("messaging.queue.")
+        worst_q, worst_depth = "", 0
+        for k, v in depths.items():
+            if k.endswith(".max_depth") and v >= worst_depth:
+                worst_q, worst_depth = k[len("messaging.queue."):-len(".max_depth")], int(v)
+        conv = counters.get_statistics(
+            "convergence_ms", windows=(600.0,)
+        ).get("convergence_ms", {}).get("600", {})
+        dev = device_stats.collect_device_stats()
+        hbm = [
+            e["hbm_in_use_mb"]
+            for e in dev["devices"]
+            if "hbm_in_use_mb" in e
+        ]
+        return {
+            "node": self.node_name,
+            "ts_ms": int(time.time() * 1000),
+            "uptime_s": round(time.monotonic() - self._process_start, 1),
+            "rss_mb": round(current_rss_mb(), 1),
+            "watchdog_fired": wd.fired if wd is not None else None,
+            "worst_queue": worst_q,
+            "worst_queue_depth": worst_depth,
+            "convergence_p99_ms": round(conv.get("p99", 0.0), 3),
+            "backend": dev["backend"],
+            "hbm_in_use_mb": round(max(hbm), 3) if hbm else None,
+            "sentinel_anomalies": int(
+                counters.get_counter("decision.sentinel.anomalies") or 0
+            ),
+            "event_logs_dropped": int(
+                counters.get_counter("monitor.event_logs.dropped") or 0
+            ),
+        }
+
+    async def _health_loop(self) -> None:
+        """Advertise this node's health card into KvStore as a TTL'd
+        `monitor:health:<node>` key — the network observes itself over
+        its own flooding fabric; `breeze monitor fleet` on ANY node
+        renders every node's card. TTL ~3 intervals: a dead node's card
+        expires instead of lying forever."""
+        interval_s = max(self._interval_s, 1.0)
+        while True:
+            await asyncio.sleep(interval_s)
+            if self._kvstore is None:
+                continue
+            try:
+                await self._advertise_health(interval_s)
+            except Exception:
+                log.debug("fleet health advertisement failed", exc_info=True)
+
+    async def _advertise_health(self, interval_s: float) -> None:
+        from openr_tpu.types import Value
+
+        payload = json.dumps(self.health_summary(), sort_keys=True).encode()
+        self._health_version += 1
+        ttl_ms = max(int(interval_s * 3000), 2500)
+        key = f"monitor:health:{self.node_name}"
+        for area in list(getattr(self._kvstore, "areas", None) or ["0"]):
+            await self._kvstore.set_key_vals(
+                area,
+                {
+                    key: Value(
+                        version=self._health_version,
+                        originator_id=self.node_name,
+                        value=payload,
+                        ttl_ms=ttl_ms,
+                    )
+                },
+            )
+        counters.increment("monitor.health.advertisements")
 
     # -- API (ref getEventLogs) --------------------------------------------
 
-    async def get_event_logs(self) -> list[str]:
-        return [s.to_json() for s in self.event_logs]
+    async def get_event_logs(
+        self, category: Optional[str] = None
+    ) -> list[str]:
+        """Retained LogSamples, optionally filtered: `category` matches
+        the event name exactly, as a dotted prefix ("spark" matches
+        "spark.neighbor_up"), or the sample's values["category"]."""
+        samples = list(self.event_logs)
+        if category:
+            samples = [
+                s
+                for s in samples
+                if s.event == category
+                or s.event.startswith(category + ".")
+                or s.values.get("category") == category
+            ]
+        return [s.to_json() for s in samples]
 
 # -- heap profiling (role of MonitorBase::dumpHeapProfile,
 # MonitorBase.h:54 — the reference hooks jemalloc; the Python runtime's
@@ -205,6 +331,9 @@ class Watchdog(Actor):
         self._watched_queues: list[ReplicateQueue] = []
         self._crash = crash_handler or _default_crash_handler
         self.fired: Optional[str] = None  # reason, for tests
+        # reader names seen last sweep, per queue: the delta vs the
+        # current sweep is the prune set (ghost-gauge cleanup)
+        self._prev_readers: dict[str, set[str]] = {}
 
     def watch_actor(self, actor: Actor) -> None:
         """ref addEvb — actors stamp last_alive_ts (actor.py heartbeat)."""
@@ -257,13 +386,23 @@ class Watchdog(Actor):
             counters.set_counter(
                 f"{base}.replicas", len(stats["readers"])
             )
+            current = set()
             for r in stats["readers"]:
+                current.add(r["name"])
                 counters.set_counter(
                     f"{base}.reader.{r['name']}.depth", r["depth"]
                 )
                 counters.set_counter(
                     f"{base}.reader.{r['name']}.reads", r["reads"]
                 )
+            # prune gauges for readers that disappeared since the last
+            # sweep: churny readers (ctrl subscriptions, long-polls)
+            # would otherwise leave ghost gauges forever and grow
+            # counter cardinality without bound. Trailing dot so reader
+            # "r" never swallows reader "r2".
+            for gone in self._prev_readers.get(stats["name"], set()) - current:
+                counters.erase_prefix(f"{base}.reader.{gone}.")
+            self._prev_readers[stats["name"]] = current
 
     def _fire(self, reason: str) -> None:
         if self.fired is None:

@@ -172,6 +172,7 @@ class OpenrWrapper:
             self.route_updates_queue,
             solver_backend=solver_backend,
             persistent_store=persistent_store,
+            log_sample_queue=self.log_sample_queue,
         )
         self.ctrl: "CtrlServer | None" = None
         self._enable_ctrl = enable_ctrl
@@ -227,6 +228,10 @@ class OpenrWrapper:
         self._monitor = monitor
         if self.ctrl is not None:
             self.ctrl.monitor = monitor
+        # fleet health: the monitor advertises monitor:health:<node>
+        # through this node's KvStore (runtime/monitor.py _health_loop)
+        if hasattr(monitor, "attach_fleet_sources"):
+            monitor.attach_fleet_sources(kvstore=self.kvstore)
 
     async def start(self, *interfaces: str) -> None:
         """Reference start order (Main.cpp): kvstore -> link-monitor ->

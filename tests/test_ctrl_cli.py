@@ -873,6 +873,167 @@ class TestOperatorInjection:
             await b.stop()
 
 
+class TestDevicePlaneRpc:
+    @run_async
+    async def test_stream_disconnect_cleans_subscriber(self):
+        """A client vanishing mid-stream must clear its
+        ctrl.subscriber_info entry, close the server-side Stream, and
+        reap the pump task — a flapping dashboard must not accumulate
+        phantom subscriptions."""
+        mesh, a, b = await start_two_node()
+        client = RpcClient("127.0.0.1", a.ctrl.port)
+        try:
+            q = await client.subscribe("ctrl.fib.subscribe_detail", {})
+            first = await asyncio.wait_for(q.get(), 5)
+            assert "snapshot" in first
+            subs = await client.request("ctrl.subscriber_info")
+            assert len(subs) == 1
+            # drop the client mid-stream (no graceful unsubscribe)
+            await client.close()
+            await wait_until(lambda: not a.ctrl._subscribers, timeout_s=10)
+            await wait_until(
+                lambda: not any(
+                    "fib_detail-sub" in (t.get_name() or "")
+                    for t in a.ctrl._tasks
+                ),
+                timeout_s=10,
+            )
+            # the server keeps serving fresh clients
+            client2 = RpcClient("127.0.0.1", a.ctrl.port)
+            try:
+                assert (
+                    await client2.request("ctrl.subscriber_info") == []
+                )
+            finally:
+                await client2.close()
+        finally:
+            await a.stop()
+            await b.stop()
+
+    @run_async
+    async def test_tpu_endpoints_on_cpu_backend(self):
+        """ctrl.tpu.* must function (not error) on a backend with no
+        HBM accounting: devices report backend=cpu, kernels join the
+        ledger with whatever the solver ran."""
+        mesh, a, b = await start_two_node()
+        client = RpcClient("127.0.0.1", a.ctrl.port)
+        try:
+            devs = await client.request("ctrl.tpu.devices")
+            assert devs["backend"] == "cpu"
+            assert len(devs["devices"]) == 8
+            assert "live" in devs
+
+            kernels = await client.request("ctrl.tpu.kernels")
+            assert kernels["backend"] == "cpu"
+            assert isinstance(kernels["kernels"], dict)
+            assert isinstance(kernels["achieved"], list)
+        finally:
+            await client.close()
+            await a.stop()
+            await b.stop()
+
+    @run_async
+    async def test_profiler_rpc_round_trip(self, tmp_path=None):
+        import tempfile
+
+        out = tempfile.mkdtemp(prefix="orctl-prof-")
+        mesh, a, b = await start_two_node()
+        client = RpcClient("127.0.0.1", a.ctrl.port)
+        try:
+            started = await client.request(
+                "ctrl.tpu.profiler.start", {"out_dir": out}
+            )
+            assert started["ok"], started
+            # single-flight surfaces as ok=False over RPC, not a raise
+            dup = await client.request("ctrl.tpu.profiler.start")
+            assert dup["ok"] is False and "already" in dup["error"]
+            status = await client.request("ctrl.tpu.profiler.status")
+            assert status["capturing"] is True
+            # churn a route so the capture window sees device work
+            b.advertise_prefix("10.77.0.0/24")
+            await wait_until(
+                lambda: "10.77.0.0/24" in a.fib_routes, timeout_s=20
+            )
+            stopped = await client.request("ctrl.tpu.profiler.stop")
+            assert stopped["ok"] and stopped["out_dir"] == out
+            assert stopped["files"] > 0  # non-empty trace directory
+            again = await client.request("ctrl.tpu.profiler.stop")
+            assert again["ok"] is False
+        finally:
+            from openr_tpu.runtime import device_stats as _ds
+
+            try:  # never leak a process-global capture into later tests
+                _ds.profiler_stop()
+            except RuntimeError:
+                pass
+            await client.close()
+            await a.stop()
+            await b.stop()
+
+
+class TestFleetHealth:
+    @run_async
+    async def test_three_node_fleet_visible_from_one_ctrl_port(self):
+        """Every node's Monitor advertises monitor:health:<node> into
+        KvStore; flooding makes the whole fleet's health readable from
+        any single node's ctrl port."""
+        from openr_tpu.config import MonitorConfig
+        from openr_tpu.runtime.monitor import Monitor
+
+        names = ["node-0", "node-1", "node-2"]
+        mesh = MockIoMesh()
+        kv_ports = {}
+        nodes = {
+            n: OpenrWrapper(
+                n, mesh.provider(n), kv_ports, enable_ctrl=True
+            )
+            for n in names
+        }
+        # a line: node-0 -- node-1 -- node-2 (health must cross a hop)
+        mesh.connect("node-0", "if-01", "node-1", "if-10")
+        mesh.connect("node-1", "if-12", "node-2", "if-21")
+        await nodes["node-0"].start("if-01")
+        await nodes["node-1"].start("if-10", "if-12")
+        await nodes["node-2"].start("if-21")
+        monitors = []
+        for n, w in nodes.items():
+            mon = Monitor(
+                n,
+                MonitorConfig(),
+                w.log_sample_queue.get_reader(),
+                interval_s=0.2,
+            )
+            w.set_monitor(mon)  # wires the kvstore for fleet health
+            await mon.start()
+            monitors.append(mon)
+        client = RpcClient("127.0.0.1", nodes["node-0"].ctrl.port)
+        try:
+            fleet = None
+            deadline = asyncio.get_running_loop().time() + 30
+            while asyncio.get_running_loop().time() < deadline:
+                fleet = await client.request("ctrl.monitor.fleet")
+                if set(fleet["nodes"]) >= set(names):
+                    break
+                await asyncio.sleep(0.25)
+            assert fleet is not None
+            assert set(fleet["nodes"]) >= set(names), fleet
+            assert fleet["local_node"] == "node-0"
+            for n in names:
+                card = fleet["nodes"][n]
+                assert card["node"] == n
+                assert card["rss_mb"] > 0
+                assert card["backend"] in ("cpu", "unavailable")
+                assert card["watchdog_fired"] is None
+                assert "convergence_p99_ms" in card
+                assert "sentinel_anomalies" in card
+        finally:
+            await client.close()
+            for mon in monitors:
+                await mon.stop()
+            for w in nodes.values():
+                await w.stop()
+
+
 def test_kv_compare_detects_value_and_ttl_divergence(monkeypatch):
     """Regression: kv-compare used to key divergence on
     (version, originator) alone — two stores agreeing on both but

@@ -74,7 +74,11 @@ class TestWatchdog:
         fired = []
         wd = Watchdog(
             "node1",
-            WatchdogConfig(interval_s=0.05, thread_timeout_s=0.2),
+            # ceiling high enough that suite-wide RSS can't trip it —
+            # this test is about stall detection; the memory ceiling
+            # has its own test below
+            WatchdogConfig(interval_s=0.05, thread_timeout_s=0.2,
+                           max_memory_mb=100_000),
             crash_handler=fired.append,
         )
         victim = Actor("victim")
