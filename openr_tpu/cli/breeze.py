@@ -927,6 +927,63 @@ def heap_profile(ctx, action, stop, top) -> None:
                      {"top": top, "stop": stop}))
 
 
+@monitor.command("crashes")
+@click.pass_context
+def monitor_crashes(ctx) -> None:
+    """Recent task crashes (runtime crash ring), newest first — the
+    forensic twin of the runtime.task_crash.* counters."""
+    _print(_call(ctx, "ctrl.monitor.crashes"))
+
+
+# -- fault injection --------------------------------------------------------
+
+@cli.group()
+def fault() -> None:
+    """Deterministic fault-injection drills (runtime/faults.py)."""
+
+
+@fault.command("inject")
+@click.argument("site")
+@click.option("--probability", default=0.0, type=float,
+              help="fire with this probability per check (0..1)")
+@click.option("--every-nth", default=0, type=int,
+              help="fire deterministically every Nth check")
+@click.option("--one-shot", is_flag=True, help="fire once, then disarm")
+@click.option("--window", "window_s", default=0.0, type=float,
+              help="auto-disarm after this many seconds")
+@click.option("--max-fires", default=0, type=int,
+              help="disarm after this many fires (0 = unlimited)")
+@click.option("--seed", default=None, type=int,
+              help="override the registry seed for this site")
+@click.pass_context
+def fault_inject(
+    ctx, site, probability, every_nth, one_shot, window_s, max_fires, seed
+) -> None:
+    """Arm SITE (e.g. solver.exec, kvstore.flood, rpc.send,
+    fib.program, queue.push, decision.ingest). With no schedule options
+    the site fires on every check."""
+    _print(_call(ctx, "ctrl.fault.inject", {
+        "site": site, "probability": probability, "every_nth": every_nth,
+        "one_shot": one_shot, "window_s": window_s, "max_fires": max_fires,
+        "seed": seed,
+    }))
+
+
+@fault.command("clear")
+@click.argument("site", required=False)
+@click.pass_context
+def fault_clear(ctx, site) -> None:
+    """Disarm SITE, or every armed site when omitted."""
+    _print(_call(ctx, "ctrl.fault.clear", {"site": site}))
+
+
+@fault.command("list")
+@click.pass_context
+def fault_list(ctx) -> None:
+    """Armed sites with their schedules and fire counts."""
+    _print(_call(ctx, "ctrl.fault.list"))
+
+
 # -- tpu --------------------------------------------------------------------
 
 @cli.group()

@@ -130,6 +130,13 @@ class DecisionConfig:
     enable_numerical_sentinels: bool = True
     # capacity classes for static-shape padding (ops/csr.py)
     max_nodes_hint: int = 0  # 0 = grow on demand
+    # mid-flight TPU->CPU solver failover (decision/decision.py): a
+    # device/runtime error during build_route_db recomputes the round on
+    # the CPU oracle and marks the node degraded; a backoff-timed canary
+    # probe re-promotes the device backend once it answers again.
+    enable_solver_failover: bool = True
+    solver_probe_initial_backoff_s: float = 1.0
+    solver_probe_max_backoff_s: float = 30.0
 
 
 @dataclass
@@ -165,6 +172,14 @@ class WatchdogConfig:
     interval_s: float = 20.0
     thread_timeout_s: float = 300.0
     max_memory_mb: int = 800
+    # in-process fiber supervision (runtime/actor.py): crashed supervised
+    # fibers restart with exponential backoff until the PER-ACTOR crash
+    # budget is exhausted, then escalate to the watchdog crash handler
+    # (role of systemd Restart=on-failure + StartLimitBurst for the
+    # reference daemon). Applied to actors via Watchdog.watch_actor.
+    supervisor_crash_budget: int = 3
+    supervisor_backoff_initial_s: float = 0.05
+    supervisor_backoff_max_s: float = 2.0
 
 
 @dataclass
@@ -184,6 +199,19 @@ class MonitorConfig:
     # monitor:health:<node> key so `breeze monitor fleet` reads every
     # node from any node
     enable_fleet_health: bool = True
+
+
+@dataclass
+class FaultInjectionConfig:
+    """Deterministic fault injection (runtime/faults.py). Schedules armed
+    here apply from daemon startup; ctrl.fault.{inject,clear,list} and
+    `breeze fault ...` arm/disarm at runtime. Each schedule dict takes
+    the registry.arm() keywords: site (required), probability, every_nth,
+    one_shot, window_s, max_fires, seed."""
+
+    enable_fault_injection: bool = False
+    seed: int = 0
+    schedules: list[dict] = field(default_factory=list)
 
 
 @dataclass
@@ -345,6 +373,9 @@ class OpenrConfig:
     fib_config: FibConfig = field(default_factory=FibConfig)
     watchdog_config: WatchdogConfig = field(default_factory=WatchdogConfig)
     monitor_config: MonitorConfig = field(default_factory=MonitorConfig)
+    fault_injection_config: FaultInjectionConfig = field(
+        default_factory=FaultInjectionConfig
+    )
     prefix_allocation_config: Optional[PrefixAllocationConfig] = None
     segment_routing_config: SegmentRoutingConfig = field(
         default_factory=SegmentRoutingConfig
@@ -477,6 +508,34 @@ class Config:
             )
         if dc.solver_backend not in ("cpu", "tpu", "auto"):
             raise ConfigError(f"unknown solver_backend {dc.solver_backend!r}")
+        if not (
+            0 < dc.solver_probe_initial_backoff_s
+            <= dc.solver_probe_max_backoff_s
+        ):
+            raise ConfigError(
+                "decision solver probe backoff must satisfy 0 < initial <= max"
+            )
+        wc = cfg.watchdog_config
+        if wc.supervisor_crash_budget < 0:
+            raise ConfigError("supervisor_crash_budget must be >= 0")
+        if not (
+            0 < wc.supervisor_backoff_initial_s <= wc.supervisor_backoff_max_s
+        ):
+            raise ConfigError(
+                "supervisor backoff must satisfy 0 < initial <= max"
+            )
+        fi = cfg.fault_injection_config
+        for i, sched in enumerate(fi.schedules):
+            if not isinstance(sched, dict) or not sched.get("site"):
+                raise ConfigError(
+                    f"fault_injection_config.schedules[{i}] needs a 'site'"
+                )
+            p = float(sched.get("probability", 0.0))
+            if not 0.0 <= p <= 1.0:
+                raise ConfigError(
+                    f"fault_injection_config.schedules[{i}]: probability "
+                    f"{p} not in [0, 1]"
+                )
         kc = cfg.kvstore_config
         if kc.key_ttl_ms <= 0 and kc.key_ttl_ms != -1:
             raise ConfigError("kvstore key_ttl_ms must be positive or -1 (infinite)")

@@ -84,6 +84,12 @@ class CtrlServer(Actor):
         s.register("monitor.event_logs", self._event_logs)
         s.register("ctrl.monitor.logs", self._event_logs)
         s.register("ctrl.monitor.fleet", self._monitor_fleet)
+        s.register("ctrl.monitor.crashes", self._monitor_crashes)
+        # fault-injection registry (runtime/faults.py): arm / disarm /
+        # inspect chaos drills on the live daemon
+        s.register("ctrl.fault.inject", self._fault_inject)
+        s.register("ctrl.fault.clear", self._fault_clear)
+        s.register("ctrl.fault.list", self._fault_list)
         s.register("monitor.heap_profile.start", self._heap_profile_start)
         s.register("monitor.heap_profile.dump", self._heap_profile_dump)
         # device plane (runtime/device_stats.py + ops/xla_cache.ledger):
@@ -311,6 +317,46 @@ class CtrlServer(Actor):
         from openr_tpu.runtime.monitor import dump_heap_profile
 
         return await dump_heap_profile(int(top), bool(stop))
+
+    async def _monitor_crashes(self) -> list:
+        """Last task crashes (runtime/tasks.py ring), newest first."""
+        from openr_tpu.runtime.tasks import recent_crashes
+
+        return recent_crashes()
+
+    # -- fault injection (runtime/faults.py) -------------------------------
+
+    async def _fault_inject(
+        self,
+        site: str,
+        probability: float = 0.0,
+        every_nth: int = 0,
+        one_shot: bool = False,
+        window_s: float = 0.0,
+        max_fires: int = 0,
+        seed: Optional[int] = None,
+    ) -> dict:
+        from openr_tpu.runtime.faults import registry
+
+        return registry.arm(
+            site,
+            probability=float(probability),
+            every_nth=int(every_nth),
+            one_shot=bool(one_shot),
+            window_s=float(window_s),
+            max_fires=int(max_fires),
+            seed=seed if seed is None else int(seed),
+        )
+
+    async def _fault_clear(self, site: Optional[str] = None) -> dict:
+        from openr_tpu.runtime.faults import registry
+
+        return registry.clear(site)
+
+    async def _fault_list(self) -> dict:
+        from openr_tpu.runtime.faults import registry
+
+        return registry.list()
 
     async def _event_logs(self, category: Optional[str] = None) -> list:
         """ref getEventLogs — Monitor's LogSample ring, optionally

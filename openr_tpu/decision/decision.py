@@ -40,9 +40,10 @@ from openr_tpu.decision.rib_policy import RibPolicy
 from openr_tpu.decision.spf_solver import SpfSolver
 from openr_tpu.messaging import RQueue, ReplicateQueue
 from openr_tpu.runtime.actor import Actor
+from openr_tpu.runtime.faults import maybe_fail
 from openr_tpu.serde import from_plain, to_plain
 from openr_tpu.runtime.counters import counters
-from openr_tpu.runtime.throttle import AsyncDebounce
+from openr_tpu.runtime.throttle import AsyncDebounce, ExponentialBackoff
 from openr_tpu.runtime.tracing import TraceContext, tracer
 from openr_tpu.serde import deserialize
 from openr_tpu.types import (
@@ -182,6 +183,11 @@ class Decision(Actor):
         self._kvstore_synced = False
         self._first_build_done = False
         self._rebuild_debounced = None  # created on start (needs loop)
+        # mid-flight solver failover state: a device/runtime error during
+        # a full rebuild flips the node degraded (CPU oracle carries the
+        # load) until a canary probe proves the primary healthy again
+        self._degraded = False
+        self._probe_backoff: Optional[ExponentialBackoff] = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -191,10 +197,22 @@ class Decision(Actor):
             self.cfg.debounce_max_ms / 1e3,
             self.rebuild_routes,
         )
-        self.add_task(self._kvstore_loop(), name=f"{self.name}.kvstore")
+        self.add_supervised_task(
+            self._kvstore_loop, name=f"{self.name}.kvstore"
+        )
         if self._static_routes is not None:
-            self.add_task(self._static_loop(), name=f"{self.name}.static")
+            self.add_supervised_task(
+                self._static_loop, name=f"{self.name}.static"
+            )
         self._load_saved_rib_policy()
+
+    async def on_fiber_restart(self, task_name: str) -> None:
+        """A crashed ingest fiber may have died mid-apply: the LSDB
+        itself is intact (mutations are synchronous), but a batched
+        pending update may have been lost — force a full rebuild so the
+        next debounce re-derives routes from scratch."""
+        self.pending.needs_full_rebuild = True
+        self._trigger_rebuild()
 
     async def on_stop(self) -> None:
         if self._rebuild_debounced is not None:
@@ -205,6 +223,10 @@ class Decision(Actor):
     async def _kvstore_loop(self) -> None:
         while True:
             item = await self._kvstore_updates.get()
+            # chaos seam: crash the ingest fiber between dequeue and
+            # apply — the supervisor drill (restart + full-rebuild
+            # recovery) needs a deterministic place to die
+            maybe_fail("decision.ingest")
             if isinstance(item, Publication):
                 self.process_publication(item)
             elif item == InitializationEvent.KVSTORE_SYNCED:
@@ -356,16 +378,20 @@ class Decision(Actor):
         pending = self.pending
         self.pending = PendingUpdates()
         ctx = pending.trace
-        full = pending.needs_full_rebuild or not self._first_build_done
+        # while degraded every rebuild is a full one on the CPU oracle:
+        # the incremental path would still route through the primary
+        full = (
+            pending.needs_full_rebuild
+            or not self._first_build_done
+            or self._degraded
+        )
         t0 = time.perf_counter()
 
         spf_sp = tracer.start_span(
             ctx, "decision.spf", node=self.node_name, full=full
         )
         if full:
-            new_db = self.solver.build_route_db(
-                self.node_name, self.area_link_states, self.prefix_state
-            )
+            new_db = self._solve_full(ctx, spf_sp)
             if new_db is None:
                 tracer.end_span(spf_sp)
                 tracer.end_trace(ctx, status="not_in_lsdb")
@@ -426,6 +452,157 @@ class Decision(Actor):
         if not self._first_build_done:
             self._first_build_done = True
             self._route_updates_q.push(InitializationEvent.RIB_COMPUTED)
+
+    # -- mid-flight solver failover ----------------------------------------
+
+    def _solve_full(self, ctx, spf_sp):
+        """Full rebuild through the primary solver, failing over to its
+        CPU oracle mid-flight on a device/runtime error. Only solvers
+        that carry a `cpu` fallback (TpuSpfSolver) can fail over; on the
+        plain CPU backend the error propagates as before."""
+        fallback = getattr(self.solver, "cpu", None)
+        if not self._degraded:
+            try:
+                maybe_fail("solver.exec", span=spf_sp)
+                return self.solver.build_route_db(
+                    self.node_name, self.area_link_states, self.prefix_state
+                )
+            except Exception as e:
+                if not self.cfg.enable_solver_failover or fallback is None:
+                    raise
+                self._enter_degraded(e)
+        # degraded: the CPU oracle carries the load; stamp the evidence
+        # onto the spf span AND the trace root so the closed trace shows
+        # the event converged degraded
+        if spf_sp is not None:
+            spf_sp.attributes["degraded"] = True
+        tracer.annotate(ctx, degraded=True)
+        return fallback.build_route_db(
+            self.node_name, self.area_link_states, self.prefix_state
+        )
+
+    def _enter_degraded(self, exc: Exception) -> None:
+        self._degraded = True
+        counters.set_counter("decision.solver.degraded", 1)
+        counters.increment("decision.solver.failovers")
+        log.error(
+            "%s: device solver failed (%s: %s) — failing over to the "
+            "CPU oracle, probing the device on backoff",
+            self.name, type(exc).__name__, exc,
+        )
+        self._emit_solver_sample(
+            "DECISION_SOLVER_DEGRADED",
+            {"error": f"{type(exc).__name__}: {exc}"},
+        )
+        if self._probe_backoff is None:
+            self._probe_backoff = ExponentialBackoff(
+                self.cfg.solver_probe_initial_backoff_s,
+                self.cfg.solver_probe_max_backoff_s,
+            )
+        self._probe_backoff.report_error()
+        self._schedule_probe()
+
+    def _schedule_probe(self) -> None:
+        self.schedule(
+            self._probe_backoff.time_until_retry_s(), self._probe_primary
+        )
+
+    def _probe_primary(self) -> None:
+        """Canary the primary solver: a real device execution when the
+        solver exposes one (TpuSpfSolver.probe_device re-runs its last
+        compiled pipeline), else a tiny 2-node graph through the full
+        build path. Healthy -> promote back; still broken -> bump the
+        probe backoff and retry later."""
+        if not self._degraded:
+            return
+        try:
+            maybe_fail("solver.exec")
+            probe = getattr(self.solver, "probe_device", None)
+            if probe is not None:
+                probe()
+            else:
+                self._canary_solve()
+        except Exception as e:
+            counters.increment("decision.solver.probe_failures")
+            log.warning(
+                "%s: device probe failed (%s: %s); staying degraded",
+                self.name, type(e).__name__, e,
+            )
+            self._probe_backoff.report_error()
+            self._schedule_probe()
+            return
+        self._promote()
+
+    def _canary_solve(self) -> None:
+        """Probe fallback for solvers without probe_device: solve a
+        throwaway two-node topology and discard the result."""
+        ls = LinkState("~canary")
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name="~canary-a",
+                adjacencies=(
+                    Adjacency(
+                        other_node_name="~canary-b",
+                        if_name="c0",
+                        other_if_name="c1",
+                    ),
+                ),
+                area="~canary",
+            )
+        )
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name="~canary-b",
+                adjacencies=(
+                    Adjacency(
+                        other_node_name="~canary-a",
+                        if_name="c1",
+                        other_if_name="c0",
+                    ),
+                ),
+                area="~canary",
+            )
+        )
+        ps = PrefixState()
+        ps.update_prefix_database(
+            PrefixDatabase(
+                this_node_name="~canary-b",
+                prefix_entries=(PrefixEntry(prefix="192.0.2.1/32"),),
+                area="~canary",
+            )
+        )
+        self.solver.build_route_db("~canary-a", {"~canary": ls}, ps)
+
+    def _promote(self) -> None:
+        self._degraded = False
+        counters.set_counter("decision.solver.degraded", 0)
+        counters.increment("decision.solver.promotions")
+        self._probe_backoff.report_success()
+        log.warning(
+            "%s: device solver healthy again — promoting back from the "
+            "CPU fallback", self.name,
+        )
+        self._emit_solver_sample("DECISION_SOLVER_PROMOTED", {})
+        # full rebuild through the primary so the RIB is re-derived by
+        # the promoted backend (and any drift from the oracle heals)
+        self.pending.needs_full_rebuild = True
+        self._trigger_rebuild()
+
+    def _emit_solver_sample(self, event: str, values: dict) -> None:
+        if self._log_samples is None:
+            return
+        try:
+            from openr_tpu.runtime.monitor import LogSample
+
+            self._log_samples.push(
+                LogSample(
+                    event=event,
+                    node_name=self.node_name,
+                    values={"category": "sentinel", **values},
+                )
+            )
+        except Exception:  # pragma: no cover - telemetry must not kill
+            log.debug("%s: solver log sample failed", self.name)
 
     def _emit_sentinels(self, spf_sp) -> None:
         """Surface the solver's numerical-health sentinels

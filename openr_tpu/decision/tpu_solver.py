@@ -1901,3 +1901,20 @@ class TpuSpfSolver:
             o = run(*dev_args, *o[2:])
         jax.block_until_ready(o)
         return (_time.perf_counter() - t0) * 1e3 / iters
+
+    def probe_device(self) -> None:
+        """Health canary for Decision's degraded-mode re-promotion: run
+        ONE device execution and block on the result, raising whatever
+        the runtime raises when the device is unhealthy. Re-runs the
+        last compiled pipeline when one is resident (the cheapest real
+        execution — no recompilation); otherwise a trivial on-device
+        reduction proves dispatch + transfer work."""
+        import jax
+
+        if self._last_exec is not None:
+            run, dev_args, prev = self._last_exec
+            jax.block_until_ready(run(*dev_args, *prev))
+            return
+        import jax.numpy as jnp
+
+        jax.block_until_ready(jnp.arange(8, dtype=jnp.int32).sum())

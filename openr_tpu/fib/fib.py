@@ -38,6 +38,7 @@ from openr_tpu.fib.fib_service import FibServiceBase, FibUpdateError
 from openr_tpu.messaging import RQueue, ReplicateQueue
 from openr_tpu.runtime.actor import Actor
 from openr_tpu.runtime.counters import counters
+from openr_tpu.runtime.faults import maybe_fail
 from openr_tpu.runtime.throttle import ExponentialBackoff
 from openr_tpu.runtime.tracing import TraceContext, tracer
 from openr_tpu.types import (
@@ -128,9 +129,22 @@ class Fib(Actor):
             self._agent_alive_since = await self.service.alive_since()
         except Exception:
             pass  # keepalive loop will establish it
-        self.add_task(self._route_updates_loop(), name=f"{self.name}.updates")
-        self.add_task(self._retry_loop(), name=f"{self.name}.retry")
-        self.add_task(self._keepalive_loop(), name=f"{self.name}.keepalive")
+        self.add_supervised_task(
+            self._route_updates_loop, name=f"{self.name}.updates"
+        )
+        self.add_supervised_task(self._retry_loop, name=f"{self.name}.retry")
+        self.add_supervised_task(
+            self._keepalive_loop, name=f"{self.name}.keepalive"
+        )
+
+    async def on_fiber_restart(self, task_name: str) -> None:
+        """A fiber crash mid-programming leaves the agent's table state
+        unknown — force a full re-sync (same recovery as an agent
+        restart in the keepalive loop)."""
+        if self.route_state.state != FibState.AWAITING_UPDATE:
+            self.route_state.state = FibState.SYNCING
+        if self._retry_signal is not None:
+            self._retry_signal.set()
 
     # -- main update path (ref processDecisionRouteUpdate) -----------------
 
@@ -204,6 +218,9 @@ class Fib(Actor):
         failed_p: set = set()
         failed_l: set = set()
         try:
+            # chaos seam: a programming failure here must land in the
+            # existing retry-with-backoff machinery below
+            maybe_fail("fib.program", span=sp)
             await self.service.sync_fib(
                 CLIENT_ID_OPENR, list(rs.unicast_routes.values())
             )
@@ -398,6 +415,8 @@ class Fib(Actor):
         programmed = DecisionRouteUpdate(type=RouteUpdateType.INCREMENTAL)
         ok = True
         try:
+            # chaos seam: everything due stays dirty and retries
+            maybe_fail("fib.program", span=sp)
             if add_prefixes:
                 await self.service.add_unicast_routes(
                     CLIENT_ID_OPENR,

@@ -44,6 +44,7 @@ from openr_tpu.kvstore.engine import (
 from openr_tpu.messaging import RQueue, ReplicateQueue
 from openr_tpu.runtime.actor import Actor
 from openr_tpu.runtime.counters import counters
+from openr_tpu.runtime.faults import maybe_fail
 from openr_tpu.runtime.rpc import RpcClient, RpcServer
 from openr_tpu.runtime.throttle import ExponentialBackoff
 from openr_tpu.runtime.tracing import tracer
@@ -211,15 +212,26 @@ class KvStore(Actor):
             host=self._listen_addr, port=self._listen_port,
             ssl=self._server_ssl, peer_verifier=peer_verifier,
         )
-        self.add_task(self._peer_updates_loop(), name=f"{self.name}.peers")
-        self.add_task(self._kv_requests_loop(), name=f"{self.name}.requests")
-        self.add_task(self._sync_loop(), name=f"{self.name}.sync")
-        self.add_task(self._ttl_loop(), name=f"{self.name}.ttl")
-        self.add_task(self._ttl_refresh_loop(), name=f"{self.name}.ttl-refresh")
-        self.add_task(self._ttl_alarm_loop(), name=f"{self.name}.ttl-alarm")
+        # long-lived fibers run supervised: a crash restarts the loop
+        # (queue readers keep their backlog) instead of leaving a
+        # half-dead store that still answers RPCs
+        self.add_supervised_task(
+            self._peer_updates_loop, name=f"{self.name}.peers"
+        )
+        self.add_supervised_task(
+            self._kv_requests_loop, name=f"{self.name}.requests"
+        )
+        self.add_supervised_task(self._sync_loop, name=f"{self.name}.sync")
+        self.add_supervised_task(self._ttl_loop, name=f"{self.name}.ttl")
+        self.add_supervised_task(
+            self._ttl_refresh_loop, name=f"{self.name}.ttl-refresh"
+        )
+        self.add_supervised_task(
+            self._ttl_alarm_loop, name=f"{self.name}.ttl-alarm"
+        )
         if self.cfg.sync_interval_s > 0:
-            self.add_task(
-                self._anti_entropy_loop(), name=f"{self.name}.anti-entropy"
+            self.add_supervised_task(
+                self._anti_entropy_loop, name=f"{self.name}.anti-entropy"
             )
 
     async def on_stop(self) -> None:
@@ -228,6 +240,14 @@ class KvStore(Actor):
             for peer in area.peers.values():
                 if peer.client is not None:
                     await peer.client.close()
+
+    async def on_fiber_restart(self, task_name: str) -> None:
+        """Supervisor recovery: re-kick every wakeup event — the crashed
+        fiber may have consumed a wakeup without acting on it, and the
+        sync FSM must re-examine peers left mid-transition."""
+        self._sync_wakeup.set()
+        self._ttl_wakeup.set()
+        self._refresh_wakeup.set()
 
     # -- RPC server side ---------------------------------------------------
 
@@ -488,6 +508,9 @@ class KvStore(Actor):
             return
         try:
             t0 = time.monotonic()
+            # chaos seam: lands in the transport-failure path below, which
+            # must reset the peer session for re-sync
+            maybe_fail("kvstore.flood")
             await peer.client.request(
                 "kvstore.set_key_vals",
                 {

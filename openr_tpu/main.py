@@ -98,6 +98,11 @@ async def run_daemon(args) -> None:
     )
     log.info("starting openr_tpu node %s", node_name)
 
+    # -- fault injection: arm config-declared chaos schedules -------------
+    from openr_tpu.runtime.faults import registry as fault_registry
+
+    fault_registry.configure(oc.fault_injection_config)
+
     # -- persistent store (ref config-store start, Main.cpp:340) ----------
     store = (
         PersistentStore(oc.persistent_store_path)

@@ -22,6 +22,10 @@ T = TypeVar("T")
 # makes a top-level import circular); None until tracing is ever used
 _tracer = None
 
+# fault hook, lazily bound for the same circular-import reason; the
+# armed-site check itself is one dict lookup (runtime/faults.py)
+_maybe_fail = None
+
 
 class QueueClosedError(RuntimeError):
     """Raised from get() once the queue is closed and drained
@@ -98,6 +102,11 @@ class ReplicateQueue(Generic[T]):
         None and this costs one comparison."""
         if self._closed:
             raise QueueClosedError(self.name)
+        global _maybe_fail
+        if _maybe_fail is None:
+            from openr_tpu.runtime.faults import maybe_fail as _mf
+            _maybe_fail = _mf
+        _maybe_fail("queue.push")
         if trace is not None:
             global _tracer
             if _tracer is None:

@@ -20,9 +20,19 @@ import time
 from typing import Any, Callable, Coroutine, Optional
 
 from openr_tpu.messaging import QueueClosedError
-from openr_tpu.runtime.tasks import spawn_logged
+from openr_tpu.runtime.counters import counters
+from openr_tpu.runtime.tasks import record_crash, spawn_logged
+from openr_tpu.runtime.throttle import ExponentialBackoff
 
 log = logging.getLogger(__name__)
+
+# Supervisor defaults (ref systemd Restart=on-failure + StartLimitBurst:
+# the reference daemon leans on an external supervisor; in-process fibers
+# get the same restart-with-backoff-then-escalate contract). Overridden
+# per actor by Watchdog.watch_actor from watchdog_config.
+SUPERVISOR_CRASH_BUDGET = 3
+SUPERVISOR_BACKOFF_INITIAL_S = 0.05
+SUPERVISOR_BACKOFF_MAX_S = 2.0
 
 
 class Timer:
@@ -75,6 +85,15 @@ class Actor:
         self._running = False
         # Health timestamp for watchdog liveness (ref OpenrEventBase.h:76).
         self.last_alive_ts = time.monotonic()
+        # Supervisor state: restarts are budgeted PER ACTOR (a flapping
+        # fiber and a cascade across fibers both exhaust the same budget);
+        # Watchdog.watch_actor overrides the knobs from config and wires
+        # _escalate to its crash handler.
+        self.crash_budget = SUPERVISOR_CRASH_BUDGET
+        self.restart_backoff_initial_s = SUPERVISOR_BACKOFF_INITIAL_S
+        self.restart_backoff_max_s = SUPERVISOR_BACKOFF_MAX_S
+        self._escalate: Optional[Callable[[str], Any]] = None
+        self._crash_count = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -123,7 +142,8 @@ class Actor:
                 await coro
             except (QueueClosedError, asyncio.CancelledError):
                 pass
-            except Exception:
+            except Exception as e:
+                record_crash(name or f"{self.name}.task", e)
                 log.exception("%s: task %s crashed", self.name, name)
                 raise
 
@@ -149,6 +169,117 @@ class Actor:
 
         task.add_done_callback(_done)
         return task
+
+    def add_supervised_task(
+        self,
+        factory: Callable[[], Coroutine[Any, Any, Any]],
+        name: str = "",
+    ) -> asyncio.Task:
+        """Supervised fiber (role of systemd Restart=on-failure for the
+        reference daemon, scoped to one fiber): `factory` is a zero-arg
+        callable returning a fresh coroutine — a crash restarts it with
+        ExponentialBackoff after running the actor's recovery hook
+        (on_fiber_restart), until the per-actor crash budget is exhausted
+        and the failure escalates to the Watchdog crash handler."""
+        return self.add_task(self._supervise(factory, name), name=name)
+
+    async def _supervise(
+        self, factory: Callable[[], Coroutine[Any, Any, Any]], name: str
+    ) -> None:
+        backoff: Optional[ExponentialBackoff] = None
+        while True:
+            try:
+                await factory()
+                return
+            except (QueueClosedError, asyncio.CancelledError):
+                raise  # shutdown paths are not crashes
+            except Exception as e:
+                record_crash(name or f"{self.name}.task", e)
+                self._crash_count += 1
+                if self._crash_count > self.crash_budget:
+                    counters.increment("runtime.supervisor.escalations")
+                    reason = (
+                        f"{self.name}: fiber {name or '?'} exceeded crash "
+                        f"budget ({self.crash_budget}): "
+                        f"{type(e).__name__}: {e}"
+                    )
+                    log.critical(reason)
+                    if self._escalate is not None:
+                        self._escalate(reason)
+                    raise
+                # knobs are read lazily so Watchdog.watch_actor config
+                # applied after start() still takes effect
+                if backoff is None:
+                    backoff = ExponentialBackoff(
+                        self.restart_backoff_initial_s,
+                        self.restart_backoff_max_s,
+                    )
+                backoff.report_error()
+                delay = backoff.time_until_retry_s()
+                counters.increment("runtime.supervisor.restarts")
+                counters.increment(
+                    f"runtime.supervisor.restarts.{self.name}"
+                )
+                log.warning(
+                    "%s: supervisor restarting fiber %s in %.2fs "
+                    "(crash %d/%d): %s",
+                    self.name, name, delay, self._crash_count,
+                    self.crash_budget, e,
+                )
+                self._emit_supervisor_restart(name, e)
+                await asyncio.sleep(delay)
+                try:
+                    await self.on_fiber_restart(name)
+                except Exception:
+                    log.exception(
+                        "%s: recovery hook failed for fiber %s",
+                        self.name, name,
+                    )
+
+    async def on_fiber_restart(self, task_name: str) -> None:
+        """Recovery hook run before a supervised fiber restarts (override:
+        re-subscribe queues, force a full rebuild/resync, ...)."""
+
+    def _emit_supervisor_restart(self, name: str, exc: Exception) -> None:
+        """Surface the restart: SUPERVISOR_RESTART log sample (when the
+        actor carries a log-sample queue) + a span event in the tracer's
+        closed ring so drills can see restarts next to convergence."""
+        q = getattr(self, "_log_samples", None) or getattr(
+            self, "_log_sample_q", None
+        )
+        if q is not None:
+            try:
+                from openr_tpu.runtime.monitor import LogSample
+
+                q.push(
+                    LogSample(
+                        event="SUPERVISOR_RESTART",
+                        node_name=getattr(self, "node_name", self.name),
+                        values={
+                            "category": "supervisor",
+                            "actor": self.name,
+                            "task": name,
+                            "restart": self._crash_count,
+                            "error": f"{type(exc).__name__}: {exc}",
+                        },
+                    )
+                )
+            except Exception:  # pragma: no cover - telemetry must not kill
+                log.debug("%s: restart log sample failed", self.name)
+        try:
+            from openr_tpu.runtime.tracing import tracer
+
+            ctx = tracer.start_trace(
+                "runtime.supervisor.restart",
+                actor=self.name,
+                task=name,
+                restart=self._crash_count,
+                error=type(exc).__name__,
+            )
+            if ctx is not None:
+                tracer.end_trace(ctx, status="supervisor_restart")
+        except Exception:  # pragma: no cover
+            log.debug("%s: restart span failed", self.name)
 
     def make_timer(self, callback: Callable[[], Any]) -> Timer:
         t = Timer(callback)

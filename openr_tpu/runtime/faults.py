@@ -1,0 +1,202 @@
+"""Deterministic fault-injection registry — the chaos-drill seam.
+
+Role of the fault-injection tooling a training/inference platform uses to
+validate preemption and device-loss handling: recovery paths (supervised
+fiber restart, TPU->CPU solver failover, KvStore peer resync, FIB retry)
+only count as working if they can be *driven* on demand, reproducibly.
+
+Named sites call ``maybe_fail("site")`` on their hot path. When nothing is
+armed the check is a single dict lookup on an empty dict — near-zero cost.
+Arming a site attaches a schedule:
+
+  - probability  p in (0, 1]: fire on each check with probability p,
+    drawn from a PRNG seeded by (registry seed, site) — the firing
+    pattern is identical for identical seeds and check sequences
+  - every_nth    fire on every Nth check of the site
+  - one_shot     fire on the first check, then disarm
+  - window_s     schedule stays armed for this long after arming
+  - max_fires    disarm after this many firings
+
+Schedules come from ``config.py`` (fault_injection_config, armed at daemon
+startup) or at runtime via the ``ctrl.fault.{inject,clear,list}`` endpoints
+(``breeze fault ...``). Every firing bumps ``runtime.fault.<site>.fired``
+and, when the caller passes the active trace span, stamps
+``fault_injected=<site>`` onto it.
+"""
+
+from __future__ import annotations
+
+import time
+from random import Random
+from typing import Optional
+
+from openr_tpu.runtime.counters import counters
+
+# The sites wired into the codebase today (the registry itself accepts any
+# name — new sites need only a maybe_fail() call).
+KNOWN_SITES = (
+    "rpc.send",  # RpcClient.request, before the frame is written
+    "kvstore.flood",  # KvStore._flood_to_peer, before the peer RPC
+    "fib.program",  # Fib sync/incremental programming, before the service call
+    "solver.exec",  # Decision primary SPF execution + TPU device dispatch
+    "queue.push",  # ReplicateQueue.push fan-out
+    "decision.ingest",  # Decision._kvstore_loop, after the queue read
+)
+
+
+class FaultInjected(ConnectionError):
+    """Raised by an armed site. Subclasses ConnectionError so transport
+    call sites treat it exactly like the I/O failure it simulates."""
+
+    def __init__(self, site: str):
+        super().__init__(f"injected fault at {site!r}")
+        self.site = site
+
+
+class FaultSchedule:
+    """One armed site: schedule parameters + firing state."""
+
+    def __init__(
+        self,
+        site: str,
+        probability: float = 0.0,
+        every_nth: int = 0,
+        window_s: float = 0.0,
+        max_fires: int = 0,
+        seed: int = 0,
+    ):
+        self.site = site
+        self.probability = probability
+        self.every_nth = every_nth
+        self.window_s = window_s
+        self.max_fires = max_fires
+        self.seed = seed
+        self.checks = 0
+        self.fires = 0
+        self.armed_at = time.monotonic()
+        # string seeding hashes via sha512 — stable across processes,
+        # unlike hash() which is salted per interpreter
+        self.rng = Random(f"{seed}/{site}")
+
+    def describe(self) -> dict:
+        d = {
+            "site": self.site,
+            "probability": self.probability,
+            "every_nth": self.every_nth,
+            "window_s": self.window_s,
+            "max_fires": self.max_fires,
+            "seed": self.seed,
+            "checks": self.checks,
+            "fires": self.fires,
+        }
+        if self.window_s:
+            d["remaining_window_s"] = max(
+                0.0, self.window_s - (time.monotonic() - self.armed_at)
+            )
+        return d
+
+
+class FaultRegistry:
+    """Process-global armed-site table (one daemon = one registry)."""
+
+    def __init__(self):
+        self.seed = 0
+        self._armed: dict[str, FaultSchedule] = {}
+
+    # -- arming / clearing -------------------------------------------------
+
+    def configure(self, cfg) -> None:
+        """Apply a config.FaultInjectionConfig at startup."""
+        self.seed = int(cfg.seed)
+        self.clear()
+        if not cfg.enable_fault_injection:
+            return
+        for sched in cfg.schedules:
+            self.arm(**dict(sched))
+
+    def arm(
+        self,
+        site: str,
+        probability: float = 0.0,
+        every_nth: int = 0,
+        one_shot: bool = False,
+        window_s: float = 0.0,
+        max_fires: int = 0,
+        seed: Optional[int] = None,
+    ) -> dict:
+        if not site:
+            raise ValueError("fault site name must be non-empty")
+        probability = float(probability)
+        if not 0.0 <= probability <= 1.0:
+            raise ValueError(f"probability {probability} not in [0, 1]")
+        if int(every_nth) < 0 or int(max_fires) < 0 or float(window_s) < 0:
+            raise ValueError("every_nth/max_fires/window_s must be >= 0")
+        if one_shot:
+            max_fires = 1
+        self._armed[site] = FaultSchedule(
+            site,
+            probability=probability,
+            every_nth=int(every_nth),
+            window_s=float(window_s),
+            max_fires=int(max_fires),
+            seed=self.seed if seed is None else int(seed),
+        )
+        counters.increment("runtime.fault.armed")
+        return self._armed[site].describe()
+
+    def clear(self, site: Optional[str] = None) -> dict:
+        """Disarm one site, or every site when site is None."""
+        if site is None:
+            cleared = sorted(self._armed)
+            self._armed.clear()
+        else:
+            cleared = [site] if self._armed.pop(site, None) is not None else []
+        return {"cleared": cleared}
+
+    def list(self) -> dict:
+        return {
+            "seed": self.seed,
+            "known_sites": list(KNOWN_SITES),
+            "armed": [s.describe() for s in self._armed.values()],
+        }
+
+    # -- the hook ----------------------------------------------------------
+
+    def maybe_fail(self, site: str, span=None) -> None:
+        """Hot-path check: raises FaultInjected when the site's schedule
+        fires. `span` (a tracing Span, optional) is stamped with the
+        firing for trace-level attribution."""
+        sched = self._armed.get(site)
+        if sched is None:
+            return
+        self._check(sched, span)
+
+    def _check(self, s: FaultSchedule, span) -> None:
+        if s.window_s and (time.monotonic() - s.armed_at) > s.window_s:
+            self._armed.pop(s.site, None)
+            return
+        s.checks += 1
+        if s.every_nth > 0:
+            fire = (s.checks % s.every_nth) == 0
+        elif s.probability > 0.0:
+            fire = s.rng.random() < s.probability
+        else:
+            fire = True  # unconditional schedule (window/one-shot style)
+        if not fire:
+            return
+        s.fires += 1
+        counters.increment(f"runtime.fault.{s.site}.fired")
+        counters.increment("runtime.fault.fired")
+        if s.max_fires and s.fires >= s.max_fires:
+            self._armed.pop(s.site, None)
+        if span is not None and hasattr(span, "attributes"):
+            span.attributes["fault_injected"] = s.site
+        raise FaultInjected(s.site)
+
+
+registry = FaultRegistry()
+
+
+def maybe_fail(site: str, span=None) -> None:
+    """Module-level hook; see FaultRegistry.maybe_fail."""
+    registry.maybe_fail(site, span)

@@ -195,6 +195,12 @@ class Monitor(Actor):
             "sentinel_anomalies": int(
                 counters.get_counter("decision.sentinel.anomalies") or 0
             ),
+            "solver_degraded": bool(
+                counters.get_counter("decision.solver.degraded") or 0
+            ),
+            "supervisor_restarts": int(
+                counters.get_counter("runtime.supervisor.restarts") or 0
+            ),
             "event_logs_dropped": int(
                 counters.get_counter("monitor.event_logs.dropped") or 0
             ),
@@ -336,8 +342,18 @@ class Watchdog(Actor):
         self._prev_readers: dict[str, set[str]] = {}
 
     def watch_actor(self, actor: Actor) -> None:
-        """ref addEvb — actors stamp last_alive_ts (actor.py heartbeat)."""
+        """ref addEvb — actors stamp last_alive_ts (actor.py heartbeat).
+
+        Also wires the actor's fiber supervisor to this watchdog: config
+        knobs override the actor defaults, and a fiber that exhausts its
+        crash budget escalates into _fire (same path as a stalled
+        heartbeat). The supervisor reads the knobs lazily, so applying
+        them after the actor started is fine."""
         self._watched_actors.append(actor)
+        actor.crash_budget = self.cfg.supervisor_crash_budget
+        actor.restart_backoff_initial_s = self.cfg.supervisor_backoff_initial_s
+        actor.restart_backoff_max_s = self.cfg.supervisor_backoff_max_s
+        actor._escalate = self._fire
 
     def watch_queue(self, queue: ReplicateQueue) -> None:
         """ref addQueue — depth counters (Watchdog.h:45-48)."""

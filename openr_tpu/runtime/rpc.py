@@ -26,6 +26,8 @@ import json
 import logging
 from typing import Any, Awaitable, Callable, Optional
 
+from openr_tpu.runtime.faults import maybe_fail
+
 log = logging.getLogger(__name__)
 
 # names the current connection's TLS peer certificate claims (CN/SAN);
@@ -147,8 +149,15 @@ class RpcServer:
         for t in list(self._conn_tasks):
             try:
                 await t
-            except (asyncio.CancelledError, Exception):
+            except asyncio.CancelledError:
                 pass
+            except Exception:
+                # cancellation is the expected path; anything else is a
+                # real teardown bug — surface it instead of masking
+                log.warning(
+                    "%s: connection handler failed during stop",
+                    self.name, exc_info=True,
+                )
         self._conn_tasks.clear()
         if self._server is not None:
             self._server.close()
@@ -336,8 +345,13 @@ class RpcClient:
                 self._read_task.cancel()
                 try:
                     await self._read_task
-                except (asyncio.CancelledError, Exception):
+                except asyncio.CancelledError:
                     pass
+                except Exception:
+                    log.warning(
+                        "%s: read loop failed during close",
+                        self.name, exc_info=True,
+                    )
                 self._read_task = None
 
     def _teardown(self, err: Exception) -> None:
@@ -395,6 +409,9 @@ class RpcClient:
     async def request(
         self, method: str, params: Optional[dict] = None, timeout_s: float = 30.0
     ) -> Any:
+        # chaos seam: an armed "rpc.send" raises before any bytes move,
+        # simulating a peer that became unreachable mid-conversation
+        maybe_fail("rpc.send")
         await self.connect()
         assert self._writer is not None
         req_id = next(self._ids)

@@ -6,17 +6,60 @@ exception surfaces only as "Task exception was never retrieved". Timer and
 throttle callbacks route through spawn_logged() instead: the module-level
 set retains the task until completion and a done-callback logs failures
 with the owning component's name.
+
+Every fiber death is also recorded centrally — a ``runtime.task_crash.<name>``
+counter plus a small last-crashes ring served by ``ctrl.monitor.crashes``
+(``breeze monitor crashes``) — so a half-dead node whose queue consumer
+silently stopped is visible from the outside, with or without the
+supervisor (runtime/actor.py) in the restart path.
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
+import time
+import traceback
+from collections import deque
 from typing import Any, Coroutine
 
 log = logging.getLogger("openr_tpu.runtime")
 
 _live_tasks: set[asyncio.Task] = set()
+
+# last-crashes ring: newest-last {task, error, traceback, ts_ms}
+_CRASH_RING_SIZE = 50
+_crash_ring: deque = deque(maxlen=_CRASH_RING_SIZE)
+
+
+def record_crash(task_name: str, exc: BaseException) -> None:
+    """Central fiber-death ledger: counter + ring entry. Idempotent per
+    exception instance so supervisor + runner layers don't double-count."""
+    if getattr(exc, "_openr_crash_recorded", False):
+        return
+    try:
+        exc._openr_crash_recorded = True  # type: ignore[attr-defined]
+    except Exception:
+        pass  # exceptions with __slots__; double-count is the worst case
+    from openr_tpu.runtime.counters import counters
+
+    counters.increment("runtime.task_crash")
+    counters.increment(f"runtime.task_crash.{task_name or 'unnamed'}")
+    _crash_ring.append(
+        {
+            "task": task_name or "unnamed",
+            "error": f"{type(exc).__name__}: {exc}",
+            "traceback": "".join(
+                traceback.format_exception(type(exc), exc, exc.__traceback__)
+            )[-2000:],
+            "ts_ms": int(time.time() * 1000),
+        }
+    )
+
+
+def recent_crashes() -> list[dict]:
+    """Newest-first snapshot of the last-crashes ring."""
+    return list(reversed(_crash_ring))
 
 
 def spawn_logged(coro: Coroutine[Any, Any, Any], name: str = "") -> asyncio.Task:
@@ -37,6 +80,7 @@ def spawn_logged(coro: Coroutine[Any, Any, Any], name: str = "") -> asyncio.Task
 
         if isinstance(exc, QueueClosedError):
             return
+        record_crash(t.get_name(), exc)
         log.error("task %s crashed", t.get_name(), exc_info=exc)
 
     task.add_done_callback(_done)

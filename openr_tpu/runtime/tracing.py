@@ -303,6 +303,18 @@ class Tracer:
             tr.spans.append(span)
             return span
 
+    def annotate(self, ctx: Optional[TraceContext], **attributes) -> None:
+        """Stamp attributes onto an active trace's root span without
+        closing it — e.g. degraded=True when the solver failed over
+        mid-flight, so the trace closes carrying the marker."""
+        if ctx is None or not self.enabled or not attributes:
+            return
+        with self._lock:
+            tr = self._active.get(ctx.trace_id)
+            if tr is None:
+                return
+            tr.spans[0].attributes.update(attributes)
+
     def end_trace(
         self, ctx: Optional[TraceContext], status: str = "ok", **attributes
     ) -> None:

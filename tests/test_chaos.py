@@ -1,0 +1,282 @@
+"""Chaos drills — fault-injected system tests (ISSUE 4 acceptance).
+
+Full node stacks on a MockIoMesh, with faults armed through the same
+registry `breeze fault inject` drives in production:
+
+  - kill-the-TPU: arm `solver.exec` mid-convergence on a 3-node topology;
+    routes must keep converging through the CPU fallback, the node must
+    report degraded (gauge + fleet health + trace stamp), and the device
+    must be promoted back once the fault clears.
+  - decision fiber crash: arm `decision.ingest`; the supervisor must
+    restart the fiber within budget and the pipeline must keep working.
+  - spark graceful restart: a restarting node's routes must be held
+    through the GR window and flushed when it expires.
+
+Marked slow (out of the tier-1 lane) + chaos (the CI chaos lane).
+"""
+
+import asyncio
+import contextlib
+
+import pytest
+
+from openr_tpu.config import DecisionConfig, MonitorConfig, SparkConfig
+from openr_tpu.kvstore.wrapper import wait_until
+from openr_tpu.runtime.counters import counters
+from openr_tpu.runtime.faults import registry
+from openr_tpu.runtime.monitor import Monitor
+from openr_tpu.runtime.openr_wrapper import OpenrWrapper
+from openr_tpu.runtime.tracing import tracer
+from openr_tpu.spark import MockIoMesh
+from openr_tpu.types import Value
+from tests.conftest import run_async
+
+pytestmark = [pytest.mark.slow, pytest.mark.chaos]
+
+CONVERGENCE_S = 20.0
+
+
+async def start_mesh(names, links, **wrapper_kwargs):
+    """test_system.start_mesh, plus per-node wrapper kwargs (solver
+    backend, probe-tuned decision config, spark GR timers)."""
+    mesh = MockIoMesh()
+    kv_ports: dict[str, int] = {}
+    nodes = {
+        n: OpenrWrapper(n, mesh.provider(n), kv_ports, **wrapper_kwargs)
+        for n in names
+    }
+    for a, if_a, b, if_b in links:
+        mesh.connect(a, if_a, b, if_b)
+    ifaces = {n: [] for n in names}
+    for a, if_a, b, if_b in links:
+        ifaces[a].append(if_a)
+        ifaces[b].append(if_b)
+    for n, w in nodes.items():
+        await w.start(*ifaces[n])
+    return mesh, nodes
+
+
+async def stop_all(nodes):
+    for w in nodes.values():
+        with contextlib.suppress(Exception):
+            await w.stop()
+
+
+def loopback(i: int) -> str:
+    return f"10.0.0.{i + 1}/32"
+
+
+def _counter(key):
+    return counters.get_counter(key) or 0
+
+
+def _degraded_trace_closed():
+    return any(
+        t["spans"][0]["attributes"].get("degraded") is True
+        and t["status"] == "ok"
+        for t in tracer.get_traces(limit=500)
+    )
+
+
+class TestKillTheTpuDrill:
+    @run_async
+    async def test_solver_failover_mid_convergence(self):
+        """Triangle a-b-c on the TPU backend; the device 'dies' (armed
+        solver.exec) right before a topology change."""
+        registry.clear()
+        counters.set_counter("decision.solver.degraded", 0)
+        names = ["node-0", "node-1", "node-2"]
+        links = [
+            ("node-0", "if-01", "node-1", "if-10"),
+            ("node-1", "if-12", "node-2", "if-21"),
+            ("node-2", "if-20", "node-0", "if-02"),
+        ]
+        mesh, nodes = await start_mesh(
+            names,
+            links,
+            solver_backend="tpu",
+            decision_config=DecisionConfig(
+                debounce_min_ms=5,
+                debounce_max_ms=25,
+                solver_probe_initial_backoff_s=0.2,
+                solver_probe_max_backoff_s=0.5,
+            ),
+        )
+        mon = Monitor(
+            "node-0",
+            MonitorConfig(),
+            nodes["node-0"].log_sample_queue.get_reader("drill"),
+        )
+        try:
+            for i, n in enumerate(names):
+                nodes[n].advertise_prefix(loopback(i))
+
+            def converged():
+                for i, n in enumerate(names):
+                    expect = {loopback(j) for j in range(3) if j != i}
+                    if set(nodes[n].fib_routes) != expect:
+                        return False
+                return True
+
+            await wait_until(converged, timeout_s=CONVERGENCE_S)
+            failovers0 = _counter("decision.solver.failovers")
+            promotions0 = _counter("decision.solver.promotions")
+
+            # the device dies mid-flight...
+            registry.arm("solver.exec")
+            # ...and then the topology changes: cut node-0 <-> node-2
+            mesh.disconnect("node-0", "if-02", "node-2", "if-20")
+
+            def rerouted_degraded():
+                entry = nodes["node-0"].fib_routes.get(loopback(2))
+                if entry is None:
+                    return False
+                via_b = {
+                    nh.neighbor_node_name for nh in entry.nexthops
+                } == {"node-1"}
+                return via_b and _counter("decision.solver.degraded") == 1
+
+            # routes converge anyway — carried by the CPU oracle
+            await wait_until(rerouted_degraded, timeout_s=CONVERGENCE_S)
+            assert _counter("decision.solver.failovers") > failovers0
+            # the node reports degraded in fleet health...
+            assert mon.health_summary()["solver_degraded"] is True
+            # ...and the convergence trace closed stamped degraded=true
+            await wait_until(_degraded_trace_closed, timeout_s=CONVERGENCE_S)
+            # probes keep failing while the fault is armed
+            await wait_until(
+                lambda: _counter("decision.solver.probe_failures") >= 1,
+                timeout_s=CONVERGENCE_S,
+            )
+            assert _counter("decision.solver.degraded") == 1
+
+            # the device heals: clear the fault, probes promote it back
+            registry.clear("solver.exec")
+            await wait_until(
+                lambda: _counter("decision.solver.degraded") == 0
+                and _counter("decision.solver.promotions") > promotions0,
+                timeout_s=CONVERGENCE_S,
+            )
+            assert mon.health_summary()["solver_degraded"] is False
+
+            # the promoted pipeline still routes fresh state end to end
+            nodes["node-2"].advertise_prefix("10.77.0.0/24")
+            await wait_until(
+                lambda: "10.77.0.0/24" in nodes["node-0"].fib_routes,
+                timeout_s=CONVERGENCE_S,
+            )
+        finally:
+            registry.clear()
+            counters.set_counter("decision.solver.degraded", 0)
+            await stop_all(nodes)
+
+
+class TestDecisionFiberCrashDrill:
+    @run_async
+    async def test_supervisor_restarts_crashed_ingest_fiber(self):
+        registry.clear()
+        names = ["node-0", "node-1"]
+        links = [("node-0", "if-01", "node-1", "if-10")]
+        mesh, nodes = await start_mesh(names, links)
+        try:
+            for i, n in enumerate(names):
+                nodes[n].advertise_prefix(loopback(i))
+            await wait_until(
+                lambda: loopback(1) in nodes["node-0"].fib_routes,
+                timeout_s=CONVERGENCE_S,
+            )
+            restarts0 = _counter("runtime.supervisor.restarts")
+
+            # next two publications popped by a Decision ingest fiber
+            # (either node — the registry is process-global) kill it
+            registry.arm("decision.ingest", every_nth=1, max_fires=2)
+            kv = nodes["node-0"].kvstore
+            area = next(iter(kv.areas))
+            for i in range(2):
+                await kv.set_key_vals(
+                    area,
+                    {
+                        f"chaos:junk-{i}": Value(
+                            version=1,
+                            originator_id="node-0",
+                            value=b"x",
+                            ttl_ms=-1,
+                            ttl_version=0,
+                            hash=None,
+                        )
+                    },
+                )
+                await asyncio.sleep(0.05)
+
+            # both crashes restarted within the (default 3) budget
+            await wait_until(
+                lambda: _counter("runtime.supervisor.restarts")
+                >= restarts0 + 2
+                and not registry.list()["armed"],
+                timeout_s=CONVERGENCE_S,
+            )
+            from openr_tpu.runtime.tasks import recent_crashes
+
+            assert any(
+                c["task"].startswith("decision:")
+                and "injected fault" in c["error"]
+                for c in recent_crashes()
+            )
+
+            # the restarted fiber still ingests: a fresh prefix converges
+            nodes["node-1"].advertise_prefix("10.99.0.0/24")
+            await wait_until(
+                lambda: "10.99.0.0/24" in nodes["node-0"].fib_routes,
+                timeout_s=CONVERGENCE_S,
+            )
+        finally:
+            registry.clear()
+            await stop_all(nodes)
+
+
+class TestSparkGracefulRestartDrill:
+    @run_async
+    async def test_routes_held_through_gr_window_then_flushed(self):
+        registry.clear()
+        names = ["node-0", "node-1"]
+        links = [("node-0", "if-01", "node-1", "if-10")]
+        mesh, nodes = await start_mesh(
+            names,
+            links,
+            spark_config=SparkConfig(
+                hello_time_s=0.08,
+                fastinit_hello_time_ms=20,
+                keepalive_time_s=0.05,
+                hold_time_s=0.4,
+                graceful_restart_time_s=2.5,
+                handshake_time_ms=40,
+                min_packets_per_sec=0,
+            ),
+        )
+        try:
+            for i, n in enumerate(names):
+                nodes[n].advertise_prefix(loopback(i))
+            await wait_until(
+                lambda: loopback(0) in nodes["node-1"].fib_routes,
+                timeout_s=CONVERGENCE_S,
+            )
+            gr_expired0 = _counter("spark.neighbor.gr_expired")
+
+            # node-0 announces a graceful restart, then goes dark
+            await nodes["node-0"].spark.send_restarting_hellos()
+            await nodes["node-0"].stop()
+
+            # well past hold_time (0.4s) but inside the GR window (2.5s):
+            # node-1 must still hold node-0's route
+            await asyncio.sleep(1.0)
+            assert loopback(0) in nodes["node-1"].fib_routes
+
+            # node-0 never comes back: GR expiry flushes the route
+            await wait_until(
+                lambda: loopback(0) not in nodes["node-1"].fib_routes,
+                timeout_s=10,
+            )
+            assert _counter("spark.neighbor.gr_expired") > gr_expired0
+        finally:
+            registry.clear()
+            await stop_all(nodes)

@@ -142,6 +142,42 @@ class TestCtrlServer:
             await b.stop()
 
     @run_async
+    async def test_fault_and_crash_endpoints(self):
+        """ctrl.fault.{inject,clear,list} + ctrl.monitor.crashes — the
+        runtime arm/disarm surface breeze fault / monitor crashes use."""
+        from openr_tpu.runtime.faults import registry
+
+        registry.clear()
+        mesh, a, b = await start_two_node()
+        client = RpcClient("127.0.0.1", a.ctrl.port)
+        try:
+            armed = await client.request(
+                "ctrl.fault.inject",
+                {"site": "rpc.send", "every_nth": 3, "max_fires": 5},
+            )
+            assert armed["site"] == "rpc.send"
+            assert armed["every_nth"] == 3
+
+            listed = await client.request("ctrl.fault.list")
+            assert [s["site"] for s in listed["armed"]] == ["rpc.send"]
+            assert "solver.exec" in listed["known_sites"]
+
+            cleared = await client.request(
+                "ctrl.fault.clear", {"site": "rpc.send"}
+            )
+            assert cleared == {"cleared": ["rpc.send"]}
+            listed = await client.request("ctrl.fault.list")
+            assert listed["armed"] == []
+
+            crashes = await client.request("ctrl.monitor.crashes")
+            assert isinstance(crashes, list)
+        finally:
+            registry.clear()
+            await client.close()
+            await a.stop()
+            await b.stop()
+
+    @run_async
     async def test_drain_via_ctrl(self):
         mesh, a, b = await start_two_node()
         client = RpcClient("127.0.0.1", a.ctrl.port)

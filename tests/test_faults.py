@@ -1,0 +1,446 @@
+"""Fault-injection registry, supervised-fiber restart, and solver-failover
+unit tests (ISSUE 4 tentpole). System-level drills live in test_chaos.py;
+this file is tier-1 safe (no network meshes, sub-second runtimes).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from openr_tpu.config import (
+    DecisionConfig,
+    FaultInjectionConfig,
+    WatchdogConfig,
+)
+from openr_tpu.decision.decision import Decision
+from openr_tpu.decision.link_state import LinkState
+from openr_tpu.decision.prefix_state import PrefixState
+from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.messaging import ReplicateQueue
+from openr_tpu.runtime.actor import Actor
+from openr_tpu.runtime.counters import counters
+from openr_tpu.runtime.faults import (
+    FaultInjected,
+    maybe_fail,
+    registry,
+)
+from openr_tpu.runtime.monitor import Watchdog
+from openr_tpu.runtime.tasks import recent_crashes
+from openr_tpu.runtime.tracing import tracer
+from openr_tpu.types import (
+    Adjacency,
+    AdjacencyDatabase,
+    PrefixDatabase,
+    PrefixEntry,
+)
+from tests.conftest import run_async
+
+
+def _counter(key):
+    return counters.get_counter(key) or 0
+
+
+# ---------------------------------------------------------------------------
+# registry schedules
+# ---------------------------------------------------------------------------
+
+class TestFaultRegistry:
+    def teardown_method(self):
+        registry.clear()
+
+    def test_idle_site_is_noop(self):
+        registry.clear()
+        maybe_fail("solver.exec")  # nothing armed: must not raise
+
+    def test_unconditional_fire_and_counters(self):
+        base = _counter("runtime.fault.rpc.send.fired")
+        registry.arm("rpc.send")
+        with pytest.raises(FaultInjected) as ei:
+            maybe_fail("rpc.send")
+        assert ei.value.site == "rpc.send"
+        assert isinstance(ei.value, ConnectionError)
+        assert _counter("runtime.fault.rpc.send.fired") == base + 1
+        # other sites unaffected
+        maybe_fail("fib.program")
+
+    def test_every_nth(self):
+        registry.arm("queue.push", every_nth=3)
+        fired = []
+        for i in range(9):
+            try:
+                maybe_fail("queue.push")
+                fired.append(False)
+            except FaultInjected:
+                fired.append(True)
+        assert fired == [False, False, True] * 3
+
+    def test_one_shot_disarms_after_single_fire(self):
+        registry.arm("fib.program", one_shot=True)
+        with pytest.raises(FaultInjected):
+            maybe_fail("fib.program")
+        maybe_fail("fib.program")  # disarmed
+        assert registry.list()["armed"] == []
+
+    def test_max_fires(self):
+        registry.arm("solver.exec", max_fires=2)
+        for _ in range(2):
+            with pytest.raises(FaultInjected):
+                maybe_fail("solver.exec")
+        maybe_fail("solver.exec")
+        assert registry.list()["armed"] == []
+
+    def test_probability_deterministic_for_seed(self):
+        def pattern(seed):
+            registry.arm("kvstore.flood", probability=0.5, seed=seed)
+            out = []
+            for _ in range(64):
+                try:
+                    maybe_fail("kvstore.flood")
+                    out.append(0)
+                except FaultInjected:
+                    out.append(1)
+            registry.clear("kvstore.flood")
+            return out
+
+        a = pattern(seed=42)
+        b = pattern(seed=42)
+        assert a == b
+        assert 0 < sum(a) < 64  # actually probabilistic, not degenerate
+
+    def test_window_expires(self):
+        registry.arm("rpc.send", window_s=0.02)
+        time.sleep(0.05)
+        maybe_fail("rpc.send")  # expired: no raise, schedule dropped
+        assert registry.list()["armed"] == []
+
+    def test_clear_and_list_shapes(self):
+        registry.arm("rpc.send", every_nth=2)
+        registry.arm("solver.exec")
+        listed = registry.list()
+        assert {s["site"] for s in listed["armed"]} == {
+            "rpc.send", "solver.exec"
+        }
+        assert "solver.exec" in listed["known_sites"]
+        assert registry.clear("rpc.send") == {"cleared": ["rpc.send"]}
+        assert registry.clear("rpc.send") == {"cleared": []}
+        assert registry.clear() == {"cleared": ["solver.exec"]}
+
+    def test_span_stamped_on_fire(self):
+        class FakeSpan:
+            attributes = {}
+
+        sp = FakeSpan()
+        registry.arm("solver.exec", one_shot=True)
+        with pytest.raises(FaultInjected):
+            maybe_fail("solver.exec", span=sp)
+        assert sp.attributes["fault_injected"] == "solver.exec"
+
+    def test_arm_validation(self):
+        with pytest.raises(ValueError):
+            registry.arm("")
+        with pytest.raises(ValueError):
+            registry.arm("rpc.send", probability=1.5)
+        with pytest.raises(ValueError):
+            registry.arm("rpc.send", every_nth=-1)
+
+    def test_configure_from_config(self):
+        registry.configure(
+            FaultInjectionConfig(
+                enable_fault_injection=True,
+                seed=7,
+                schedules=[{"site": "rpc.send", "every_nth": 2}],
+            )
+        )
+        assert registry.seed == 7
+        assert registry.list()["armed"][0]["site"] == "rpc.send"
+        # disabled config clears everything
+        registry.configure(FaultInjectionConfig(seed=0))
+        assert registry.list()["armed"] == []
+
+
+# ---------------------------------------------------------------------------
+# supervised fibers
+# ---------------------------------------------------------------------------
+
+class _FlakyActor(Actor):
+    """Supervised fiber that crashes `crashes` times, then parks forever."""
+
+    def __init__(self, crashes=2):
+        super().__init__("flaky")
+        self.restart_backoff_initial_s = 0.01
+        self.restart_backoff_max_s = 0.02
+        self.crashes = crashes
+        self.attempts = 0
+        self.recoveries = []
+        self.healthy = asyncio.Event()
+
+    async def on_start(self):
+        self.add_supervised_task(self._work, name="flaky.work")
+
+    async def on_fiber_restart(self, task_name):
+        self.recoveries.append(task_name)
+
+    async def _work(self):
+        self.attempts += 1
+        if self.attempts <= self.crashes:
+            raise RuntimeError(f"boom {self.attempts}")
+        self.healthy.set()
+        await asyncio.Event().wait()
+
+
+class TestSupervisor:
+    @run_async
+    async def test_restart_within_budget(self):
+        base = _counter("runtime.supervisor.restarts")
+        a = _FlakyActor(crashes=2)
+        await a.start()
+        try:
+            await asyncio.wait_for(a.healthy.wait(), timeout=5)
+        finally:
+            await a.stop()
+        assert a.attempts == 3
+        assert a.recoveries == ["flaky.work", "flaky.work"]
+        assert _counter("runtime.supervisor.restarts") >= base + 2
+        assert _counter("runtime.supervisor.restarts.flaky") >= 2
+
+    @run_async
+    async def test_crash_budget_exhaustion_escalates(self):
+        escalated = []
+        a = _FlakyActor(crashes=1000)
+        a.crash_budget = 2
+        a._escalate = escalated.append
+        base = _counter("runtime.supervisor.escalations")
+        await a.start()
+        try:
+            for _ in range(250):
+                if escalated:
+                    break
+                await asyncio.sleep(0.02)
+        finally:
+            await a.stop()
+        assert escalated and "crash budget" in escalated[0]
+        assert a.attempts == 3  # budget 2 -> two restarts, third crash fatal
+        assert _counter("runtime.supervisor.escalations") >= base + 1
+
+    @run_async
+    async def test_watchdog_wires_supervisor_and_fires(self):
+        fired = []
+        wd = Watchdog(
+            "node1",
+            WatchdogConfig(
+                supervisor_crash_budget=0,
+                supervisor_backoff_initial_s=0.01,
+                supervisor_backoff_max_s=0.02,
+            ),
+            crash_handler=fired.append,
+        )
+        a = _FlakyActor(crashes=1000)
+        wd.watch_actor(a)
+        assert a.crash_budget == 0
+        assert a._escalate is not None
+        await a.start()
+        try:
+            for _ in range(250):
+                if fired:
+                    break
+                await asyncio.sleep(0.02)
+        finally:
+            await a.stop()
+        assert fired and wd.fired is not None
+        assert "flaky.work" in wd.fired
+
+    @run_async
+    async def test_crashes_land_in_ring_and_counters(self):
+        base = _counter("runtime.task_crash.flaky.work")
+        a = _FlakyActor(crashes=1)
+        await a.start()
+        try:
+            await asyncio.wait_for(a.healthy.wait(), timeout=5)
+        finally:
+            await a.stop()
+        assert _counter("runtime.task_crash.flaky.work") == base + 1
+        ring = recent_crashes()
+        assert any(
+            c["task"] == "flaky.work" and "boom 1" in c["error"]
+            for c in ring
+        )
+
+    @run_async
+    async def test_shutdown_is_not_a_crash(self):
+        base = _counter("runtime.supervisor.restarts")
+        a = _FlakyActor(crashes=0)
+        await a.start()
+        await asyncio.wait_for(a.healthy.wait(), timeout=5)
+        await a.stop()  # cancellation must not burn crash budget
+        assert a._crash_count == 0
+        assert _counter("runtime.supervisor.restarts") == base
+
+
+# ---------------------------------------------------------------------------
+# solver failover (Decision._solve_full / probe / promote)
+# ---------------------------------------------------------------------------
+
+class FlakySolver:
+    """TpuSpfSolver stand-in: a primary that can be forced down, carrying
+    the CPU oracle as its `cpu` fallback (the failover contract)."""
+
+    def __init__(self, node_name):
+        self.cpu = SpfSolver(node_name)
+        self.fail = False
+        self.primary_builds = 0
+
+    def build_route_db(self, *args, **kwargs):
+        if self.fail:
+            raise RuntimeError("device lost")
+        self.primary_builds += 1
+        return self.cpu.build_route_db(*args, **kwargs)
+
+    def update_static_unicast_routes(self, update):
+        self.cpu.update_static_unicast_routes(update)
+
+    def create_route_for_prefix_or_get_static(self, *args):
+        return self.cpu.create_route_for_prefix_or_get_static(*args)
+
+
+def _two_node_state():
+    ls = LinkState("0")
+    ls.update_adjacency_database(
+        AdjacencyDatabase(
+            this_node_name="a",
+            adjacencies=(
+                Adjacency(
+                    other_node_name="b", if_name="i0", other_if_name="i1"
+                ),
+            ),
+            area="0",
+        )
+    )
+    ls.update_adjacency_database(
+        AdjacencyDatabase(
+            this_node_name="b",
+            adjacencies=(
+                Adjacency(
+                    other_node_name="a", if_name="i1", other_if_name="i0"
+                ),
+            ),
+            area="0",
+        )
+    )
+    ps = PrefixState()
+    ps.update_prefix_database(
+        PrefixDatabase(
+            this_node_name="b",
+            prefix_entries=(PrefixEntry(prefix="10.0.0.2/32"),),
+            area="0",
+        )
+    )
+    return ls, ps
+
+
+def _make_decision():
+    kq = ReplicateQueue("kv")
+    rq = ReplicateQueue("routes")
+    d = Decision(
+        "a",
+        DecisionConfig(
+            debounce_min_ms=5,
+            debounce_max_ms=25,
+            solver_probe_initial_backoff_s=0.01,
+            solver_probe_max_backoff_s=0.05,
+        ),
+        kq.get_reader("decision"),
+        None,
+        rq,
+        solver_backend="cpu",
+    )
+    d.solver = FlakySolver("a")
+    ls, ps = _two_node_state()
+    d.area_link_states = {"0": ls}
+    d.prefix_state = ps
+    d._kvstore_synced = True
+    return d
+
+
+class TestSolverFailover:
+    def setup_method(self):
+        registry.clear()
+        counters.set_counter("decision.solver.degraded", 0)
+
+    def teardown_method(self):
+        registry.clear()
+        counters.set_counter("decision.solver.degraded", 0)
+
+    @run_async
+    async def test_failover_then_promotion(self):
+        d = _make_decision()
+        failovers0 = _counter("decision.solver.failovers")
+        promotions0 = _counter("decision.solver.promotions")
+        d.solver.fail = True
+        d.pending.needs_full_rebuild = True
+        ctx = tracer.start_trace("adj_update", node="a")
+        d.pending.trace = ctx
+        try:
+            d.rebuild_routes()
+            # failed over mid-flight: routes still built, via the oracle
+            assert d._degraded
+            assert "10.0.0.2/32" in d.route_db.unicast_routes
+            assert _counter("decision.solver.degraded") == 1
+            assert _counter("decision.solver.failovers") == failovers0 + 1
+            # trace root carries the degraded stamp
+            [tr] = tracer.get_traces(
+                trace_id=ctx.trace_id, include_active=True
+            )
+            assert tr["spans"][0]["attributes"].get("degraded") is True
+            # primary still down: probe fails, stays degraded
+            await asyncio.sleep(0.05)
+            assert d._degraded
+            assert _counter("decision.solver.probe_failures") >= 1
+            # primary heals: backoff-timed canary promotes it back
+            d.solver.fail = False
+            for _ in range(200):
+                if not d._degraded:
+                    break
+                await asyncio.sleep(0.02)
+            assert not d._degraded
+            assert _counter("decision.solver.degraded") == 0
+            assert _counter("decision.solver.promotions") == promotions0 + 1
+        finally:
+            tracer.end_trace(ctx, status="test_done")
+            for t in list(d._timers):
+                t.cancel()
+
+    @run_async
+    async def test_fault_site_drives_failover(self):
+        """solver.exec armed via the registry: the same drill `breeze
+        fault inject solver.exec` runs against a live node."""
+        d = _make_decision()
+        registry.arm("solver.exec", one_shot=True)
+        d.pending.needs_full_rebuild = True
+        try:
+            d.rebuild_routes()
+            assert d._degraded
+            assert "10.0.0.2/32" in d.route_db.unicast_routes
+            assert d.solver.primary_builds == 0  # primary never completed
+            # one_shot disarmed on fire -> probe path is clean; FlakySolver
+            # has no probe_device, so the canary topology solve promotes
+            for _ in range(200):
+                if not d._degraded:
+                    break
+                await asyncio.sleep(0.02)
+            assert not d._degraded
+            assert d.solver.primary_builds >= 1  # canary ran the primary
+            assert _counter("runtime.fault.solver.exec.fired") >= 1
+        finally:
+            for t in list(d._timers):
+                t.cancel()
+
+    @run_async
+    async def test_cpu_backend_without_fallback_reraises(self):
+        d = _make_decision()
+        d.solver = SpfSolver("a")  # no .cpu attribute: no failover seam
+        registry.arm("solver.exec", one_shot=True)
+        d.pending.needs_full_rebuild = True
+        with pytest.raises(FaultInjected):
+            d.rebuild_routes()
+        assert not d._degraded

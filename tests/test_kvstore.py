@@ -381,6 +381,40 @@ class TestKvStoreSync:
             await b.stop()
 
     @run_async
+    async def test_flood_failure_resets_peer_then_recovers(self):
+        """Injected flood fault (the `kvstore.flood` chaos site): the
+        transport-failure path must reset the peer session, and the
+        backoff re-sync must carry the dropped key across anyway."""
+        from openr_tpu.runtime.faults import registry
+
+        a, b = await _start_stores(2)
+        try:
+            a.add_peer(b)
+            b.add_peer(a)
+            await wait_until(
+                lambda: a.peer_state("store1")
+                == KvStorePeerState.INITIALIZED
+            )
+            registry.arm("kvstore.flood", one_shot=True)
+            a.set_key("k-fault", b"v")
+            # the failed flood dropped the update, but full sync on the
+            # re-established session converges the key anyway
+            await wait_until(
+                lambda: b.get_key("k-fault") is not None, timeout_s=10
+            )
+            assert b.get_key("k-fault").value == b"v"
+            await wait_until(
+                lambda: a.peer_state("store1")
+                == KvStorePeerState.INITIALIZED,
+                timeout_s=10,
+            )
+            # one_shot: the schedule disarmed itself after firing
+            assert registry.list()["armed"] == []
+        finally:
+            registry.clear()
+            await _stop_stores([a, b])
+
+    @run_async
     async def test_del_peer_stops_flooding(self):
         a, b = await _start_stores(2)
         try:
