@@ -163,6 +163,13 @@ def bench_config(name, gen, me, runs=5, flap_victims=0, cpu_baseline=True,
 
     # steady-state full recompute through real churn (changelog path)
     victims = list(range(1, (flap_victims or 1) + 1))
+    from openr_tpu.runtime.counters import counters as _counters
+
+    _XLA_KEYS = ("factory_hits", "factory_misses", "executable_evictions")
+    xla0 = {
+        k: int(_counters.get_counter(f"xla_cache.{k}") or 0)
+        for k in _XLA_KEYS
+    }
     samples, phases = [], {}
     for i in range(runs):
         _flap(states, adj_dbs, victims, i, area)
@@ -211,13 +218,34 @@ def bench_config(name, gen, me, runs=5, flap_victims=0, cpu_baseline=True,
     dev_ms = tpu.device_compute_ms()
     if dev_ms is not None:
         res["device_ms"] = round(dev_ms, 1)
+        # the exec_ms <-> device_ms gap: dispatch overhead + the one
+        # result pull (rig RTT) — the quantity the async dispatch /
+        # delta-resident sync work drives down. Per-solve bytes_uploaded
+        # rides last_timing into the phase medians above.
+        res["exec_overhead_ms"] = round(res["exec_ms"] - dev_ms, 1)
     if cpu_ms:
         res["speedup"] = round(cpu_ms / tpu_ms, 2)
         if dev_ms:
             res["device_speedup"] = round(cpu_ms / dev_ms, 2)
+    # executable-cache health over the churn loop (deltas vs the loop
+    # start, so other configs/tests in the process don't pollute the
+    # reading): a steady state that misses (recompiles) or evicts here
+    # is a capacity-class leak
+    res["xla_cache"] = {
+        k: int(_counters.get_counter(f"xla_cache.{k}") or 0) - xla0[k]
+        for k in _XLA_KEYS
+    }
+    # async dispatch queue depth gauge (0 unless a Decision actor with
+    # async_dispatch ran in this process; reported so daemon-embedded
+    # bench runs surface backlog)
+    res["dispatch_queue_depth"] = int(
+        _counters.get_counter("decision.dispatch.depth") or 0
+    )
     log(f"[{name}] tpu recompute: {[f'{s:.0f}' for s in samples]} ms "
         f"(sync {res['sync_ms']} / exec {res['exec_ms']} / mat {res['mat_ms']} "
-        f"/ device-only {res.get('device_ms')})")
+        f"/ device-only {res.get('device_ms')} "
+        f"/ uploaded {res.get('bytes_uploaded')} B "
+        f"/ xla {res['xla_cache']})")
     return res, tpu_ms, cpu_ms
 
 

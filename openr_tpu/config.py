@@ -137,6 +137,19 @@ class DecisionConfig:
     enable_solver_failover: bool = True
     solver_probe_initial_backoff_s: float = 1.0
     solver_probe_max_backoff_s: float = 30.0
+    # async device dispatch (decision/decision.py): route rebuilds run
+    # on a dedicated supervised dispatch fiber instead of inline in the
+    # Decision event loop — the actor stays responsive to LSDB events
+    # while the device round trip is in flight, and bursts of topology
+    # events coalesce into one solve. Default off; flip off to take the
+    # dispatch fiber out of the picture when bisecting a regression
+    # (docs/Operations.md).
+    async_dispatch: bool = False
+    # async only: after the first queued solve request, wait this long
+    # and fold any further requests that arrive into the same solve
+    # (0 = no extra wait; superseded requests still coalesce whenever
+    # the fiber is busy solving).
+    dispatch_coalesce_ms: int = 0
 
 
 @dataclass
@@ -515,6 +528,8 @@ class Config:
             raise ConfigError(
                 "decision solver probe backoff must satisfy 0 < initial <= max"
             )
+        if dc.dispatch_coalesce_ms < 0:
+            raise ConfigError("decision dispatch_coalesce_ms must be >= 0")
         wc = cfg.watchdog_config
         if wc.supervisor_crash_budget < 0:
             raise ConfigError("supervisor_crash_budget must be >= 0")

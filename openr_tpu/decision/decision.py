@@ -23,6 +23,7 @@ Role of the reference's openr/decision/Decision.{h,cpp} (:130):
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import time
 from dataclasses import dataclass, field
@@ -188,6 +189,12 @@ class Decision(Actor):
         # load) until a canary probe proves the primary healthy again
         self._degraded = False
         self._probe_backoff: Optional[ExponentialBackoff] = None
+        # async device dispatch: when cfg.async_dispatch, rebuild_routes
+        # only snapshots pending state onto this queue; a dedicated
+        # supervised fiber (_dispatch_loop) coalesces and solves, so the
+        # actor loop keeps ingesting LSDB events during the device round
+        # trip. None = classic inline rebuilds.
+        self._solve_q: Optional[asyncio.Queue] = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -200,6 +207,11 @@ class Decision(Actor):
         self.add_supervised_task(
             self._kvstore_loop, name=f"{self.name}.kvstore"
         )
+        if self.cfg.async_dispatch:
+            self._solve_q = asyncio.Queue()
+            self.add_supervised_task(
+                self._dispatch_loop, name=f"{self.name}.dispatch"
+            )
         if self._static_routes is not None:
             self.add_supervised_task(
                 self._static_loop, name=f"{self.name}.static"
@@ -207,10 +219,12 @@ class Decision(Actor):
         self._load_saved_rib_policy()
 
     async def on_fiber_restart(self, task_name: str) -> None:
-        """A crashed ingest fiber may have died mid-apply: the LSDB
-        itself is intact (mutations are synchronous), but a batched
-        pending update may have been lost — force a full rebuild so the
-        next debounce re-derives routes from scratch."""
+        """A crashed ingest fiber may have died mid-apply, and a crashed
+        dispatch fiber dies holding a coalesced pending snapshot: the
+        LSDB itself is intact in both cases (mutations are synchronous
+        on the loop), but batched/queued pending updates may have been
+        lost — force a full rebuild so the next debounce re-derives
+        routes from scratch."""
         self.pending.needs_full_rebuild = True
         self._trigger_rebuild()
 
@@ -377,6 +391,57 @@ class Decision(Actor):
             return
         pending = self.pending
         self.pending = PendingUpdates()
+        if self._solve_q is not None:
+            # async dispatch: hand the snapshot to the dispatch fiber
+            # and return immediately — the actor loop stays free to
+            # ingest LSDB events while the solve is in flight
+            self._solve_q.put_nowait(pending)
+            counters.set_counter(
+                "decision.dispatch.depth", self._solve_q.qsize()
+            )
+            return
+        self._rebuild(pending)
+
+    async def _dispatch_loop(self) -> None:
+        """The async dispatch fiber: pending snapshots queue here while
+        the actor loop keeps ingesting. Snapshots that arrive during a
+        solve (or within the coalesce window) merge into ONE solve —
+        superseded requests are never solved separately."""
+        while True:
+            pending = await self._solve_q.get()
+            if self.cfg.dispatch_coalesce_ms > 0:
+                await asyncio.sleep(self.cfg.dispatch_coalesce_ms / 1e3)
+            while not self._solve_q.empty():
+                pending = self._merge_pending(
+                    pending, self._solve_q.get_nowait()
+                )
+                counters.increment("decision.dispatch.coalesced")
+            counters.set_counter(
+                "decision.dispatch.depth", self._solve_q.qsize()
+            )
+            # chaos seam: crash the dispatch fiber between coalesce and
+            # solve — the supervisor drill (restart + full-rebuild
+            # recovery, on_fiber_restart) needs a deterministic place
+            # to die
+            maybe_fail("solver.dispatch")
+            counters.increment("decision.dispatch.solves")
+            await self._rebuild_async(pending)
+
+    @staticmethod
+    def _merge_pending(a: PendingUpdates, b: PendingUpdates) -> PendingUpdates:
+        a.needs_full_rebuild = a.needs_full_rebuild or b.needs_full_rebuild
+        a.updated_prefixes |= b.updated_prefixes
+        a.count += b.count
+        if a.perf_events is None:
+            a.perf_events = b.perf_events
+        if b.trace is not None:
+            if a.trace is None:
+                a.trace = b.trace
+            else:
+                tracer.end_trace(b.trace, status="coalesced")
+        return a
+
+    def _begin_rebuild(self, pending: PendingUpdates):
         ctx = pending.trace
         # while degraded every rebuild is a full one on the CPU oracle:
         # the incremental path would still route through the primary
@@ -386,33 +451,56 @@ class Decision(Actor):
             or self._degraded
         )
         t0 = time.perf_counter()
-
         spf_sp = tracer.start_span(
             ctx, "decision.spf", node=self.node_name, full=full
         )
+        return ctx, spf_sp, full, t0
+
+    def _incremental_db(self, pending: PendingUpdates) -> DecisionRouteDb:
+        # incremental: recompute only changed prefixes
+        new_db = DecisionRouteDb(
+            unicast_routes=dict(self.route_db.unicast_routes),
+            mpls_routes=dict(self.route_db.mpls_routes),
+        )
+        for prefix in pending.updated_prefixes:
+            route = self.solver.create_route_for_prefix_or_get_static(
+                self.node_name,
+                self.area_link_states,
+                self.prefix_state,
+                prefix,
+            )
+            if route is None:
+                new_db.unicast_routes.pop(prefix, None)
+            else:
+                new_db.unicast_routes[prefix] = route
+        return new_db
+
+    def _rebuild(self, pending: PendingUpdates) -> None:
+        ctx, spf_sp, full, t0 = self._begin_rebuild(pending)
         if full:
             new_db = self._solve_full(ctx, spf_sp)
-            if new_db is None:
-                tracer.end_span(spf_sp)
-                tracer.end_trace(ctx, status="not_in_lsdb")
-                return  # we are not yet in the LSDB
         else:
-            # incremental: recompute only changed prefixes
-            new_db = DecisionRouteDb(
-                unicast_routes=dict(self.route_db.unicast_routes),
-                mpls_routes=dict(self.route_db.mpls_routes),
-            )
-            for prefix in pending.updated_prefixes:
-                route = self.solver.create_route_for_prefix_or_get_static(
-                    self.node_name,
-                    self.area_link_states,
-                    self.prefix_state,
-                    prefix,
-                )
-                if route is None:
-                    new_db.unicast_routes.pop(prefix, None)
-                else:
-                    new_db.unicast_routes[prefix] = route
+            new_db = self._incremental_db(pending)
+        self._finish_rebuild(pending, ctx, spf_sp, t0, new_db)
+
+    async def _rebuild_async(self, pending: PendingUpdates) -> None:
+        """Dispatch-fiber rebuild: identical to _rebuild except the full
+        solve's one blocking host sync runs off-loop (_solve_full_async),
+        so LSDB ingestion continues during the device round trip."""
+        ctx, spf_sp, full, t0 = self._begin_rebuild(pending)
+        if full:
+            new_db = await self._solve_full_async(ctx, spf_sp)
+        else:
+            new_db = self._incremental_db(pending)
+        self._finish_rebuild(pending, ctx, spf_sp, t0, new_db)
+
+    def _finish_rebuild(
+        self, pending: PendingUpdates, ctx, spf_sp, t0, new_db
+    ) -> None:
+        if new_db is None:
+            tracer.end_span(spf_sp)
+            tracer.end_trace(ctx, status="not_in_lsdb")
+            return  # we are not yet in the LSDB
         tracer.end_span(spf_sp)
         counters.add_stat_value(
             "decision.spf_ms", (time.perf_counter() - t0) * 1e3
@@ -477,6 +565,45 @@ class Decision(Actor):
         if spf_sp is not None:
             spf_sp.attributes["degraded"] = True
         tracer.annotate(ctx, degraded=True)
+        return fallback.build_route_db(
+            self.node_name, self.area_link_states, self.prefix_state
+        )
+
+    async def _solve_full_async(self, ctx, spf_sp):
+        """Async-dispatch variant of _solve_full. Phase 1
+        (dispatch_route_db: every LSDB read + device dispatch) runs on
+        the loop — LinkState/PrefixState are single-writer, owned by the
+        loop. Phase 2 (collect_route_db: the at-most-ONE blocking host
+        sync) touches only device buffers and the pending snapshot, so
+        it moves to an executor and the loop keeps ingesting. Solvers
+        without the dispatch/collect split (the CPU oracle) solve inline
+        as before. Same mid-flight failover as the sync path."""
+        fallback = getattr(self.solver, "cpu", None)
+        dispatch = getattr(self.solver, "dispatch_route_db", None)
+        if not self._degraded:
+            try:
+                maybe_fail("solver.exec", span=spf_sp)
+                if dispatch is None:
+                    return self.solver.build_route_db(
+                        self.node_name, self.area_link_states,
+                        self.prefix_state,
+                    )
+                build = dispatch(
+                    self.node_name, self.area_link_states, self.prefix_state
+                )
+                loop = asyncio.get_running_loop()
+                return await loop.run_in_executor(
+                    None, self.solver.collect_route_db, build
+                )
+            except Exception as e:
+                if not self.cfg.enable_solver_failover or fallback is None:
+                    raise
+                self._enter_degraded(e)
+        if spf_sp is not None:
+            spf_sp.attributes["degraded"] = True
+        tracer.annotate(ctx, degraded=True)
+        # the oracle reads LSDB state, so the degraded path stays on the
+        # loop (blocking it — acceptable while degraded)
         return fallback.build_route_db(
             self.node_name, self.area_link_states, self.prefix_state
         )

@@ -59,7 +59,7 @@ routes are host-built (they are O(adjacent links), not hot).
 
 from __future__ import annotations
 
-import functools
+from collections import OrderedDict
 from typing import Optional
 
 import numpy as np
@@ -85,6 +85,7 @@ from openr_tpu.ops.edgeplan import (
     drain_dirty,
     sync_plan,
 )
+from openr_tpu.ops.xla_cache import bounded_jit_cache
 from openr_tpu.types import (
     PrefixForwardingAlgorithm,
     PrefixForwardingType,
@@ -223,7 +224,7 @@ def _select_kernel(dist, nh, node_over, ann_node, ann_valid, path_pref, source_p
     return metric, s3, nh_mask, has_route
 
 
-@functools.lru_cache(maxsize=None)
+@bounded_jit_cache()
 def _jitted_pipeline():
     import jax
 
@@ -244,7 +245,7 @@ def _jitted_pipeline():
     return jax.jit(pipeline)
 
 
-@functools.lru_cache(maxsize=None)
+@bounded_jit_cache()
 def _jitted_sssp_batch():
     import jax
 
@@ -342,13 +343,14 @@ def _plan_sssp(deltas, shift_w, res_rows, res_nbr, res_w, root,
     return dist, trips
 
 
-@functools.lru_cache(maxsize=None)
-def _plan_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
+def _make_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
                    has_res: bool,
                    d_cap: int, p_cap: int, a_cap: int, budget: int,
                    lfa: bool = False, block_v4: bool = False,
                    sentinels: bool = True):
-    """The fused production pipeline. Outputs:
+    """The fused production pipeline (raw closure — _plan_pipeline jits
+    it for the single-area path, _fused_pipeline vmaps it over a group
+    of same-shape areas). Outputs:
       delta_buf int32 [2 + B + B + B*wa + B*wd (+ 2B with lfa)]: count,
                 trips, idx, metric, s3 words, nh words (and lfa slot +
                 metric) for up to B changed rows
@@ -519,10 +521,77 @@ def _plan_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
         full_buf = jnp.concatenate(full_parts)
         return delta_buf, full_buf, metric, s3w, nhw, lfa_slot, lfa_metric
 
-    return jax.jit(pipeline)
+    return pipeline
 
 
-@functools.lru_cache(maxsize=None)
+@bounded_jit_cache()
+def _plan_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
+                   has_res: bool,
+                   d_cap: int, p_cap: int, a_cap: int, budget: int,
+                   lfa: bool = False, block_v4: bool = False,
+                   sentinels: bool = True):
+    import jax
+
+    return jax.jit(_make_pipeline(
+        n_cap, s_cap, r_cap, kr_cap, has_res, d_cap, p_cap, a_cap,
+        budget, lfa, block_v4, sentinels,
+    ))
+
+
+@bounded_jit_cache()
+def _fused_pipeline(g: int, n_cap: int, s_cap: int, r_cap: int,
+                    kr_cap: int, has_res: bool,
+                    d_cap: int, p_cap: int, a_cap: int, budget: int,
+                    lfa: bool, block_v4: bool, sentinels: bool):
+    """`g` same-shape areas in ONE device dispatch: each of the 14
+    pipeline inputs arrives as a g-tuple of per-area arrays (a pytree —
+    still one dispatch), stacks inside the jit, and vmaps through the
+    raw pipeline. Per-call dispatch overhead is paid once for the whole
+    group instead of per area; the while_loop trip count becomes the
+    max across the group (extra trips past a lane's fixpoint are
+    no-ops). Outputs unstack back to per-area tuples so the existing
+    per-area materialization consumes them unchanged."""
+    import jax
+    import jax.numpy as jnp
+
+    raw = _make_pipeline(
+        n_cap, s_cap, r_cap, kr_cap, has_res, d_cap, p_cap, a_cap,
+        budget, lfa, block_v4, sentinels,
+    )
+
+    def fused(*area_args):
+        stacked = [jnp.stack(xs) for xs in area_args]
+        outs = jax.vmap(raw)(*stacked)
+        return tuple(tuple(o[i] for o in outs) for i in range(g))
+
+    return jax.jit(fused)
+
+
+@bounded_jit_cache()
+def _instrumented_fused(
+    g: int, n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
+    has_res: bool, d_cap: int, p_cap: int, a_cap: int, budget: int,
+    lfa: bool, block_v4: bool, sentinels: bool,
+) -> tuple:
+    """(kernel name, instrumented callable) for a fused group shape —
+    the fused analogue of _instrumented_pipeline."""
+    from openr_tpu.ops.xla_cache import instrument_jit
+
+    name = (
+        f"pipeline_fused[g={g},n={n_cap},s={s_cap},d={d_cap},"
+        f"p={p_cap},a={a_cap}"
+        + (",res" if has_res else "")
+        + (",lfa" if lfa else "")
+        + "]"
+    )
+    jitted = _fused_pipeline(
+        g, n_cap, s_cap, r_cap, kr_cap, has_res, d_cap, p_cap, a_cap,
+        budget, lfa, block_v4, sentinels,
+    )
+    return name, instrument_jit(name, jitted)
+
+
+@bounded_jit_cache()
 def _instrumented_pipeline(
     n_cap: int, s_cap: int, r_cap: int, kr_cap: int, has_res: bool,
     d_cap: int, p_cap: int, a_cap: int, budget: int,
@@ -549,14 +618,19 @@ def _instrumented_pipeline(
     return name, instrument_jit(name, jitted)
 
 
-@functools.lru_cache(maxsize=None)
-def _scatter_jit():
+@bounded_jit_cache()
+def _scatter_jit(donate: bool = False):
     import jax
 
     def scatter(arr, idx, vals):
         shape = arr.shape
         return arr.ravel().at[idx].set(vals).reshape(shape)
 
+    if donate:
+        # the resident array's buffer is reused in place — a delta sync
+        # never doubles the plan mirror's HBM footprint. Gated off on
+        # CPU, where XLA cannot honor the donation and jax warns.
+        return jax.jit(scatter, donate_argnums=(0,))
     return jax.jit(scatter)
 
 
@@ -571,14 +645,22 @@ def _pack_matrix(matrix: PrefixMatrix, node_over: np.ndarray) -> tuple:
     )
     if flags.shape[1]:
         flags[:, 0] |= matrix.is_v4.astype(np.int32) << 2
-    mbuf = np.concatenate([
-        matrix.ann_node.ravel(),
-        flags.ravel(),
-        matrix.path_pref.ravel(),
-        matrix.source_pref.ravel(),
-        matrix.dist_adv.ravel(),
-        matrix.min_nexthop.ravel(),
-    ]).astype(np.int32, copy=False)
+    mbuf = matrix._mbuf
+    if mbuf is None:
+        mbuf = matrix._mbuf = np.concatenate([
+            matrix.ann_node.ravel(),
+            flags.ravel(),
+            matrix.path_pref.ravel(),
+            matrix.source_pref.ravel(),
+            matrix.dist_adv.ravel(),
+            matrix.min_nexthop.ravel(),
+        ]).astype(np.int32, copy=False)
+    else:
+        # only the flags plane depends on node_over; every other plane
+        # is a pure function of this matrix instance — patch in place
+        # (device_put copies, so the resident buffer is unaffected)
+        pa = flags.size
+        mbuf[pa:2 * pa] = flags.ravel()
     return flags, mbuf
 
 
@@ -588,7 +670,7 @@ class _AreaDev:
     __slots__ = (
         "plan", "d_deltas", "d_shift_w", "d_res_rows", "d_res_nbr",
         "d_res_w", "matrix_key", "matrix", "flags", "d_mbuf",
-        "matrix_version",
+        "matrix_version", "pack_over",
     )
 
     def __init__(self):
@@ -599,6 +681,10 @@ class _AreaDev:
         self.matrix: Optional[PrefixMatrix] = None
         self.flags: Optional[np.ndarray] = None
         self.d_mbuf = None
+        # node_overloaded snapshot at the last _pack_matrix: packing is
+        # a pure function of (matrix, overload set), so an unchanged
+        # snapshot skips the O(6*P*A) host concat entirely
+        self.pack_over: Optional[np.ndarray] = None
         # bumped whenever the matrix is rebuilt: row -> prefix mapping may
         # change even at identical shapes, so every vantage's delta state
         # (prev outputs + route cache) must reset against the new rows
@@ -621,6 +707,33 @@ class _VantageState:
         self.crib: Optional[ColumnarRib] = None
         self.links_tuple: tuple = ()
         self.valid = False
+
+
+# areas at or below this node capacity are candidates for the fused
+# (vmapped) multi-area dispatch; larger areas keep their own dispatch so
+# one giant area never serializes behind a stack of small ones
+_FUSE_MAX_NCAP = 4096
+
+
+class _PendingBuild:
+    """An in-flight solve between dispatch_route_db (all LSDB reads +
+    device dispatches, no blocking sync) and collect_route_db (the one
+    blocking host sync at materialize). Snapshot-only: consuming it
+    never touches LinkState/PrefixState."""
+
+    __slots__ = (
+        "route_db", "futures", "t_pipe0", "ksp2_timing",
+        "bytes_uploaded", "delegated",
+    )
+
+    def __init__(self, route_db, futures=None, t_pipe0=0.0,
+                 delegated: bool = False):
+        self.route_db = route_db
+        self.futures = futures or []
+        self.t_pipe0 = t_pipe0
+        self.ksp2_timing: dict = {}
+        self.bytes_uploaded = 0
+        self.delegated = delegated
 
 
 _UCMP_ALGOS = (
@@ -822,7 +935,8 @@ class TpuSpfSolver:
     def __init__(
         self, my_node_name: str, small_graph_nodes: int = 0,
         xla_cache_dir: str | None = None,
-        enable_numerical_sentinels: bool = True, **solver_kwargs
+        enable_numerical_sentinels: bool = True,
+        fuse_small_areas: bool = True, **solver_kwargs
     ):
         # a restarting daemon must not pay the ~80s 100k-node compile
         # again — load executables from the persistent cache
@@ -841,6 +955,8 @@ class TpuSpfSolver:
         # the fixed device dispatch + result-pull round trip exceeds the
         # whole CPU solve there (the "auto" backend sets this)
         self.small_graph_nodes = small_graph_nodes
+        # batch same-shape small areas into one vmapped dispatch
+        self.fuse_small_areas = fuse_small_areas
         self.cpu = SpfSolver(my_node_name, **solver_kwargs)
         # UCMP weight resolution runs on device through the oracle's
         # resolver hook (falls back to the host walk when stale)
@@ -848,8 +964,14 @@ class TpuSpfSolver:
         self.cpu.ucmp_resolver = self._ucmp_accel
         self._area_dev: dict[str, _AreaDev] = {}
         self._vstates: dict[tuple, _VantageState] = {}
-        self._vantage_lru: list[tuple] = []
+        self._vantage_lru: OrderedDict[tuple, None] = OrderedDict()
         self._partition = None  # (ps.generation, fast, slow)
+        # host->device transfer accounting for the current solve; read
+        # into last_timing by collect_route_db (bench bytes_uploaded)
+        self._bytes_uploaded = 0
+        # buffer donation for delta scatters (resolved lazily from the
+        # backend: CPU cannot honor donation and warns)
+        self._donate: Optional[bool] = None
         self.last_device_stats: dict = {}
         # wall-time breakdown of the last fast-path solve (bench.py)
         self.last_timing: dict = {}
@@ -866,7 +988,7 @@ class TpuSpfSolver:
         # LRU over the per-vantage KSP2 state above: each entry pins
         # ~2x b_cap x n_cap int32 (device rows + host mirror), so the
         # multi-vantage fabric path must evict, not accumulate
-        self._ksp2_lru: list[tuple] = []
+        self._ksp2_lru: OrderedDict[tuple, None] = OrderedDict()
         # unrolled while_loop trips of the last device SSSP — a measured
         # diameter bound the sharded fabric path reuses
         self.last_trips: int = 0
@@ -949,23 +1071,23 @@ class TpuSpfSolver:
     _MAX_KSP2_STATES = 4
 
     def _touch_ksp2_state(self, bkey: tuple) -> None:
+        # O(1) recency bump (an OrderedDict move_to_end, not a list
+        # scan — the fabric path touches every vantage per pass)
         lru = self._ksp2_lru
-        if bkey in lru:
-            lru.remove(bkey)
-        lru.append(bkey)
+        lru[bkey] = None
+        lru.move_to_end(bkey)
         while len(lru) > self._MAX_KSP2_STATES:
-            old = lru.pop(0)
+            old, _ = lru.popitem(last=False)
             self._ksp2_rows.pop(old, None)
             self._ksp2_base.pop(old, None)
             self._ksp2_certs.pop(old, None)
 
     def _touch_foreign_vantage(self, vkey: tuple) -> None:
         lru = self._vantage_lru
-        if vkey in lru:
-            lru.remove(vkey)
-        lru.append(vkey)
+        lru[vkey] = None
+        lru.move_to_end(vkey)
         while len(lru) > self._MAX_FOREIGN_VANTAGES:
-            old = lru.pop(0)
+            old, _ = lru.popitem(last=False)
             self._vstates.pop(old, None)
 
     # -- build -------------------------------------------------------------
@@ -976,6 +1098,26 @@ class TpuSpfSolver:
         area_link_states: dict[str, LinkState],
         prefix_state: PrefixState,
     ) -> Optional[DecisionRouteDb]:
+        pending = self.dispatch_route_db(
+            my_node_name, area_link_states, prefix_state
+        )
+        if pending is None:
+            return None
+        return self.collect_route_db(pending)
+
+    def dispatch_route_db(
+        self,
+        my_node_name: str,
+        area_link_states: dict[str, LinkState],
+        prefix_state: PrefixState,
+    ) -> Optional[_PendingBuild]:
+        """Phase 1 of a solve: every LSDB read, device sync, pipeline
+        dispatch and async result copy — NO blocking host sync. Returns
+        a _PendingBuild for collect_route_db, or None when this vantage
+        is in no area's graph. Must run on the thread that owns the
+        LinkState/PrefixState (the actor loop); collect_route_db touches
+        only device buffers and the pending snapshot, so the async
+        dispatch fiber may run it in an executor."""
         if not any(
             ls.has_node(my_node_name) for ls in area_link_states.values()
         ):
@@ -986,13 +1128,15 @@ class TpuSpfSolver:
         # sentinel aggregation restarts per solve; the UCMP hook below
         # and the per-area pipelines both add into it
         self.last_sentinels = {}
+        self._bytes_uploaded = 0
         if all(
             ls.node_count() < self.small_graph_nodes
             for ls in area_link_states.values()
         ):
-            return self.cpu.build_route_db(
+            db = self.cpu.build_route_db(
                 my_node_name, area_link_states, prefix_state
             )
+            return _PendingBuild(db, delegated=True)
 
         fast_by_area, slow, ksp2, ksp2_by_area = self._partition_prefixes(
             prefix_state, area_link_states
@@ -1015,6 +1159,7 @@ class TpuSpfSolver:
         # All dispatches START before any result is consumed: the device
         # round trips overlap each other AND the host slow path.
         small: list[str] = []
+        preps: list[dict] = []
         for area, plist in fast_by_area.items():
             link_state = area_link_states[area]
             if not link_state.has_node(my_node_name):
@@ -1024,13 +1169,34 @@ class TpuSpfSolver:
                 # the oracle than one device round trip
                 small.extend(plist)
                 continue
-            prepare = self._solve_fast(
+            preps.append(self._prep_vantage(
                 my_node_name, area, link_state, prefix_state, plist
-            )
-            # the worker pulls + scatters area k's result while the main
-            # thread dispatches area k+1 and runs the host slow path —
-            # sync/exec/mat pipeline across areas instead of serializing
-            futures.append((area, self._pool().submit(prepare)))
+            ))
+        # areas whose capacity buckets (and pipeline flags) match batch
+        # into ONE vmapped dispatch — per-call overhead paid once for
+        # the group, not per area
+        singles: list[dict] = []
+        groups: dict[tuple, list] = {}
+        if self.fuse_small_areas:
+            for pv in preps:
+                if pv["plan"].n_cap <= _FUSE_MAX_NCAP:
+                    groups.setdefault(pv["fuse_key"], []).append(pv)
+                else:
+                    singles.append(pv)
+        else:
+            singles = preps
+        for group in groups.values():
+            if len(group) < 2:
+                singles.extend(group)
+                continue
+            # the worker pulls + scatters one area's result while the
+            # main thread dispatches the rest and runs the host slow
+            # path — sync/exec/mat pipeline instead of serializing
+            for pv, prepare in self._dispatch_fused(group):
+                futures.append((pv["area"], self._pool().submit(prepare)))
+        for pv in singles:
+            prepare = self._dispatch_one(pv)
+            futures.append((pv["area"], self._pool().submit(prepare)))
         # batch the per-destination second-pass SSSPs on device and prime
         # the k-paths cache; the oracle loop below then assembles KSP2
         # routes through its unchanged code path. Like the fast path,
@@ -1055,50 +1221,69 @@ class TpuSpfSolver:
             my_node_name, area_link_states, prefix_state,
             slow + ksp2 + small, route_db,
         )
-        if futures:
-            views = []
-            stages = {"sync_ms": 0.0, "exec_ms": 0.0, "mat_ms": 0.0}
-            area_timing: dict[str, dict] = {}
-            for area, fut in futures:
-                res = fut.result()
-                views.append(res["view"])
-                stats = res["stats"]
-                self.last_trips = stats["trips"]
-                self.last_device_stats = stats
-                for k, v in res["timing"].items():
-                    stages[k] = stages.get(k, 0.0) + v
-                area_timing[area] = dict(res["timing"])
-                # the shape-class kernel this area executed, for the
-                # ctrl.tpu.kernels estimated-vs-achieved join
-                if stats.get("kernel"):
-                    area_timing[area]["kernel"] = stats["kernel"]
-                for sk, sv in (stats.get("sentinels") or {}).items():
-                    self.last_sentinels[sk] = (
-                        self.last_sentinels.get(sk, 0) + sv
-                    )
-                # per-area solve/materialize latency percentiles
-                # (the per-event stage timing ISSUE 2 reports against)
-                counters.add_stat_value(
-                    f"decision.area.{area}.spf_ms",
-                    res["timing"]["sync_ms"] + res["timing"]["exec_ms"],
+        pending = _PendingBuild(route_db, futures, t_pipe0)
+        pending.ksp2_timing = self._ksp2_timing
+        self._ksp2_timing = {}
+        pending.bytes_uploaded = self._bytes_uploaded
+        return pending
+
+    def collect_route_db(
+        self, pending: Optional[_PendingBuild]
+    ) -> Optional[DecisionRouteDb]:
+        """Phase 2 of a solve: the at-most-ONE blocking host sync —
+        drain the per-area materialization futures and assemble the
+        timing breakdown. Reads no LSDB state, so it may run off the
+        actor loop."""
+        if pending is None:
+            return None
+        route_db = pending.route_db
+        if pending.delegated or not pending.futures:
+            return route_db
+        import time as _time
+
+        views = []
+        stages = {"sync_ms": 0.0, "exec_ms": 0.0, "mat_ms": 0.0}
+        area_timing: dict[str, dict] = {}
+        for area, fut in pending.futures:
+            res = fut.result()
+            views.append(res["view"])
+            stats = res["stats"]
+            self.last_trips = stats["trips"]
+            self.last_device_stats = stats
+            for k, v in res["timing"].items():
+                stages[k] = stages.get(k, 0.0) + v
+            area_timing[area] = dict(res["timing"])
+            # the shape-class kernel this area executed, for the
+            # ctrl.tpu.kernels estimated-vs-achieved join
+            if stats.get("kernel"):
+                area_timing[area]["kernel"] = stats["kernel"]
+            for sk, sv in (stats.get("sentinels") or {}).items():
+                self.last_sentinels[sk] = (
+                    self.last_sentinels.get(sk, 0) + sv
                 )
-                counters.add_stat_value(
-                    f"decision.area.{area}.mat_ms", res["timing"]["mat_ms"]
-                )
-            # device routes shadow host/static entries for the same
-            # prefix — same override order as the seed's dict.update
-            route_db.unicast_routes = LazyUnicastRoutes(
-                route_db.unicast_routes, views
+            # per-area solve/materialize latency percentiles
+            # (the per-event stage timing ISSUE 2 reports against)
+            counters.add_stat_value(
+                f"decision.area.{area}.spf_ms",
+                res["timing"]["sync_ms"] + res["timing"]["exec_ms"],
             )
-            wall = (_time.perf_counter() - t_pipe0) * 1e3
-            self.last_timing = {
-                **stages,
-                "pipeline_wall_ms": wall,
-                "pipeline_stages_ms": sum(stages.values()),
-                "areas": area_timing,
-                **self._ksp2_timing,
-            }
-            self._ksp2_timing = {}
+            counters.add_stat_value(
+                f"decision.area.{area}.mat_ms", res["timing"]["mat_ms"]
+            )
+        # device routes shadow host/static entries for the same
+        # prefix — same override order as the seed's dict.update
+        route_db.unicast_routes = LazyUnicastRoutes(
+            route_db.unicast_routes, views
+        )
+        wall = (_time.perf_counter() - pending.t_pipe0) * 1e3
+        self.last_timing = {
+            **stages,
+            "pipeline_wall_ms": wall,
+            "pipeline_stages_ms": sum(stages.values()),
+            "areas": area_timing,
+            "bytes_uploaded": float(pending.bytes_uploaded),
+            **pending.ksp2_timing,
+        }
         return route_db
 
     def _prime_ucmp(
@@ -1353,10 +1538,56 @@ class TpuSpfSolver:
 
     # -- device state sync -------------------------------------------------
 
-    def _sync_area(self, area: str, link_state: LinkState,
-                   prefix_state: PrefixState, prefixes: list) -> _AreaDev:
+    def _donation_on(self) -> bool:
+        """Donate resident buffers into delta scatters (in-place HBM
+        update). CPU cannot honor donation and warns, so gate there."""
+        if self._donate is None:
+            import jax
+
+            self._donate = jax.default_backend() != "cpu"
+        return self._donate
+
+    def _put_counted(self, arr):
         import jax
 
+        self._bytes_uploaded += arr.nbytes
+        return jax.device_put(arr)
+
+    def _scatter_counted(self, d_arr, idx, vals):
+        """Scatter (idx, vals) into the resident array; uploads only the
+        delta-sized index/value buffers."""
+        self._bytes_uploaded += idx.nbytes + vals.nbytes
+        donate = self._donation_on()
+        if donate:
+            # the donated input may be referenced by the last-exec probe
+            # tuple; that handle dies with the donation
+            self._last_exec = None
+        return _scatter_jit(donate)(d_arr, idx, vals)
+
+    def _diff_scatter(self, d_arr, old_np, new_np, extra_idx=None):
+        """Reconcile a resident device array to `new_np` by scattering
+        only the positions where it differs. The device holds `old_np`'s
+        content except at `extra_idx` (undrained dirty slots whose
+        device values are unknown) — those are force-included so the
+        result is exact regardless. Falls back to a full re-put when
+        the diff is no longer delta-sized."""
+        diff = np.flatnonzero(old_np.ravel() != new_np.ravel())
+        if extra_idx:
+            diff = np.union1d(
+                diff, np.asarray(extra_idx, np.int64)
+            )
+        if diff.size == 0:
+            return d_arr
+        if diff.size * 4 > new_np.size:
+            # >25% changed: per-element scatter traffic approaches the
+            # full array — one contiguous re-put is cheaper
+            return self._put_counted(new_np)
+        idx = diff.astype(np.int32)
+        vals = np.ascontiguousarray(new_np.ravel()[diff])
+        return self._scatter_counted(d_arr, idx, vals)
+
+    def _sync_area(self, area: str, link_state: LinkState,
+                   prefix_state: PrefixState, prefixes: list) -> _AreaDev:
         ad = self._area_dev.get(area)
         if ad is None:
             ad = self._area_dev[area] = _AreaDev()
@@ -1365,11 +1596,57 @@ class TpuSpfSolver:
         rebuilt = plan is not old_plan
         ad.plan = plan
         if rebuilt or ad.d_deltas is None:
-            ad.d_deltas = jax.device_put(plan.deltas)
-            ad.d_shift_w = jax.device_put(plan.shift_w)
-            ad.d_res_rows = jax.device_put(plan.res_rows)
-            ad.d_res_nbr = jax.device_put(plan.res_nbr)
-            ad.d_res_w = jax.device_put(plan.res_w)
+            # same-capacity rebuild (index renumbering, class reshuffle
+            # without a pow2 bucket change): the resident arrays stay on
+            # device and only changed slices ship. The device holds the
+            # OLD plan's content except at its undrained dirty slots —
+            # _diff_scatter folds those in, so the reconcile is exact.
+            same_caps = (
+                old_plan is not None
+                and ad.d_deltas is not None
+                and old_plan.deltas.shape == plan.deltas.shape
+                and old_plan.shift_w.shape == plan.shift_w.shape
+                and old_plan.res_rows.shape == plan.res_rows.shape
+                and old_plan.res_nbr.shape == plan.res_nbr.shape
+                and old_plan.res_w.shape == plan.res_w.shape
+            )
+            if same_caps:
+                n_cap_o = old_plan.n_cap
+                kr_o = old_plan.res_nbr.shape[1]
+                sd = [
+                    k * n_cap_o + u for k, u, _ in old_plan.dirty_shift
+                ]
+                rd = [
+                    r * kr_o + c for r, c, _ in old_plan.dirty_res
+                ]
+                ad.d_deltas = self._diff_scatter(
+                    ad.d_deltas, old_plan.deltas, plan.deltas
+                )
+                ad.d_shift_w = self._diff_scatter(
+                    ad.d_shift_w, old_plan.shift_w, plan.shift_w, sd
+                )
+                if old_plan.dirty_res_nbr:
+                    # residual slot layout changed without tracked
+                    # indices — the residual mirror re-ships whole
+                    ad.d_res_rows = self._put_counted(plan.res_rows)
+                    ad.d_res_nbr = self._put_counted(plan.res_nbr)
+                    ad.d_res_w = self._put_counted(plan.res_w)
+                else:
+                    ad.d_res_rows = self._diff_scatter(
+                        ad.d_res_rows, old_plan.res_rows, plan.res_rows
+                    )
+                    ad.d_res_nbr = self._diff_scatter(
+                        ad.d_res_nbr, old_plan.res_nbr, plan.res_nbr
+                    )
+                    ad.d_res_w = self._diff_scatter(
+                        ad.d_res_w, old_plan.res_w, plan.res_w, rd
+                    )
+            else:
+                ad.d_deltas = self._put_counted(plan.deltas)
+                ad.d_shift_w = self._put_counted(plan.shift_w)
+                ad.d_res_rows = self._put_counted(plan.res_rows)
+                ad.d_res_nbr = self._put_counted(plan.res_nbr)
+                ad.d_res_w = self._put_counted(plan.res_w)
             plan.dirty_shift = []
             plan.dirty_res = []
             plan.dirty_res_nbr = False
@@ -1380,14 +1657,17 @@ class TpuSpfSolver:
             prewarm_edge_loc(plan)
         else:
             (s_idx, s_val), (r_idx, r_val), nbr_changed = drain_dirty(plan)
-            scatter = _scatter_jit()
             if s_idx is not None:
-                ad.d_shift_w = scatter(ad.d_shift_w, s_idx, s_val)
+                ad.d_shift_w = self._scatter_counted(
+                    ad.d_shift_w, s_idx, s_val
+                )
             if r_idx is not None:
-                ad.d_res_w = scatter(ad.d_res_w, r_idx, r_val)
+                ad.d_res_w = self._scatter_counted(
+                    ad.d_res_w, r_idx, r_val
+                )
             if nbr_changed:
-                ad.d_res_rows = jax.device_put(plan.res_rows)
-                ad.d_res_nbr = jax.device_put(plan.res_nbr)
+                ad.d_res_rows = self._put_counted(plan.res_rows)
+                ad.d_res_nbr = self._put_counted(plan.res_nbr)
 
         # announcer matrix: keyed on prefix churn + node-index stability
         mkey = (prefix_state.generation, plan.index_version)
@@ -1419,10 +1699,18 @@ class TpuSpfSolver:
             ad.matrix_key = mkey
             ad.matrix_version += 1
             ad.flags = None  # force re-pack
-        flags, mbuf = _pack_matrix(ad.matrix, plan.node_overloaded)
-        if ad.flags is None or not np.array_equal(flags, ad.flags):
-            ad.flags = flags
-            ad.d_mbuf = jax.device_put(mbuf)
+        # packing is a pure function of (matrix, overload set): with an
+        # unchanged matrix and an unchanged overload snapshot the packed
+        # mirror on device is already current — skip the O(6*P*A) host
+        # concat that used to run on every sync
+        if ad.flags is None or not np.array_equal(
+            plan.node_overloaded, ad.pack_over
+        ):
+            flags, mbuf = _pack_matrix(ad.matrix, plan.node_overloaded)
+            ad.pack_over = plan.node_overloaded.copy()
+            if ad.flags is None or not np.array_equal(flags, ad.flags):
+                ad.flags = flags
+                ad.d_mbuf = self._put_counted(mbuf)
         return ad
 
     # -- the fast path ------------------------------------------------------
@@ -1435,16 +1723,26 @@ class TpuSpfSolver:
         prefix_state: PrefixState,
         prefixes: list[str],
     ):
-        """Dispatch the device pipeline and start the async result copy;
-        returns a prepare() closure that consumes the buffer and patches
-        the vantage's columnar RIB. The caller submits prepare to the
-        materialization worker and runs independent host work (the CPU
-        slow path, further area dispatches) while it blocks on the pull.
-        Thread-safety: one worker thread, and the caller does not touch
-        this vantage's state until it collects the future."""
-        import time as _time
+        """Single-area prep + dispatch (the unfused path, kept for
+        callers outside dispatch_route_db's grouping loop); returns the
+        prepare() closure."""
+        return self._dispatch_one(self._prep_vantage(
+            my_node_name, area, link_state, prefix_state, prefixes
+        ))
 
-        import jax
+    def _prep_vantage(
+        self,
+        my_node_name: str,
+        area: str,
+        link_state: LinkState,
+        prefix_state: PrefixState,
+        prefixes: list[str],
+    ) -> dict:
+        """Host half of a fast-path solve (the tpu.sync span): device
+        mirror sync, out-link extraction, vantage-state (re)init. Reads
+        LSDB state, so it must run on the owning thread. Returns the
+        dispatch context consumed by _dispatch_one/_dispatch_fused."""
+        import time as _time
 
         t0 = _time.perf_counter()
         ad = self._sync_area(area, link_state, prefix_state, prefixes)
@@ -1484,11 +1782,11 @@ class TpuSpfSolver:
             wa = -(-a_cap // 16)
             wd = -(-d_cap // 16)
             vs.prev = (
-                jax.device_put(np.zeros(p_cap, np.int32)),
-                jax.device_put(np.zeros((p_cap, wa), np.int32)),
-                jax.device_put(np.zeros((p_cap, wd), np.int32)),
-                jax.device_put(np.zeros(p_cap, np.int32)),
-                jax.device_put(np.zeros(p_cap, np.int32)),
+                self._put_counted(np.zeros(p_cap, np.int32)),
+                self._put_counted(np.zeros((p_cap, wa), np.int32)),
+                self._put_counted(np.zeros((p_cap, wd), np.int32)),
+                self._put_counted(np.zeros(p_cap, np.int32)),
+                self._put_counted(np.zeros(p_cap, np.int32)),
             )
             vs.shape_key = cache_key
             vs.matrix_version = ad.matrix_version
@@ -1500,27 +1798,83 @@ class TpuSpfSolver:
             vs.valid = False
 
         t1 = _time.perf_counter()
-        sentinels = self.enable_sentinels
-        kernel_name, run = _instrumented_pipeline(
-            *shape_key, _DELTA_BUDGET, lfa, block_v4, sentinels
-        )
-        delta_buf, full_buf, *new_prev = run(
+        return {
+            "area": area, "ad": ad, "plan": plan, "matrix": matrix,
+            "root_idx": root_idx, "root_nbr": root_nbr, "root_w": root_w,
+            "shape_key": shape_key,
+            "fuse_key": (shape_key, lfa, block_v4),
+            "vs": vs, "lfa": lfa, "block_v4": block_v4,
+            "d_cap": d_cap, "p_cap": p_cap, "a_cap": a_cap,
+            "t0": t0, "t1": t1,
+        }
+
+    @staticmethod
+    def _lane_args(pv: dict) -> tuple:
+        ad, vs = pv["ad"], pv["vs"]
+        return (
             ad.d_deltas, ad.d_shift_w, ad.d_res_rows, ad.d_res_nbr,
             ad.d_res_w, ad.d_mbuf,
-            np.int32(root_idx), root_nbr, root_w, *vs.prev,
+            np.int32(pv["root_idx"]), pv["root_nbr"], pv["root_w"],
+            *vs.prev,
         )
+
+    def _dispatch_one(self, pv: dict):
+        """Dispatch one area's pipeline and start the async result copy;
+        returns the prepare() closure for the materialization worker."""
+        kernel_name, run = _instrumented_pipeline(
+            *pv["shape_key"], _DELTA_BUDGET, pv["lfa"], pv["block_v4"],
+            self.enable_sentinels,
+        )
+        args = self._lane_args(pv)
+        delta_buf, full_buf, *new_prev = run(*args)
         # resident pipeline state for device-only throughput probes
         # (bench.py device_compute_ms): re-invokable with outputs fed
         # forward as the next prev
-        self._last_exec = (
-            run,
-            (
-                ad.d_deltas, ad.d_shift_w, ad.d_res_rows, ad.d_res_nbr,
-                ad.d_res_w, ad.d_mbuf,
-                np.int32(root_idx), root_nbr, root_w,
-            ),
-            tuple(new_prev),
+        self._last_exec = (run, args[:9], tuple(new_prev))
+        return self._make_prepare(
+            pv, kernel_name, delta_buf, full_buf, new_prev
         )
+
+    def _dispatch_fused(self, group: list[dict]) -> list[tuple]:
+        """ONE vmapped dispatch for a group of same-shape areas; returns
+        (pv, prepare) pairs. Per-area inputs travel as g-tuples (a
+        pytree — still a single dispatch), so the per-call overhead the
+        single path pays per area is paid once for the group."""
+        g = len(group)
+        pv0 = group[0]
+        kernel_name, run = _instrumented_fused(
+            g, *pv0["shape_key"], _DELTA_BUDGET, pv0["lfa"],
+            pv0["block_v4"], self.enable_sentinels,
+        )
+        lanes = [self._lane_args(pv) for pv in group]
+        area_args = tuple(
+            tuple(lane[i] for lane in lanes) for i in range(14)
+        )
+        outs = run(*area_args)
+        counters.increment("decision.device.fused_dispatches")
+        counters.increment("decision.device.fused_areas", g)
+        result = []
+        for pv, out in zip(group, outs):
+            delta_buf, full_buf, *new_prev = out
+            result.append((pv, self._make_prepare(
+                pv, kernel_name, delta_buf, full_buf, new_prev, fused=g
+            )))
+        return result
+
+    def _make_prepare(self, pv: dict, kernel_name: str, delta_buf,
+                      full_buf, new_prev, fused: int = 0):
+        """Start the async device->host copy of the buffer the solve
+        will consume and build the prepare() closure that patches the
+        vantage's columnar RIB on the materialization worker.
+        Thread-safety: one worker thread, and the caller does not touch
+        this vantage's state until it collects the future."""
+        import time as _time
+
+        plan, matrix, vs = pv["plan"], pv["matrix"], pv["vs"]
+        lfa = pv["lfa"]
+        sentinels = self.enable_sentinels
+        d_cap, p_cap, a_cap = pv["d_cap"], pv["p_cap"], pv["a_cap"]
+        t0, t1 = pv["t0"], pv["t1"]
         was_valid = vs.valid
         # start the device->host copy of the buffer we will consume; it
         # flies while the caller does unrelated host work
@@ -1553,6 +1907,7 @@ class TpuSpfSolver:
                 "changed_rows": count,
                 "full_pull": full_pull,
                 "kernel": kernel_name,
+                "fused": fused,
             }
             if full_pull:
                 fbuf = np.asarray(full_buf)

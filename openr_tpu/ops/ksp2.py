@@ -36,11 +36,10 @@ both directions.
 
 from __future__ import annotations
 
-import functools
-
 import numpy as np
 
 from openr_tpu.ops.edgeplan import INF32E
+from openr_tpu.ops.xla_cache import bounded_jit_cache
 
 INF_E = int(INF32E)
 _UNROLL = 8
@@ -125,7 +124,7 @@ def _make_one_sssp(jnp, jax, n_cap, s_cap, r_cap, kr_cap, has_res,
     return one
 
 
-@functools.lru_cache(maxsize=None)
+@bounded_jit_cache()
 def _base_sssp_fn(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
                   has_res: bool):
     import jax
@@ -141,7 +140,7 @@ def _base_sssp_fn(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
     return jax.jit(f)
 
 
-@functools.lru_cache(maxsize=None)
+@bounded_jit_cache()
 def _masked_rows_fn(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
                     has_res: bool, b_cap: int, ms_cap: int, mr_cap: int):
     """Full masked rows [B, N] — the cold/init path (one big pull)."""
@@ -160,7 +159,7 @@ def _masked_rows_fn(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
     return jax.jit(batch)
 
 
-@functools.lru_cache(maxsize=None)
+@bounded_jit_cache()
 def _masked_rows_delta_fn(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
                           has_res: bool, b_cap: int, ms_cap: int,
                           mr_cap: int, k_cap: int):

@@ -40,16 +40,15 @@ back to the host walk, whose Python ints are unbounded.
 
 from __future__ import annotations
 
-import functools
-
 import numpy as np
 
 from openr_tpu.ops.edgeplan import INF32E, MAX_METRIC, natural_key
+from openr_tpu.ops.xla_cache import bounded_jit_cache
 
 INF_E = int(INF32E)
 
 
-@functools.lru_cache(maxsize=None)
+@bounded_jit_cache()
 def _ucmp_fn(e_cap: int, n_cap: int, use_prefix_weight: bool):
     import jax
     import jax.numpy as jnp

@@ -18,10 +18,12 @@ the setting at first compile).
 
 from __future__ import annotations
 
+import functools
 import logging
 import os
 import threading
 import time
+from collections import OrderedDict
 
 log = logging.getLogger(__name__)
 
@@ -102,6 +104,73 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
     _hook_cache_monitoring()
     _applied = d
     return d
+
+
+# -- bounded executable caches ----------------------------------------------
+#
+# The jit factories across the solver are keyed on capacity-class shapes.
+# An unbounded lru_cache never drops an executable, so a long-lived
+# daemon whose graph grew through several pow2 capacity buckets keeps
+# every superseded bucket's compiled program (and its device constants)
+# alive forever — exactly the slow-leak signature the HBM runbook
+# chases. bounded_jit_cache evicts by CAPACITY BUCKET, not by raw key:
+# flag variants of the same shape class (lfa / block_v4 / sentinels)
+# live and die together, because a live bucket legitimately needs all
+# of its variants while a dead (outgrown) bucket needs none.
+
+
+def bounded_jit_cache(max_buckets: int = 8):
+    """lru_cache replacement for shape-keyed jit factories, bounded to
+    `max_buckets` distinct capacity signatures per factory. A key's
+    capacity signature is its tuple of int (non-bool) components; bool
+    flags select a variant WITHIN a bucket. On overflow the least-
+    recently-used bucket is dropped whole, releasing every variant's
+    executable, and `xla_cache.executable_evictions` counts the drops.
+
+    Hashable positional keys only — same contract the lru_cache sites
+    already honor. Exposes `cache_clear()` for tests."""
+
+    def decorate(fn):
+        lock = threading.Lock()
+        buckets: OrderedDict[tuple, dict] = OrderedDict()
+
+        @functools.wraps(fn)
+        def wrapper(*key):
+            from openr_tpu.runtime.counters import counters
+
+            sig = tuple(
+                k for k in key
+                if isinstance(k, int) and not isinstance(k, bool)
+            )
+            with lock:
+                group = buckets.get(sig)
+                if group is not None and key in group:
+                    buckets.move_to_end(sig)
+                    counters.increment("xla_cache.factory_hits")
+                    return group[key]
+            # compile outside the lock: factory bodies trace/compile and
+            # may take seconds — a racing duplicate compile is benign
+            counters.increment("xla_cache.factory_misses")
+            value = fn(*key)
+            with lock:
+                group = buckets.setdefault(sig, {})
+                group.setdefault(key, value)
+                buckets.move_to_end(sig)
+                while len(buckets) > max_buckets:
+                    _, dropped = buckets.popitem(last=False)
+                    counters.increment(
+                        "xla_cache.executable_evictions", len(dropped)
+                    )
+                return group[key]
+
+        def cache_clear():
+            with lock:
+                buckets.clear()
+
+        wrapper.cache_clear = cache_clear
+        return wrapper
+
+    return decorate
 
 
 # -- kernel cost ledger -----------------------------------------------------

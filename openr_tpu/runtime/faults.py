@@ -39,6 +39,7 @@ KNOWN_SITES = (
     "kvstore.flood",  # KvStore._flood_to_peer, before the peer RPC
     "fib.program",  # Fib sync/incremental programming, before the service call
     "solver.exec",  # Decision primary SPF execution + TPU device dispatch
+    "solver.dispatch",  # Decision._dispatch_loop, before the async solve
     "queue.push",  # ReplicateQueue.push fan-out
     "decision.ingest",  # Decision._kvstore_loop, after the queue read
 )

@@ -43,6 +43,18 @@ def test_bench_config_smoke_device_path():
     for k in ("sync_ms", "exec_ms", "mat_ms"):
         assert {"p50", "p99"} <= set(sp[k]), (k, sp)
         assert sp[k]["p99"] >= sp[k]["p50"], (k, sp)
+    # ISSUE 5: the exec_ms <-> device_ms gap and the per-solve upload
+    # volume are first-class bench outputs
+    if "device_ms" in res:
+        assert "exec_overhead_ms" in res, res
+    assert "bytes_uploaded" in res, res
+    assert "dispatch_queue_depth" in res, res
+    # the churn loop must run entirely on warm executables: every
+    # flapped rebuild re-enters the same capacity class, so the factory
+    # caches report hits and (at this scale) zero bucket evictions
+    xc = res["xla_cache"]
+    assert xc["factory_hits"] > 0, xc
+    assert xc["executable_evictions"] == 0, xc
 
 
 def test_bench_config_small_graph_delegation_still_reports():

@@ -234,6 +234,74 @@ class TestDecisionFiberCrashDrill:
             await stop_all(nodes)
 
 
+class TestDispatchFiberKillDrill:
+    @run_async
+    async def test_supervisor_restarts_crashed_dispatch_fiber(self):
+        """Async-dispatch mesh (ISSUE 5): kill the dedicated dispatch
+        fiber mid-solve via the solver.dispatch seam. The supervisor
+        must restart it, on_fiber_restart must force a full rebuild (the
+        crashed fiber died holding a coalesced pending snapshot), and
+        fresh topology state must keep converging end to end."""
+        registry.clear()
+        names = ["node-0", "node-1"]
+        links = [("node-0", "if-01", "node-1", "if-10")]
+        mesh, nodes = await start_mesh(
+            names,
+            links,
+            decision_config=DecisionConfig(
+                debounce_min_ms=5,
+                debounce_max_ms=25,
+                async_dispatch=True,
+            ),
+        )
+        try:
+            for i, n in enumerate(names):
+                nodes[n].advertise_prefix(loopback(i))
+            await wait_until(
+                lambda: loopback(1) in nodes["node-0"].fib_routes
+                and loopback(0) in nodes["node-1"].fib_routes,
+                timeout_s=CONVERGENCE_S,
+            )
+            assert _counter("decision.dispatch.solves") >= 1
+            restarts0 = _counter("runtime.supervisor.restarts")
+
+            # the next two solves popped by a dispatch fiber (either
+            # node — the registry is process-global) kill it
+            registry.arm("solver.dispatch", every_nth=1, max_fires=2)
+            nodes["node-1"].advertise_prefix("10.88.0.0/24")
+
+            await wait_until(
+                lambda: _counter("runtime.supervisor.restarts")
+                >= restarts0 + 2
+                and not registry.list()["armed"],
+                timeout_s=CONVERGENCE_S,
+            )
+            from openr_tpu.runtime.tasks import recent_crashes
+
+            assert any(
+                c["task"].startswith("decision:")
+                and c["task"].endswith(".dispatch")
+                and "injected fault" in c["error"]
+                for c in recent_crashes()
+            )
+
+            # the restarted fiber's forced full rebuild recovers the
+            # snapshot lost in the crash...
+            await wait_until(
+                lambda: "10.88.0.0/24" in nodes["node-0"].fib_routes,
+                timeout_s=CONVERGENCE_S,
+            )
+            # ...and keeps solving fresh state
+            nodes["node-0"].advertise_prefix("10.89.0.0/24")
+            await wait_until(
+                lambda: "10.89.0.0/24" in nodes["node-1"].fib_routes,
+                timeout_s=CONVERGENCE_S,
+            )
+        finally:
+            registry.clear()
+            await stop_all(nodes)
+
+
 class TestSparkGracefulRestartDrill:
     @run_async
     async def test_routes_held_through_gr_window_then_flushed(self):
