@@ -249,6 +249,47 @@ def bench_config(name, gen, me, runs=5, flap_victims=0, cpu_baseline=True,
     return res, tpu_ms, cpu_ms
 
 
+def bench_whatif(name, gen, me) -> dict:
+    """N-1 what-if sweep smoke (decision/whatif.py): one batched device
+    dispatch sweeping every up link of the fabric. Tier-1/CPU-friendly —
+    runs on whatever device jax picked, so the quick lane starts
+    tracking sweep throughput (scenarios/s) and peak HBM during a sweep
+    alongside the solve trajectory."""
+    from openr_tpu.decision.tpu_solver import TpuSpfSolver
+    from openr_tpu.decision.whatif import WhatIfEngine
+    from openr_tpu.models import topologies
+    from openr_tpu.runtime.counters import counters as _counters
+    from openr_tpu.runtime.device_stats import peak_hbm_mb
+
+    adj_dbs, prefix_dbs = gen()
+    states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    tpu = TpuSpfSolver(me)
+    tpu.build_route_db(me, states, ps)  # resident mirror + warm jit
+    eng = WhatIfEngine(tpu)
+    eng.sweep(states, ps, order=1)  # warm the sweep executable
+    d0 = int(_counters.get_counter("whatif.device.batched_dispatches") or 0)
+    t0 = time.perf_counter()
+    out = eng.sweep(states, ps, order=1)
+    sweep_ms = (time.perf_counter() - t0) * 1e3
+    res = {
+        "scenarios": out["scenarios"],
+        "sweep_ms": round(sweep_ms, 1),
+        "scenarios_per_s": round(out["scenarios"] / (sweep_ms / 1e3), 1),
+        "dispatches": int(
+            _counters.get_counter("whatif.device.batched_dispatches") or 0
+        ) - d0,
+        "partitioned": out["partitioned"],
+    }
+    peak_mb, backend = peak_hbm_mb()
+    res["backend"] = backend
+    if peak_mb is not None:
+        res["peak_hbm_mb"] = round(peak_mb, 1)
+    log(f"[{name}] whatif N-1 sweep: {out['scenarios']} scenarios in "
+        f"{sweep_ms:.0f} ms ({res['scenarios_per_s']}/s, "
+        f"{res['dispatches']} dispatch) peak_hbm {res.get('peak_hbm_mb')}")
+    return res
+
+
 def main() -> None:
     quick = "--quick" in sys.argv
     only = None
@@ -298,6 +339,14 @@ def main() -> None:
     run("tg1k", lambda: topologies.grid(32, node_labels=False), "node-16-16",
         small_graph_nodes=2816)
 
+    # N-1 what-if sweep throughput on the 1k-node mesh: ~2k hypothetical
+    # topologies against the resident graph in one batched dispatch
+    if only in (None, "whatif1k"):
+        configs["whatif1k"] = bench_whatif(
+            "whatif1k", lambda: topologies.grid(32, node_labels=False),
+            "node-16-16",
+        )
+
     if quick:
         if not configs:
             sys.exit(f"--only={only} matched no config")
@@ -305,7 +354,7 @@ def main() -> None:
         out = configs[name]
         print(json.dumps({
             "metric": f"full_rib_recompute_{name}_ms",
-            "value": out["tpu_ms"],
+            "value": out.get("tpu_ms", out.get("sweep_ms")),
             "unit": "ms",
             "vs_baseline": out.get("speedup", 1.0),
             "rig_rtt_ms": round(rtt_ms, 1),

@@ -478,6 +478,71 @@ def rib_policy(ctx, clear, set_json) -> None:
         _print(_call(ctx, "ctrl.decision.get_rib_policy"))
 
 
+# -- decision whatif --------------------------------------------------------
+
+@decision.group("whatif")
+def whatif() -> None:
+    """Hypothetical-topology sweeps on the resident device graph."""
+
+
+@whatif.command("sweep")
+@click.option("--order", default=1, help="failure order: 1 (N-1) or 2 (N-2)")
+@click.option("--area", default="", help="restrict to one area")
+@click.option(
+    "--roots", default=None,
+    help="comma-separated vantage nodes (default: this node)",
+)
+@click.option(
+    "--max-scenarios", default=0,
+    help="cap the scenario count (0 = all; N-2 is quadratic)",
+)
+@click.option("--top", default=0, help="only print the worst N scenarios")
+@click.pass_context
+def whatif_sweep(ctx, order, area, roots, max_scenarios, top) -> None:
+    """Batched N-k link-failure sweep: which failures partition or
+    stretch the fabric, judged against the live baseline in one
+    vmapped device dispatch."""
+    _print(_call(ctx, "ctrl.decision.whatif.sweep", {
+        "order": order,
+        "area": area,
+        "roots": roots.split(",") if roots else None,
+        "max_scenarios": max_scenarios,
+        "top": top,
+    }))
+
+
+@whatif.command("drain")
+@click.option("--node", default="", help="preview draining this node")
+@click.option("--link", default="", help="preview draining link 'n1|n2'")
+@click.option("--area", default="", help="restrict to one area")
+@click.option("--top", default=10, help="most-affected destinations to list")
+@click.pass_context
+def whatif_drain(ctx, node, link, area, top) -> None:
+    """Impact preview before an operator drains a node or link."""
+    _print(_call(ctx, "ctrl.decision.whatif.drain", {
+        "node": node, "link": link, "area": area, "top": top,
+    }))
+
+
+@whatif.command("optimize")
+@click.option(
+    "--demand", "demand_json", required=True,
+    help='demand matrix JSON: [{"src": ..., "dst": ..., "volume": ...}]',
+)
+@click.option("--area", default="", help="restrict to one area")
+@click.option("--iters", default=40, help="gradient-descent iterations")
+@click.option("--lr", default=2.0, help="gradient-descent step size")
+@click.option("--tau", default=1.0, help="softmin temperature")
+@click.pass_context
+def whatif_optimize(ctx, demand_json, area, iters, lr, tau) -> None:
+    """Differentiable link-weight TE: propose a metric vector lowering
+    the predicted max link utilization for a demand matrix."""
+    _print(_call(ctx, "ctrl.decision.whatif.optimize", {
+        "demands": json.loads(demand_json),
+        "area": area, "iters": iters, "lr": lr, "tau": tau,
+    }))
+
+
 # -- fib --------------------------------------------------------------------
 
 @cli.group()

@@ -150,6 +150,11 @@ class DecisionConfig:
     # (0 = no extra wait; superseded requests still coalesce whenever
     # the fiber is busy solving).
     dispatch_coalesce_ms: int = 0
+    # areas at or below this node capacity batch into the fused vmapped
+    # dispatch (decision/tpu_solver.py); the what-if sweep batcher
+    # (decision/whatif.py) sizes its scenario chunks off the same value.
+    # Larger = fewer dispatches but bigger resident planes per launch.
+    fuse_n_cap: int = 4096
 
 
 @dataclass
@@ -530,6 +535,8 @@ class Config:
             )
         if dc.dispatch_coalesce_ms < 0:
             raise ConfigError("decision dispatch_coalesce_ms must be >= 0")
+        if dc.fuse_n_cap < 1:
+            raise ConfigError("decision fuse_n_cap must be >= 1")
         wc = cfg.watchdog_config
         if wc.supervisor_crash_budget < 0:
             raise ConfigError("supervisor_crash_budget must be >= 0")

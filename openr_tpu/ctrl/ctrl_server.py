@@ -143,6 +143,11 @@ class CtrlServer(Actor):
             s.register(
                 "ctrl.decision.convergence", self._decision_convergence
             )
+            s.register("ctrl.decision.whatif.sweep", self._whatif_sweep)
+            s.register("ctrl.decision.whatif.drain", self._whatif_drain)
+            s.register(
+                "ctrl.decision.whatif.optimize", self._whatif_optimize
+            )
         if self.fib is not None:
             s.register("ctrl.fib.routes", self._fib_routes)
             s.register("ctrl.fib.mpls_routes", self._fib_mpls)
@@ -665,6 +670,39 @@ class CtrlServer(Actor):
         k edge-disjoint paths between two nodes from the live LSDB."""
         return await self.decision.get_paths(
             src or self.node_name, dst, area=area, k=int(k)
+        )
+
+    async def _whatif_sweep(
+        self, order: int = 1, area: str = "",
+        roots: Optional[list] = None, max_scenarios: int = 0,
+        top: int = 0,
+    ) -> dict:
+        """Batched N-k failure sweep on the resident graph
+        (decision/whatif.py): per-scenario partition/stretch verdicts."""
+        return await self.decision.whatif_sweep(
+            order=int(order), area=area or None, roots=roots,
+            max_scenarios=int(max_scenarios), top=int(top),
+        )
+
+    async def _whatif_drain(
+        self, node: str = "", link: str = "", area: str = "",
+        roots: Optional[list] = None, top: int = 10,
+    ) -> dict:
+        """Drain impact preview for a node or link ('n1|n2')."""
+        return await self.decision.whatif_drain(
+            node=node, link=link, area=area or None, roots=roots,
+            top=int(top),
+        )
+
+    async def _whatif_optimize(
+        self, demands: Optional[list] = None, area: str = "",
+        iters: int = 40, lr: float = 2.0, tau: float = 1.0,
+    ) -> dict:
+        """Differentiable link-weight TE against a demand matrix
+        ([{src, dst, volume}])."""
+        return await self.decision.whatif_optimize(
+            demands or [], area=area or None, iters=int(iters),
+            lr=float(lr), tau=float(tau),
         )
 
     async def _decision_validate(self) -> dict:

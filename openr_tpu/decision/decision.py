@@ -108,6 +108,7 @@ def make_solver(
     if backend == "cpu":
         kwargs.pop("xla_cache_dir", None)
         kwargs.pop("enable_numerical_sentinels", None)
+        kwargs.pop("fuse_n_cap", None)
         return SpfSolver(node_name, **kwargs)
     if backend in ("tpu", "auto"):
         try:
@@ -123,6 +124,7 @@ def make_solver(
             kwargs.pop("xla_cache_dir", None)
             kwargs.pop("small_graph_nodes", None)
             kwargs.pop("enable_numerical_sentinels", None)
+            kwargs.pop("fuse_n_cap", None)
             return SpfSolver(node_name, **kwargs)
     raise ValueError(f"unknown solver backend {backend!r}")
 
@@ -169,6 +171,7 @@ class Decision(Actor):
                 "enable_numerical_sentinels",
                 config.enable_numerical_sentinels,
             )
+            skw.setdefault("fuse_n_cap", config.fuse_n_cap)
         self.solver = make_solver(
             node_name,
             backend,
@@ -195,6 +198,9 @@ class Decision(Actor):
         # actor loop keeps ingesting LSDB events during the device round
         # trip. None = classic inline rebuilds.
         self._solve_q: Optional[asyncio.Queue] = None
+        # what-if engine (decision/whatif.py): lazy, device backend only;
+        # read-only planning workload riding the solver's resident mirrors
+        self._whatif_engine = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -900,6 +906,115 @@ class Decision(Actor):
             for (node, area), entry in entries.items():
                 out.setdefault(node, {}).setdefault(area, {})[prefix] = entry
         return out
+
+    # -- what-if engine (decision/whatif.py) -------------------------------
+    #
+    # Planning/TE workload over the solver's resident device mirrors.
+    # Strictly LOWER priority than live convergence: every batched
+    # dispatch first yields until the async solve queue is drained
+    # (whatif.deferrals counts the waits), and every failure — including
+    # an armed solver.whatif fault — is returned as an {"error": ...}
+    # payload + whatif.errors, never routed into _enter_degraded.
+
+    def _whatif(self):
+        if self._whatif_engine is None:
+            if not hasattr(self.solver, "_sync_area"):
+                return None  # CPU backend: no resident mirror to sweep
+            from openr_tpu.decision.whatif import WhatIfEngine
+
+            self._whatif_engine = WhatIfEngine(self.solver, self.node_name)
+        return self._whatif_engine
+
+    async def _whatif_gate(self) -> None:
+        """Yield until no live solve is queued — a sweep chunk never
+        races a topology event for the device."""
+        while self._solve_q is not None and not self._solve_q.empty():
+            counters.increment("whatif.deferrals")
+            await asyncio.sleep(0.005)
+
+    async def whatif_sweep(
+        self, order: int = 1, area: Optional[str] = None,
+        roots: Optional[list[str]] = None, max_scenarios: int = 0,
+        top: int = 0,
+    ) -> dict:
+        """Batched N-`order` link-failure sweep from this node's vantage
+        (or explicit roots): per-scenario unreachable-pair counts, max
+        metric stretch, and partition verdicts."""
+        eng = self._whatif()
+        if eng is None:
+            return {"error": "whatif requires the device solver backend"}
+        try:
+            job = eng.plan_sweep(
+                self.area_link_states, self.prefix_state, order=order,
+                area=area, roots=roots, max_scenarios=max_scenarios,
+            )
+        except Exception as e:
+            counters.increment("whatif.errors")
+            return {"error": f"{type(e).__name__}: {e}"}
+        loop = asyncio.get_running_loop()
+        try:
+            rows: list[dict] = []
+            for chunk in job.chunks:
+                await self._whatif_gate()
+                chunk.dispatch()
+                rows.extend(
+                    await loop.run_in_executor(None, chunk.collect)
+                )
+            out = job.result(rows)
+            if top:
+                out["rows"] = out["rows"][:top]
+            return out
+        except Exception as e:
+            job.fail()
+            counters.increment("whatif.errors")
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    async def whatif_drain(
+        self, node: str = "", link: str = "", area: Optional[str] = None,
+        roots: Optional[list[str]] = None, top: int = 10,
+    ) -> dict:
+        """Impact preview for draining a node or a link ('n1|n2')."""
+        eng = self._whatif()
+        if eng is None:
+            return {"error": "whatif requires the device solver backend"}
+        await self._whatif_gate()
+        try:
+            return eng.drain(
+                self.area_link_states, self.prefix_state,
+                node=node or None, link=link or None, area=area,
+                roots=roots, top=top,
+            )
+        except Exception as e:
+            counters.increment("whatif.errors")
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    async def whatif_optimize(
+        self, demands: list[dict], area: Optional[str] = None,
+        iters: int = 40, lr: float = 2.0, tau: float = 1.0,
+    ) -> dict:
+        """Gradient-descent link-weight optimization against a demand
+        matrix ([{src, dst, volume}]); returns the proposed metric vector
+        and its predicted max-link-utilization delta."""
+        eng = self._whatif()
+        if eng is None:
+            return {"error": "whatif requires the device solver backend"}
+        await self._whatif_gate()
+        try:
+            job = eng.plan_optimize(
+                self.area_link_states, self.prefix_state, demands,
+                area=area, iters=iters, lr=lr, tau=tau,
+            )
+        except Exception as e:
+            counters.increment("whatif.errors")
+            return {"error": f"{type(e).__name__}: {e}"}
+        loop = asyncio.get_running_loop()
+        try:
+            # the GD loop touches only device/host arrays — run it off
+            # the actor loop so route processing stays live throughout
+            return await loop.run_in_executor(None, job.run)
+        except Exception as e:
+            counters.increment("whatif.errors")
+            return {"error": f"{type(e).__name__}: {e}"}
 
     _RIB_POLICY_KEY = "rib-policy"
 

@@ -936,7 +936,8 @@ class TpuSpfSolver:
         self, my_node_name: str, small_graph_nodes: int = 0,
         xla_cache_dir: str | None = None,
         enable_numerical_sentinels: bool = True,
-        fuse_small_areas: bool = True, **solver_kwargs
+        fuse_small_areas: bool = True,
+        fuse_n_cap: int = _FUSE_MAX_NCAP, **solver_kwargs
     ):
         # a restarting daemon must not pay the ~80s 100k-node compile
         # again — load executables from the persistent cache
@@ -955,8 +956,12 @@ class TpuSpfSolver:
         # the fixed device dispatch + result-pull round trip exceeds the
         # whole CPU solve there (the "auto" backend sets this)
         self.small_graph_nodes = small_graph_nodes
-        # batch same-shape small areas into one vmapped dispatch
+        # batch same-shape small areas into one vmapped dispatch; areas
+        # above fuse_n_cap keep their own dispatch (decision_config
+        # fuse_n_cap knob — the what-if sweep batcher sizes its scenario
+        # chunks off the same value)
         self.fuse_small_areas = fuse_small_areas
+        self.fuse_n_cap = int(fuse_n_cap)
         self.cpu = SpfSolver(my_node_name, **solver_kwargs)
         # UCMP weight resolution runs on device through the oracle's
         # resolver hook (falls back to the host walk when stale)
@@ -1179,7 +1184,7 @@ class TpuSpfSolver:
         groups: dict[tuple, list] = {}
         if self.fuse_small_areas:
             for pv in preps:
-                if pv["plan"].n_cap <= _FUSE_MAX_NCAP:
+                if pv["plan"].n_cap <= self.fuse_n_cap:
                     groups.setdefault(pv["fuse_key"], []).append(pv)
                 else:
                     singles.append(pv)

@@ -119,7 +119,7 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
 # of its variants while a dead (outgrown) bucket needs none.
 
 
-def bounded_jit_cache(max_buckets: int = 8):
+def bounded_jit_cache(max_buckets: int = 8, namespace: str = ""):
     """lru_cache replacement for shape-keyed jit factories, bounded to
     `max_buckets` distinct capacity signatures per factory. A key's
     capacity signature is its tuple of int (non-bool) components; bool
@@ -127,8 +127,21 @@ def bounded_jit_cache(max_buckets: int = 8):
     recently-used bucket is dropped whole, releasing every variant's
     executable, and `xla_cache.executable_evictions` counts the drops.
 
+    `namespace` partitions workload classes: a namespaced factory keeps
+    its own bucket table AND its own bucket budget, and reports through
+    `xla_cache.<namespace>_factory_hits/_factory_misses/
+    _executable_evictions`. The what-if sweep factories (ops/sweep.py)
+    use namespace="whatif" so a burst of interactive sweep shapes
+    churns only its own LRU and can never evict a live-solve
+    executable — and the counter split shows which workload is
+    compiling. The namespace is also folded into the bucket signature,
+    so two namespaces can never alias a capacity bucket even if they
+    were ever pointed at a shared table.
+
     Hashable positional keys only — same contract the lru_cache sites
     already honor. Exposes `cache_clear()` for tests."""
+
+    prefix = f"xla_cache.{namespace}_" if namespace else "xla_cache."
 
     def decorate(fn):
         lock = threading.Lock()
@@ -138,7 +151,7 @@ def bounded_jit_cache(max_buckets: int = 8):
         def wrapper(*key):
             from openr_tpu.runtime.counters import counters
 
-            sig = tuple(
+            sig = (namespace,) + tuple(
                 k for k in key
                 if isinstance(k, int) and not isinstance(k, bool)
             )
@@ -146,11 +159,11 @@ def bounded_jit_cache(max_buckets: int = 8):
                 group = buckets.get(sig)
                 if group is not None and key in group:
                     buckets.move_to_end(sig)
-                    counters.increment("xla_cache.factory_hits")
+                    counters.increment(prefix + "factory_hits")
                     return group[key]
             # compile outside the lock: factory bodies trace/compile and
             # may take seconds — a racing duplicate compile is benign
-            counters.increment("xla_cache.factory_misses")
+            counters.increment(prefix + "factory_misses")
             value = fn(*key)
             with lock:
                 group = buckets.setdefault(sig, {})
@@ -159,7 +172,7 @@ def bounded_jit_cache(max_buckets: int = 8):
                 while len(buckets) > max_buckets:
                     _, dropped = buckets.popitem(last=False)
                     counters.increment(
-                        "xla_cache.executable_evictions", len(dropped)
+                        prefix + "executable_evictions", len(dropped)
                     )
                 return group[key]
 

@@ -42,6 +42,7 @@ KNOWN_SITES = (
     "solver.dispatch",  # Decision._dispatch_loop, before the async solve
     "queue.push",  # ReplicateQueue.push fan-out
     "decision.ingest",  # Decision._kvstore_loop, after the queue read
+    "solver.whatif",  # WhatIfEngine sweep/drain/optimize entry + dispatch
 )
 
 

@@ -1,0 +1,491 @@
+"""What-if engine tests (decision/whatif.py + ops/sweep.py).
+
+The load-bearing guarantee is EXACT parity: a batched N-1 sweep's
+per-scenario distance plane must equal a serial full re-solve of the
+perturbed topology on the CPU oracle (LinkState.run_spf — the same
+Dijkstra the differential solver tests trust), at several fabric
+shapes. On top of that: verdict semantics (partition / stretch), the
+one-batched-dispatch contract, fuse_n_cap-driven chunking, the whatif
+executable-cache namespace, drain preview, the TE optimizer, and the
+chaos-isolation contract (an armed solver.whatif fault never degrades
+the live solver).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from openr_tpu.config import Config, ConfigError, DecisionConfig, OpenrConfig
+from openr_tpu.decision.tpu_solver import TpuSpfSolver
+from openr_tpu.decision.whatif import INF_E, WhatIfEngine
+from openr_tpu.models import topologies
+from openr_tpu.ops.edgeplan import MAX_METRIC
+from openr_tpu.runtime.counters import counters
+from openr_tpu.runtime.faults import registry
+from tests.conftest import run_async
+from tests.test_decision import (
+    DecisionHarness,
+    adj,
+    adj_db_kv,
+    prefix_db_kv,
+    two_node_mesh,
+)
+
+AREA = "0"
+
+
+def _counter(key):
+    return int(counters.get_counter(key) or 0)
+
+
+def make_fabric(gen):
+    adj_dbs, prefix_dbs = gen()
+    states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    me = sorted(states[AREA].node_names())[0]
+    return adj_dbs, prefix_dbs, states, ps, me
+
+
+def solved_engine(states, ps, me, **solver_kw):
+    tpu = TpuSpfSolver(me, **solver_kw)
+    assert tpu.build_route_db(me, states, ps) is not None
+    return WhatIfEngine(tpu)
+
+
+def oracle_spf_without_link(adj_dbs, prefix_dbs, link, root):
+    """Serial CPU oracle: rebuild the LSDB with `link` removed and run
+    the reference Dijkstra from `root`. -> {node: metric} (absent =
+    unreachable)."""
+    pruned = []
+    for db in adj_dbs:
+        if db.this_node_name == link.n1:
+            drop = (link.n2, link.if1)
+        elif db.this_node_name == link.n2:
+            drop = (link.n1, link.if2)
+        else:
+            pruned.append(db)
+            continue
+        pruned.append(type(db)(**{
+            **db.__dict__,
+            "adjacencies": tuple(
+                a for a in db.adjacencies
+                if (a.other_node_name, a.if_name) != drop
+            ),
+        }))
+    states, _ = topologies.build_states(pruned, prefix_dbs)
+    spf = states[AREA].run_spf(root)
+    return {name: spf[name].metric for name in spf}
+
+
+# -- N-1 parity vs the CPU oracle, 3 fabric shapes --------------------------
+
+
+@pytest.mark.parametrize("gen", [
+    lambda: topologies.full_mesh(5),
+    lambda: topologies.grid(4),
+    lambda: topologies.fat_tree(pods=2, planes=2),
+], ids=["mesh5", "grid4", "fat_tree"])
+def test_n1_sweep_matches_serial_cpu_oracle(gen):
+    adj_dbs, prefix_dbs, states, ps, me = make_fabric(gen)
+    eng = solved_engine(states, ps, me)
+    job = eng.plan_sweep(states, ps, order=1, return_dist=True)
+    out = job.run()
+    plan = job.ad.plan
+    assert out["dispatches"] == len(job.dist_planes)
+
+    # reassemble (scenario -> distance row) across chunks; lane 0 of
+    # every chunk is the baseline
+    row_of = {}
+    for ci, chunk in enumerate(job.chunks):
+        for li, scen in enumerate(chunk.scenarios, start=1):
+            row_of[scen.name] = job.dist_planes[ci][li, 0]
+    base = job.dist_planes[0][0, 0]
+
+    links = [ln for ln in states[AREA].ordered_all_links() if ln.is_up()]
+    assert out["scenarios"] == len(links) == len(row_of)
+    verdict = {r["scenario"]: r for r in out["rows"]}
+    for link in links:
+        name = f"{link.n1}|{link.n2}"
+        oracle = oracle_spf_without_link(adj_dbs, prefix_dbs, link, me)
+        got = row_of[name]
+        unreachable = 0
+        stretch = 0
+        for node, idx in plan.node_index.items():
+            want = oracle.get(node)
+            if want is None:
+                assert got[idx] >= INF_E, (name, node)
+                if base[idx] < INF_E:
+                    unreachable += 1
+            else:
+                assert int(got[idx]) == want, (name, node)
+                stretch = max(stretch, want - int(base[idx]))
+        v = verdict[name]
+        assert v["unreachable_pairs"] == unreachable, name
+        assert v["max_stretch"] == stretch, name
+        assert v["partitioned"] == (unreachable > 0), name
+
+
+def test_n1_verdicts_grid_one_dispatch():
+    """A full grid N-1 sweep: no single failure partitions a 2-connected
+    mesh, every scenario lands in ONE batched device dispatch, and the
+    counter family records it."""
+    _, _, states, ps, me = make_fabric(lambda: topologies.grid(5))
+    eng = solved_engine(states, ps, me)
+    d0 = _counter("whatif.device.batched_dispatches")
+    s0 = _counter("whatif.device.batched_scenarios")
+    out = eng.sweep(states, ps, order=1)
+    n_links = len([
+        ln for ln in states[AREA].ordered_all_links() if ln.is_up()
+    ])
+    assert out["scenarios"] == n_links
+    assert out["partitioned"] == 0
+    assert all(not r["partitioned"] for r in out["rows"])
+    assert out["dispatches"] == 1
+    assert _counter("whatif.device.batched_dispatches") - d0 == 1
+    assert _counter("whatif.device.batched_scenarios") - s0 == n_links
+
+
+def test_ring_n1_stretch_and_bridge_partition():
+    # ring: a single failure never partitions. From one vantage, only
+    # failures on the vantage's SPF tree stretch anything: the two
+    # edges "opposite" node-0 in ring(6) leave every shortest path
+    # intact (the other direction ties), so exactly 4 of 6 rows move,
+    # and the worst case (an edge incident to the root) stretches by
+    # ring_len - 2 = 4.
+    _, _, states, ps, me = make_fabric(lambda: topologies.ring(6))
+    eng = solved_engine(states, ps, me)
+    out = eng.sweep(states, ps, order=1)
+    assert out["scenarios"] == 6
+    assert out["partitioned"] == 0
+    stretches = sorted(r["max_stretch"] for r in out["rows"])
+    assert stretches == [0, 0, 2, 2, 4, 4]
+
+    # two triangles joined by one bridge: exactly the bridge partitions
+    tri = {
+        "a": ["b", "c"], "b": ["a", "c"], "c": ["a", "b", "x"],
+        "x": ["c", "y", "z"], "y": ["x", "z"], "z": ["x", "y"],
+    }
+    from openr_tpu.models.topologies import _adj, _mk_dbs
+    from openr_tpu.types import PrefixForwardingAlgorithm
+
+    nodes = {
+        n: [_adj(n, o) for o in peers] for n, peers in tri.items()
+    }
+    adj_dbs, prefix_dbs = _mk_dbs(
+        nodes, AREA, PrefixForwardingAlgorithm.SP_ECMP, True
+    )
+    states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    eng = solved_engine(states, ps, "a")
+    out = eng.sweep(states, ps, order=1)
+    parts = [r for r in out["rows"] if r["partitioned"]]
+    assert [p["scenario"] for p in parts] == ["c|x"]
+    assert parts[0]["unreachable_pairs"] == 3  # x, y, z lost from a
+    # worst scenario sorts first
+    assert out["rows"][0]["scenario"] == "c|x"
+
+
+@pytest.mark.slow
+def test_n2_sweep_ring_always_partitions():
+    """Order-2 exactness on the one topology with a closed-form answer:
+    removing ANY two edges of a cycle partitions it."""
+    _, _, states, ps, me = make_fabric(lambda: topologies.ring(8))
+    eng = solved_engine(states, ps, me)
+    out = eng.sweep(states, ps, order=2)
+    assert out["scenarios"] == 8 + 28  # N-1 lanes + C(8,2) pairs
+    pairs = [r for r in out["rows"] if "+" in r["scenario"]]
+    assert len(pairs) == 28
+    assert all(r["partitioned"] for r in pairs)
+
+
+def test_max_scenarios_truncation():
+    _, _, states, ps, me = make_fabric(lambda: topologies.grid(4))
+    eng = solved_engine(states, ps, me)
+    out = eng.sweep(states, ps, order=2, max_scenarios=10)
+    assert out["scenarios"] == 10
+    assert out["truncated"] > 0
+
+
+# -- fuse_n_cap knob --------------------------------------------------------
+
+
+def test_fuse_n_cap_drives_sweep_chunking():
+    _, _, states, ps, me = make_fabric(lambda: topologies.grid(4))
+    # tiny budget: 16 * 2048 / n_cap(16) = 2048... force chunking via
+    # an even smaller value than one lane row
+    eng = solved_engine(states, ps, me, fuse_n_cap=1)
+    assert eng.solver.fuse_n_cap == 1
+    # cap = max(2, 2048 // 16) = 128 -> still one chunk for 24 links;
+    # shrink further by pretending a huge plan via _batch_cap directly
+    assert eng._batch_cap(2048 * 4, 1) == 2
+    job = eng.plan_sweep(states, ps, order=2)
+    n_links = 24
+    expect = n_links + n_links * (n_links - 1) // 2
+    assert sum(len(c.scenarios) for c in job.chunks) == expect
+    assert len(job.chunks) > 1  # budget forced multiple dispatches
+    out = job.run()
+    assert out["dispatches"] == len(job.chunks)
+    job2 = solved_engine(states, ps, me, fuse_n_cap=4096).plan_sweep(
+        states, ps, order=2
+    )
+    assert len(job2.chunks) == 1  # default budget: one dispatch
+    job2.fail()
+
+
+def test_fuse_n_cap_config_validation_and_threading():
+    cfg = OpenrConfig(node_name="node1")
+    cfg.decision_config.fuse_n_cap = 0
+    with pytest.raises(ConfigError):
+        Config(cfg)
+    assert DecisionConfig().fuse_n_cap == 4096
+    assert TpuSpfSolver("n", fuse_n_cap=123).fuse_n_cap == 123
+
+
+# -- whatif executable-cache namespace (xla_cache.whatif_*) ------------------
+
+
+def test_bounded_cache_whatif_namespace_isolated():
+    from openr_tpu.ops.xla_cache import bounded_jit_cache
+
+    @bounded_jit_cache(max_buckets=2)
+    def live(n):
+        return object()
+
+    @bounded_jit_cache(max_buckets=2, namespace="whatif")
+    def sweepy(n):
+        return object()
+
+    live(1), live(2)
+    a = live(1)
+    w0 = {
+        k: _counter(f"xla_cache.whatif_{k}")
+        for k in ("factory_hits", "factory_misses", "executable_evictions")
+    }
+    # churn MANY whatif shapes straight through its 2-bucket budget
+    for n in range(8):
+        sweepy(n)
+    # live executables untouched by the whatif churn
+    assert live(1) is a
+    assert _counter("xla_cache.whatif_factory_misses") - w0[
+        "factory_misses"
+    ] == 8
+    assert _counter("xla_cache.whatif_executable_evictions") - w0[
+        "executable_evictions"
+    ] == 6
+    assert sweepy(7) is sweepy(7)
+    assert _counter("xla_cache.whatif_factory_hits") > w0["factory_hits"]
+
+
+# -- drain preview ----------------------------------------------------------
+
+
+def test_drain_node_preview_line_topology():
+    # a - b - c: draining b's out-edges cuts transit, c lost from a
+    nodes = {"a": ["b"], "b": ["a", "c"], "c": ["b"]}
+    from openr_tpu.models.topologies import _adj, _mk_dbs
+    from openr_tpu.types import PrefixForwardingAlgorithm
+
+    adj_dbs, prefix_dbs = _mk_dbs(
+        {n: [_adj(n, o) for o in p] for n, p in nodes.items()},
+        AREA, PrefixForwardingAlgorithm.SP_ECMP, True,
+    )
+    states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    eng = solved_engine(states, ps, "a")
+    out = eng.drain(states, ps, node="b")
+    assert out["kind"] == "drain_node"
+    assert out["partitioned"]
+    assert out["unreachable_pairs"] == 1
+    lost = [i for i in out["impacted"] if i["unreachable"]]
+    assert [i["node"] for i in lost] == ["c"]
+    assert lost[0]["before"] == 2 and lost[0]["after"] is None
+    # b itself stays reachable: in-edges stand under a transit drain
+    assert all(i["node"] != "b" for i in lost)
+
+    out = eng.drain(states, ps, link="a|b")
+    assert out["kind"] == "drain_link"
+    assert out["unreachable_pairs"] == 2  # b and c both lost
+
+    with pytest.raises(ValueError):
+        eng.drain(states, ps, node="a", link="a|b")
+    with pytest.raises(ValueError):
+        eng.drain(states, ps, link="a|zzz")
+
+
+def test_drain_stretch_reports_affected_destinations():
+    _, _, states, ps, me = make_fabric(lambda: topologies.ring(6))
+    eng = solved_engine(states, ps, me)
+    out = eng.drain(states, ps, link="node-0|node-1")
+    assert not out["partitioned"]
+    assert out["max_stretch"] > 0
+    assert out["impacted"], "rerouted destinations must be listed"
+    worst = out["impacted"][0]
+    assert worst["stretch"] == out["max_stretch"]
+    assert worst["after"] == worst["before"] + worst["stretch"]
+
+
+# -- TE optimizer -----------------------------------------------------------
+
+
+def test_optimize_smoke_structure():
+    _, _, states, ps, me = make_fabric(lambda: topologies.grid(3))
+    eng = solved_engine(states, ps, me)
+    dem = [
+        {"src": "node-0-0", "dst": "node-2-2", "volume": 4.0},
+        {"src": "node-0-2", "dst": "node-2-0"},
+        {"src": "node-0-0", "dst": "node-0-0"},  # rejected: src == dst
+        {"src": "node-0-0", "dst": "nope"},  # rejected: unknown
+    ]
+    o0 = _counter("whatif.optimizes")
+    out = eng.optimize(states, ps, dem, iters=2, lr=0.05)
+    assert out["iters"] == 2 and len(out["loss_curve"]) == 2
+    assert out["demands"] == 2 and out["rejected_demands"] == 2
+    assert np.isfinite(out["loss_curve"]).all()
+    assert out["max_util_before"] > 0
+    for ch in out["changes"]:
+        assert 1 <= ch["proposed"] <= MAX_METRIC
+    assert _counter("whatif.optimizes") - o0 == 1
+    with pytest.raises(ValueError):
+        eng.optimize(states, ps, [])
+    with pytest.raises(ValueError):
+        eng.optimize(states, ps, [{"src": "nope", "dst": "node-0-0"}])
+
+
+@pytest.mark.slow
+def test_optimize_loop_reduces_soft_max_utilization():
+    """Diamond with a cheap and an expensive branch: all demand piles on
+    the cheap one; gradient descent must spread it (soft-max-util loss
+    strictly lower than at theta0)."""
+    nodes = {
+        "s": ["a", "b"], "a": ["s", "t"], "b": ["s", "t"], "t": ["a", "b"],
+    }
+    from openr_tpu.models.topologies import _adj, _mk_dbs
+    from openr_tpu.types import PrefixForwardingAlgorithm
+
+    metric = {("s", "b"): 4, ("b", "s"): 4, ("b", "t"): 4, ("t", "b"): 4}
+    adj_dbs, prefix_dbs = _mk_dbs(
+        {
+            n: [_adj(n, o, metric=metric.get((n, o), 1)) for o in p]
+            for n, p in nodes.items()
+        },
+        AREA, PrefixForwardingAlgorithm.SP_ECMP, True,
+    )
+    states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    eng = solved_engine(states, ps, "s")
+    out = eng.optimize(
+        states, ps, [{"src": "s", "dst": "t", "volume": 10.0}],
+        iters=30, lr=0.05, tau=1.0,
+    )
+    assert out["loss_curve"][-1] < out["loss_curve"][0]
+    assert out["changes"], "an imbalanced diamond must move some metric"
+
+
+# -- chaos isolation + Decision wiring --------------------------------------
+
+
+class TestWhatifDecision:
+    @run_async
+    async def test_armed_whatif_fault_never_degrades_live_solver(self):
+        registry.clear()
+        try:
+            async with DecisionHarness(backend="tpu") as h:
+                two_node_mesh(h)
+                h.synced()
+                await h.next_route_update()
+                registry.arm("solver.whatif", probability=1.0)
+                e0 = _counter("whatif.errors")
+                out = await h.decision.whatif_sweep(order=1)
+                assert "error" in out and "FaultInjected" in out["error"]
+                assert _counter("whatif.errors") - e0 == 1
+                # the live solver is untouched: not degraded, and the
+                # next topology event still converges on the primary
+                assert not h.decision._degraded
+                assert _counter("decision.solver.degraded") in (0,)
+                registry.clear("solver.whatif")
+                h.publish(
+                    adj_db_kv("1", [adj("1", "2"), adj("1", "3")],
+                              version=2),
+                    adj_db_kv("3", [adj("3", "1")]),
+                    prefix_db_kv("3", "10.0.0.3/32"),
+                )
+                update = await h.next_route_update()
+                assert "10.0.0.3/32" in update.unicast_routes_to_update
+                assert not h.decision._degraded
+                # disarmed: the sweep itself now works through the actor
+                out = await h.decision.whatif_sweep(order=1)
+                assert "error" not in out
+                assert out["scenarios"] == 2
+        finally:
+            registry.clear()
+
+    @run_async
+    async def test_whatif_requires_device_backend(self):
+        async with DecisionHarness(backend="cpu") as h:
+            two_node_mesh(h)
+            h.synced()
+            await h.next_route_update()
+            out = await h.decision.whatif_sweep()
+            assert "error" in out
+
+    @run_async
+    async def test_sweep_concurrent_with_live_churn_async_dispatch(self):
+        """The acceptance shape: a sweep in flight must not stop a live
+        topology event from converging (whatif dispatches gate on the
+        solve queue; errors stay in the whatif lane)."""
+        cfg = DecisionConfig(
+            debounce_min_ms=5, debounce_max_ms=20, async_dispatch=True
+        )
+        async with DecisionHarness(backend="tpu", config=cfg) as h:
+            two_node_mesh(h)
+            h.synced()
+            await h.next_route_update()
+            sweep = asyncio.ensure_future(h.decision.whatif_sweep(order=1))
+            h.publish(
+                adj_db_kv("1", [adj("1", "2"), adj("1", "3")], version=2),
+                adj_db_kv("3", [adj("3", "1")]),
+                prefix_db_kv("3", "10.0.0.3/32"),
+            )
+            update = await h.next_route_update()
+            assert "10.0.0.3/32" in update.unicast_routes_to_update
+            out = await sweep
+            assert "error" not in out
+            assert out["scenarios"] >= 1
+            # and a sweep over the NEW topology sees the third node
+            out = await h.decision.whatif_sweep(order=1)
+            assert out["scenarios"] == 2
+
+    @run_async
+    async def test_whatif_drain_and_optimize_through_actor(self):
+        async with DecisionHarness(backend="tpu") as h:
+            two_node_mesh(h)
+            h.synced()
+            await h.next_route_update()
+            out = await h.decision.whatif_drain(link="1|2")
+            assert out["partitioned"] and out["unreachable_pairs"] == 1
+            out = await h.decision.whatif_optimize(
+                [{"src": "1", "dst": "2", "volume": 2.0}], iters=2, lr=0.01
+            )
+            assert "error" not in out and out["demands"] == 1
+            out = await h.decision.whatif_drain()  # neither node nor link
+            assert "error" in out
+
+
+# -- traces stay out of the convergence percentiles -------------------------
+
+
+def test_whatif_traces_close_with_whatif_status():
+    from openr_tpu.runtime.tracing import tracer
+
+    def converged_count():
+        stats = counters.get_statistics("convergence_ms", windows=(1e9,))
+        agg = stats.get("convergence_ms")
+        return next(iter(agg.values()))["count"] if agg else 0
+
+    _, _, states, ps, me = make_fabric(lambda: topologies.ring(4))
+    eng = solved_engine(states, ps, me)
+    n0 = converged_count()
+    eng.sweep(states, ps, order=1)
+    done = [
+        t for t in tracer.get_traces(limit=50)
+        if t["name"] == "whatif.sweep"
+    ]
+    assert done and done[-1]["status"] == "whatif"
+    assert converged_count() == n0, "a sweep must not stamp convergence_ms"
