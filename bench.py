@@ -40,11 +40,13 @@ def _flap(states, adj_dbs, victims, round_i, area="0"):
     reroutes and routes to/through the victims change."""
     from openr_tpu.types import AdjacencyDatabase, Adjacency
 
+    # cache the name index per adj_dbs object — holding the reference
+    # itself (not its id(), which the allocator reuses across configs)
     by_name = getattr(_flap, "_index", None)
-    if by_name is None or _flap._index_id != id(adj_dbs):
+    if by_name is None or _flap._index_src is not adj_dbs:
         by_name = {db.this_node_name: db for db in adj_dbs}
         _flap._index = by_name
-        _flap._index_id = id(adj_dbs)
+        _flap._index_src = adj_dbs
 
     metric = 50 + (round_i % 5)
     touched = {}
@@ -203,7 +205,9 @@ def bench_config(name, gen, me, runs=5, flap_victims=0, cpu_baseline=True,
             "p50": round(_percentile(pv, 50.0), 1),
             "p99": round(_percentile(pv, 99.0), 1),
         }
-    res["changed_rows"] = tpu.last_device_stats.get("changed_rows")
+    # uniform across fabric sizes: 0 when the delta pull had no changed
+    # rows (or the config delegated to the CPU oracle), never null
+    res["changed_rows"] = int(tpu.last_device_stats.get("changed_rows") or 0)
     # peak HBM across devices at end of the churn loop — None on backends
     # (cpu) that don't expose memory_stats()
     from openr_tpu.runtime.device_stats import peak_hbm_mb
@@ -246,6 +250,59 @@ def bench_config(name, gen, me, runs=5, flap_victims=0, cpu_baseline=True,
         f"/ device-only {res.get('device_ms')} "
         f"/ uploaded {res.get('bytes_uploaded')} B "
         f"/ xla {res['xla_cache']})")
+
+    # incremental churn lane: same fabric, single-victim metric flaps
+    # against a solver with the seed-from-previous path enabled, so each
+    # config reports incr_device_ms / incr_changed_rows next to its
+    # full-solve numbers. Skipped when the config delegated to the CPU
+    # oracle (no device path to make incremental). The incr executable
+    # cache deltas ride along: a steady flap sequence reuses ONE dirty
+    # bucket, so incr_executable_evictions staying 0 is the health
+    # signal the smoke test pins.
+    if res.get("device_ms") is not None:
+        _INCR_KEYS = (
+            "incr_factory_hits", "incr_factory_misses",
+            "incr_executable_evictions",
+        )
+        ix0 = {
+            k: int(_counters.get_counter(f"xla_cache.{k}") or 0)
+            for k in _INCR_KEYS
+        }
+        tpu_i = TpuSpfSolver(
+            me, small_graph_nodes=small_graph_nodes,
+            incremental_spf=True, **solver_kw,
+        )
+        tpu_i.build_route_db(me, states, ps)  # first solve: cold seed
+        i_samples, engaged, cones, rows = [], 0, [], []
+        for i in range(runs):
+            _flap(states, adj_dbs, victims[:1], runs + i, area)
+            t0 = time.perf_counter()
+            tpu_i.build_route_db(me, states, ps)
+            i_samples.append((time.perf_counter() - t0) * 1e3)
+            st = tpu_i.last_device_stats
+            if st.get("incremental") and not st.get("fell_back"):
+                engaged += 1
+            cones.append(int(st.get("cone") or 0))
+            rows.append(int(st.get("changed_rows") or 0))
+        res["incr_tpu_ms"] = round(statistics.median(i_samples), 1)
+        res["incr_engaged"] = engaged
+        res["incr_runs"] = runs
+        res["incr_cone"] = max(cones) if cones else 0
+        res["incr_changed_rows"] = max(rows) if rows else 0
+        res["incr_xla_cache"] = {
+            k: int(_counters.get_counter(f"xla_cache.{k}") or 0) - ix0[k]
+            for k in _INCR_KEYS
+        }
+        i_dev = tpu_i.incr_device_compute_ms()
+        if i_dev is not None:
+            res["incr_device_ms"] = round(i_dev, 2)
+        log(f"[{name}] tpu incremental churn: "
+            f"{[f'{s:.0f}' for s in i_samples]} ms "
+            f"(engaged {engaged}/{runs} / device-only "
+            f"{res.get('incr_device_ms')} / cone {res['incr_cone']} "
+            f"/ changed {res['incr_changed_rows']} "
+            f"/ xla {res['incr_xla_cache']})")
+        del tpu_i
     return res, tpu_ms, cpu_ms
 
 
@@ -410,6 +467,9 @@ def main() -> None:
         "vs_baseline": round((cpu_ms or tpu_ms) / tpu_ms, 2),
         "rig_rtt_ms": round(rtt_ms, 1),
         "device_ms_100k": dev,
+        "incr_device_ms_100k": configs.get("lsdb100k", {}).get(
+            "incr_device_ms"
+        ),
         # The e2e value above includes one mandatory device->host result
         # round trip; on this tunneled rig that RTT (rig_rtt_ms, measured
         # with an 8-byte pull) is a fixed floor independent of problem

@@ -449,8 +449,10 @@ def received_routes(ctx) -> None:
 @decision.command("convergence")
 @click.pass_context
 def decision_convergence(ctx) -> None:
-    """Per-event convergence latency: p50/p95/p99 over closed traces
-    plus the windowed convergence_ms stat."""
+    """Per-event convergence latency: p50/p95/p99 over closed traces,
+    the windowed convergence_ms stat, and the solver's incremental vs
+    full dispatch split (incremental_solves / incremental_full_fallbacks
+    / full_solves plus cone-fraction and changed-row stats)."""
     _print(_call(ctx, "ctrl.decision.convergence"))
 
 

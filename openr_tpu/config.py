@@ -155,6 +155,20 @@ class DecisionConfig:
     # (decision/whatif.py) sizes its scenario chunks off the same value.
     # Larger = fewer dispatches but bigger resident planes per launch.
     fuse_n_cap: int = 4096
+    # incremental device SSSP (decision/tpu_solver.py +
+    # ops/incremental.py): seed each single-area dispatch from the
+    # previous resident distance plane and re-relax only the affected
+    # cone of the drained dirty edges. Bit-identical to the full solve;
+    # falls back automatically on first solve, topology-shape or
+    # root-link churn, journal gaps, zero-weight edges, or when the
+    # affected cone exceeds incremental_cone_frac of the fabric.
+    incremental_spf: bool = True
+    # full-solve fallback threshold: affected cone (in node-lanes, as a
+    # fraction of d_cap * n_nodes) above which a warm re-relax stops
+    # paying for its parent-plane overhead. Decided on device inside
+    # the same dispatch. 0.0 forces every incremental dispatch to
+    # degrade to the (bit-identical) cold seed — a bisection lever.
+    incremental_cone_frac: float = 0.25
 
 
 @dataclass
@@ -537,6 +551,10 @@ class Config:
             raise ConfigError("decision dispatch_coalesce_ms must be >= 0")
         if dc.fuse_n_cap < 1:
             raise ConfigError("decision fuse_n_cap must be >= 1")
+        if not (0.0 <= dc.incremental_cone_frac <= 1.0):
+            raise ConfigError(
+                "decision incremental_cone_frac must be in [0, 1]"
+            )
         wc = cfg.watchdog_config
         if wc.supervisor_crash_budget < 0:
             raise ConfigError("supervisor_crash_budget must be >= 0")

@@ -255,12 +255,36 @@ class CtrlServer(Actor):
 
     async def _decision_convergence(self) -> dict:
         """Per-event convergence latency: percentile summary over the
-        closed-trace ring plus the windowed convergence_ms stat."""
+        closed-trace ring, the windowed convergence_ms stat, and the
+        solver's incremental/full dispatch split (decision.solver.*
+        counters — incr.solves ran the seed-from-previous kernel,
+        incr.full_fallbacks degraded to a full solve while incremental
+        was enabled, full.solves is every cold/full dispatch)."""
+        incr_stats = counters.get_statistics(
+            "decision.solver.incr"
+        )
         return {
             "summary": tracer.convergence_summary(),
             "stat": counters.get_statistics("convergence_ms").get(
                 "convergence_ms", {}
             ),
+            "solver": {
+                "incremental_solves": counters.get_counter(
+                    "decision.solver.incr.solves"
+                ) or 0,
+                "incremental_full_fallbacks": counters.get_counter(
+                    "decision.solver.incr.full_fallbacks"
+                ) or 0,
+                "full_solves": counters.get_counter(
+                    "decision.solver.full.solves"
+                ) or 0,
+                "cone_frac": incr_stats.get(
+                    "decision.solver.incr.cone_frac", {}
+                ),
+                "changed_rows": incr_stats.get(
+                    "decision.solver.incr.changed_rows", {}
+                ),
+            },
         }
 
     async def _watch_initialization(self, queue: ReplicateQueue) -> None:

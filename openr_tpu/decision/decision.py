@@ -109,6 +109,8 @@ def make_solver(
         kwargs.pop("xla_cache_dir", None)
         kwargs.pop("enable_numerical_sentinels", None)
         kwargs.pop("fuse_n_cap", None)
+        kwargs.pop("incremental_spf", None)
+        kwargs.pop("incremental_cone_frac", None)
         return SpfSolver(node_name, **kwargs)
     if backend in ("tpu", "auto"):
         try:
@@ -125,6 +127,8 @@ def make_solver(
             kwargs.pop("small_graph_nodes", None)
             kwargs.pop("enable_numerical_sentinels", None)
             kwargs.pop("fuse_n_cap", None)
+            kwargs.pop("incremental_spf", None)
+            kwargs.pop("incremental_cone_frac", None)
             return SpfSolver(node_name, **kwargs)
     raise ValueError(f"unknown solver backend {backend!r}")
 
@@ -172,6 +176,10 @@ class Decision(Actor):
                 config.enable_numerical_sentinels,
             )
             skw.setdefault("fuse_n_cap", config.fuse_n_cap)
+            skw.setdefault("incremental_spf", config.incremental_spf)
+            skw.setdefault(
+                "incremental_cone_frac", config.incremental_cone_frac
+            )
         self.solver = make_solver(
             node_name,
             backend,
@@ -782,6 +790,10 @@ class Decision(Actor):
         tm = getattr(self.solver, "last_timing", None)
         if not isinstance(tm, dict) or spf_sp.end is None:
             return
+        if tm.get("incremental"):
+            # at least one area dispatched the incremental SSSP kernel
+            # this solve (seed-from-previous, ops/incremental.py)
+            spf_sp.attributes["incremental"] = True
         areas = tm.get("areas") or {"": tm}
         cursor = spf_sp.end
         for area, stages in sorted(areas.items(), reverse=True):

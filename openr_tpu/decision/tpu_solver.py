@@ -108,6 +108,48 @@ _UNROLL = 8
 # the int32 metric algebra cannot flag on its own
 _SENTINEL_SAT = 1 << 28
 
+# incremental-solve dirty buffers pad to one of these sizes (shared by
+# the shift and residual buffers): pow4 steps bound the number of
+# executable shape classes per fabric to 4, so dirty-set churn settles
+# into a handful of incr-namespace buckets instead of thrashing them.
+# Larger merged dirty sets fall back to the full solve on host.
+_DIRTY_BUCKETS = (64, 256, 1024, 4096)
+
+
+def _dirty_bucket(n: int) -> Optional[int]:
+    for b in _DIRTY_BUCKETS:
+        if n <= b:
+            return b
+    return None
+
+
+def _merge_drain_log(ad: "_AreaDev", since_epoch: int):
+    """Merge the area's drain journal entries newer than `since_epoch`
+    into ({shift_flat: old}, {res_flat: old}) maps carrying each dirty
+    slot's weight AS OF since_epoch (the epoch of the vantage's
+    resident distance plane). Returns None when the window cannot be
+    reconstructed — a journal gap (deque overflow), a reset marker
+    (mirror rebuild / residual-layout change), or a missing epoch —
+    in which case the caller falls back to the full solve."""
+    if ad.drain_epoch == since_epoch:
+        return {}, {}
+    s_map: dict = {}
+    r_map: dict = {}
+    expected = since_epoch + 1
+    for epoch, s_d, r_d in ad.drain_log:
+        if epoch <= since_epoch:
+            continue
+        if epoch != expected or s_d is None:
+            return None
+        for f, old in s_d.items():
+            s_map.setdefault(f, old)
+        for f, old in r_d.items():
+            r_map.setdefault(f, old)
+        expected += 1
+    if expected != ad.drain_epoch + 1:
+        return None
+    return s_map, r_map
+
 
 def _ucmp_weight_anomalies(w) -> int:
     """Count numerically-unhealthy entries in a UCMP weight field:
@@ -347,7 +389,8 @@ def _make_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
                    has_res: bool,
                    d_cap: int, p_cap: int, a_cap: int, budget: int,
                    lfa: bool = False, block_v4: bool = False,
-                   sentinels: bool = True):
+                   sentinels: bool = True, emit_dist: bool = False,
+                   incr: bool = False):
     """The fused production pipeline (raw closure — _plan_pipeline jits
     it for the single-area path, _fused_pipeline vmaps it over a group
     of same-shape areas). Outputs:
@@ -362,11 +405,23 @@ def _make_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
                 into ColumnarRib columns without an O(P*A) filter pass.
       metric, s3w, nhw, lfa_slot, lfa_metric: resident arrays (the next
                 call's prev_*; lfa arrays are passthrough when lfa=False)
+      dist_d (emit_dist): the [D, N] SSSP plane, kept resident as the
+                next incremental solve's warm seed.
+
+    With `incr=True` the pipeline takes six extra trailing args
+    (prev_dist, s_dirty_idx, s_dirty_old, r_dirty_idx, r_dirty_old,
+    cone_limit) and swaps the cold SSSP for ops/incremental.py's
+    seed-from-previous solve; [cone, fell_back] ride the tail of both
+    pull buffers AFTER the sentinel scalars. The incremental fixpoint
+    is bit-identical to the cold one, so the ENTIRE selection / LFA /
+    packing / delta tail below is shared verbatim between the two
+    kernels — output parity by construction.
     """
     import jax
     import jax.numpy as jnp
 
     from openr_tpu.ops.compact import route_ok_device
+    from openr_tpu.ops.incremental import incremental_sssp
 
     wa = -(-a_cap // 16)
     wd = -(-d_cap // 16)
@@ -376,7 +431,7 @@ def _make_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
     def pipeline(deltas, shift_w, res_rows, res_nbr, res_w, mbuf,
                  root, root_nbr, root_w,
                  prev_metric, prev_s3w, prev_nhw,
-                 prev_lfa_slot, prev_lfa_metric):
+                 prev_lfa_slot, prev_lfa_metric, *incr_args):
         o = 0
         ann_node = mbuf[o:o + pa].reshape(p_cap, a_cap); o += pa
         ann_flags = mbuf[o:o + pa].reshape(p_cap, a_cap); o += pa
@@ -393,11 +448,22 @@ def _make_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
             else jnp.zeros((p_cap,), bool)
         )
 
-        dist_d, trips = _plan_sssp(
-            deltas, shift_w, res_rows, res_nbr, res_w, root,
-            root_nbr, root_w,
-            s_cap, has_res, n_cap, d_cap, max_trips,
-        )  # [D, N]
+        if incr:
+            (prev_dist, s_dirty_idx, s_dirty_old,
+             r_dirty_idx, r_dirty_old, cone_limit) = incr_args
+            dist_d, trips, cone, fell_back = incremental_sssp(
+                deltas, shift_w, res_rows, res_nbr, res_w, root,
+                root_nbr, root_w, prev_dist,
+                s_dirty_idx, s_dirty_old, r_dirty_idx, r_dirty_old,
+                cone_limit,
+                s_cap, has_res, n_cap, d_cap, max_trips,
+            )  # [D, N]
+        else:
+            dist_d, trips = _plan_sssp(
+                deltas, shift_w, res_rows, res_nbr, res_w, root,
+                root_nbr, root_w,
+                s_cap, has_res, n_cap, d_cap, max_trips,
+            )  # [D, N]
         via = root_w[:, None] + dist_d  # <= 2^30, overflow-free
         dist = jnp.minimum(via.min(axis=0), INF_E).at[root].set(0)  # [N]
 
@@ -517,9 +583,20 @@ def _make_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
             )
             delta_parts += [unreach[None], saturated[None]]
             full_parts += [unreach[None], saturated[None]]
+        if incr:
+            # cone + in-kernel-fallback flag ride last (the host parses
+            # the tail back to front: [-2]=cone, [-1]=fell_back, with
+            # the sentinels at [-4]/[-3] when enabled)
+            tail = [cone[None], fell_back.astype(jnp.int32)[None]]
+            delta_parts += tail
+            full_parts += tail
         delta_buf = jnp.concatenate(delta_parts)
         full_buf = jnp.concatenate(full_parts)
-        return delta_buf, full_buf, metric, s3w, nhw, lfa_slot, lfa_metric
+        outs = (delta_buf, full_buf, metric, s3w, nhw, lfa_slot,
+                lfa_metric)
+        if emit_dist:
+            outs += (dist_d,)
+        return outs
 
     return pipeline
 
@@ -529,12 +606,31 @@ def _plan_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
                    has_res: bool,
                    d_cap: int, p_cap: int, a_cap: int, budget: int,
                    lfa: bool = False, block_v4: bool = False,
-                   sentinels: bool = True):
+                   sentinels: bool = True, emit_dist: bool = False):
     import jax
 
     return jax.jit(_make_pipeline(
         n_cap, s_cap, r_cap, kr_cap, has_res, d_cap, p_cap, a_cap,
-        budget, lfa, block_v4, sentinels,
+        budget, lfa, block_v4, sentinels, emit_dist,
+    ))
+
+
+@bounded_jit_cache(namespace="incr")
+def _incr_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
+                   has_res: bool,
+                   d_cap: int, p_cap: int, a_cap: int, budget: int,
+                   dirty_cap: int, lfa: bool = False,
+                   block_v4: bool = False, sentinels: bool = True):
+    """Incremental-solve executable. `dirty_cap` is the quantized pad
+    size of BOTH dirty buffers — part of the capacity signature so
+    dirty-set shape churn buckets under the `incr` namespace and can
+    never evict the full-solve or what-if executables. Always emits the
+    distance plane (it is the next solve's warm seed)."""
+    import jax
+
+    return jax.jit(_make_pipeline(
+        n_cap, s_cap, r_cap, kr_cap, has_res, d_cap, p_cap, a_cap,
+        budget, lfa, block_v4, sentinels, emit_dist=True, incr=True,
     ))
 
 
@@ -596,6 +692,7 @@ def _instrumented_pipeline(
     n_cap: int, s_cap: int, r_cap: int, kr_cap: int, has_res: bool,
     d_cap: int, p_cap: int, a_cap: int, budget: int,
     lfa: bool, block_v4: bool, sentinels: bool,
+    emit_dist: bool = False,
 ) -> tuple:
     """(kernel name, instrumented callable) for a pipeline shape class.
     The wrapper AOT-compiles on first call, recording compile time +
@@ -613,7 +710,32 @@ def _instrumented_pipeline(
     )
     jitted = _plan_pipeline(
         n_cap, s_cap, r_cap, kr_cap, has_res, d_cap, p_cap, a_cap,
-        budget, lfa, block_v4, sentinels,
+        budget, lfa, block_v4, sentinels, emit_dist,
+    )
+    return name, instrument_jit(name, jitted)
+
+
+@bounded_jit_cache(namespace="incr")
+def _instrumented_incr(
+    n_cap: int, s_cap: int, r_cap: int, kr_cap: int, has_res: bool,
+    d_cap: int, p_cap: int, a_cap: int, budget: int, dirty_cap: int,
+    lfa: bool, block_v4: bool, sentinels: bool,
+) -> tuple:
+    """(kernel name, instrumented callable) for an incremental-solve
+    shape class — the incr-namespace analogue of
+    _instrumented_pipeline."""
+    from openr_tpu.ops.xla_cache import instrument_jit
+
+    name = (
+        f"pipeline_incr[n={n_cap},s={s_cap},d={d_cap},p={p_cap},"
+        f"a={a_cap},dd={dirty_cap}"
+        + (",res" if has_res else "")
+        + (",lfa" if lfa else "")
+        + "]"
+    )
+    jitted = _incr_pipeline(
+        n_cap, s_cap, r_cap, kr_cap, has_res, d_cap, p_cap, a_cap,
+        budget, dirty_cap, lfa, block_v4, sentinels,
     )
     return name, instrument_jit(name, jitted)
 
@@ -670,10 +792,12 @@ class _AreaDev:
     __slots__ = (
         "plan", "d_deltas", "d_shift_w", "d_res_rows", "d_res_nbr",
         "d_res_w", "matrix_key", "matrix", "flags", "d_mbuf",
-        "matrix_version", "pack_over",
+        "matrix_version", "pack_over", "drain_epoch", "drain_log",
     )
 
     def __init__(self):
+        from collections import deque
+
         self.plan: Optional[EdgePlan] = None
         self.d_deltas = self.d_shift_w = None
         self.d_res_rows = self.d_res_nbr = self.d_res_w = None
@@ -681,6 +805,16 @@ class _AreaDev:
         self.matrix: Optional[PrefixMatrix] = None
         self.flags: Optional[np.ndarray] = None
         self.d_mbuf = None
+        # drain journal for the incremental solver: one entry per
+        # _sync_area epoch — ({shift_flat: old_w}, {res_flat: old_w})
+        # maps of that drain's pre-write weights, or (None, None) as a
+        # reset marker (rebuild / residual-layout change). A vantage
+        # whose distance plane is k epochs old merges the last k
+        # entries to reconstruct its old weight plane on device; the
+        # bounded deque turns long-idle vantages into journal gaps
+        # (-> full-solve fallback) instead of unbounded host state.
+        self.drain_epoch = 0
+        self.drain_log = deque(maxlen=16)
         # node_overloaded snapshot at the last _pack_matrix: packing is
         # a pure function of (matrix, overload set), so an unchanged
         # snapshot skips the O(6*P*A) host concat entirely
@@ -697,7 +831,7 @@ class _VantageState:
 
     __slots__ = (
         "shape_key", "matrix_version", "prev", "crib",
-        "links_tuple", "valid",
+        "links_tuple", "valid", "prev_dist", "dist_epoch", "root_sig",
     )
 
     def __init__(self):
@@ -707,6 +841,15 @@ class _VantageState:
         self.crib: Optional[ColumnarRib] = None
         self.links_tuple: tuple = ()
         self.valid = False
+        # incremental-solve seed state: the [D, N] distance plane of
+        # the last single-area dispatch, the area drain epoch it
+        # corresponds to, and the root out-link signature it was
+        # computed under (lane <-> neighbor mapping + per-lane link-up
+        # mask; a changed mask flips lanes between all-INF and finite,
+        # which a warm re-relax cannot express)
+        self.prev_dist = None
+        self.dist_epoch = -1
+        self.root_sig = None
 
 
 # areas at or below this node capacity are candidates for the fused
@@ -937,7 +1080,9 @@ class TpuSpfSolver:
         xla_cache_dir: str | None = None,
         enable_numerical_sentinels: bool = True,
         fuse_small_areas: bool = True,
-        fuse_n_cap: int = _FUSE_MAX_NCAP, **solver_kwargs
+        fuse_n_cap: int = _FUSE_MAX_NCAP,
+        incremental_spf: bool = False,
+        incremental_cone_frac: float = 0.25, **solver_kwargs
     ):
         # a restarting daemon must not pay the ~80s 100k-node compile
         # again — load executables from the persistent cache
@@ -962,6 +1107,15 @@ class TpuSpfSolver:
         # chunks off the same value)
         self.fuse_small_areas = fuse_small_areas
         self.fuse_n_cap = int(fuse_n_cap)
+        # incremental SSSP: seed single-area dispatches from the
+        # previous resident distance plane and re-relax only the
+        # affected cone of the drained dirty edges (ops/incremental.py).
+        # Bit-identical to the full solve; falls back automatically on
+        # first solve, shape/root churn, journal gaps, zero-weight
+        # edges, or when the cone exceeds incremental_cone_frac of the
+        # fabric's node-lanes (decided on device, same dispatch).
+        self.incremental_spf = bool(incremental_spf)
+        self.incremental_cone_frac = float(incremental_cone_frac)
         self.cpu = SpfSolver(my_node_name, **solver_kwargs)
         # UCMP weight resolution runs on device through the oracle's
         # resolver hook (falls back to the host walk when stale)
@@ -1000,6 +1154,10 @@ class TpuSpfSolver:
         # (jitted pipeline, device args, prev outputs) of the last fast
         # solve, for device-only throughput probes
         self._last_exec = None
+        # (jitted incr pipeline, device args, prev outputs, prev dist,
+        # dirty tail) of the last incremental solve — the
+        # incr_device_compute_ms probe (bench incr_device_ms)
+        self._last_exec_incr = None
         # single worker that runs each area's blocking result pull +
         # columnar scatter while the main thread dispatches the next
         # area and walks the host slow path (created lazily; one worker
@@ -1033,6 +1191,9 @@ class TpuSpfSolver:
                     yield arr
         for vs in self._vstates.values():
             yield from (getattr(vs, "prev", None) or ())
+            pd = getattr(vs, "prev_dist", None)
+            if pd is not None:
+                yield pd
 
     def _pool(self):
         if self._mat_pool is None:
@@ -1249,11 +1410,17 @@ class TpuSpfSolver:
         views = []
         stages = {"sync_ms": 0.0, "exec_ms": 0.0, "mat_ms": 0.0}
         area_timing: dict[str, dict] = {}
+        incremental = False
         for area, fut in pending.futures:
             res = fut.result()
             views.append(res["view"])
             stats = res["stats"]
-            self.last_trips = stats["trips"]
+            if stats.get("incremental"):
+                # a warm re-relax converges in a trip or two — not a
+                # diameter bound the sharded fabric path may reuse
+                incremental = True
+            else:
+                self.last_trips = stats["trips"]
             self.last_device_stats = stats
             for k, v in res["timing"].items():
                 stages[k] = stages.get(k, 0.0) + v
@@ -1287,6 +1454,7 @@ class TpuSpfSolver:
             "pipeline_stages_ms": sum(stages.values()),
             "areas": area_timing,
             "bytes_uploaded": float(pending.bytes_uploaded),
+            "incremental": incremental,
             **pending.ksp2_timing,
         }
         return route_db
@@ -1565,8 +1733,9 @@ class TpuSpfSolver:
         donate = self._donation_on()
         if donate:
             # the donated input may be referenced by the last-exec probe
-            # tuple; that handle dies with the donation
+            # tuples; those handles die with the donation
             self._last_exec = None
+            self._last_exec_incr = None
         return _scatter_jit(donate)(d_arr, idx, vals)
 
     def _diff_scatter(self, d_arr, old_np, new_np, extra_idx=None):
@@ -1619,10 +1788,11 @@ class TpuSpfSolver:
                 n_cap_o = old_plan.n_cap
                 kr_o = old_plan.res_nbr.shape[1]
                 sd = [
-                    k * n_cap_o + u for k, u, _ in old_plan.dirty_shift
+                    k * n_cap_o + u
+                    for k, u, _, _ in old_plan.dirty_shift
                 ]
                 rd = [
-                    r * kr_o + c for r, c, _ in old_plan.dirty_res
+                    r * kr_o + c for r, c, _, _ in old_plan.dirty_res
                 ]
                 ad.d_deltas = self._diff_scatter(
                     ad.d_deltas, old_plan.deltas, plan.deltas
@@ -1655,13 +1825,19 @@ class TpuSpfSolver:
             plan.dirty_shift = []
             plan.dirty_res = []
             plan.dirty_res_nbr = False
+            # mirror content changed without per-slot old values — any
+            # resident distance plane from before this epoch cannot be
+            # incrementally advanced across it
+            ad.drain_epoch += 1
+            ad.drain_log.append((ad.drain_epoch, None, None))
             # first churn after a cold build must not pay the edge
             # locator build inside its convergence window
             from openr_tpu.ops.edgeplan import prewarm_edge_loc
 
             prewarm_edge_loc(plan)
         else:
-            (s_idx, s_val), (r_idx, r_val), nbr_changed = drain_dirty(plan)
+            ((s_idx, s_val, s_old), (r_idx, r_val, r_old),
+             nbr_changed) = drain_dirty(plan)
             if s_idx is not None:
                 ad.d_shift_w = self._scatter_counted(
                     ad.d_shift_w, s_idx, s_val
@@ -1670,9 +1846,23 @@ class TpuSpfSolver:
                 ad.d_res_w = self._scatter_counted(
                     ad.d_res_w, r_idx, r_val
                 )
+            ad.drain_epoch += 1
             if nbr_changed:
                 ad.d_res_rows = self._put_counted(plan.res_rows)
                 ad.d_res_nbr = self._put_counted(plan.res_nbr)
+                # residual slot layout changed: journal old values no
+                # longer name stable (row, col) edges — reset marker
+                ad.drain_log.append((ad.drain_epoch, None, None))
+            else:
+                s_map = (
+                    {} if s_idx is None
+                    else dict(zip(s_idx.tolist(), s_old.tolist()))
+                )
+                r_map = (
+                    {} if r_idx is None
+                    else dict(zip(r_idx.tolist(), r_old.tolist()))
+                )
+                ad.drain_log.append((ad.drain_epoch, s_map, r_map))
 
         # announcer matrix: keyed on prefix churn + node-index stability
         mkey = (prefix_state.generation, plan.index_version)
@@ -1801,6 +1991,49 @@ class TpuSpfSolver:
             )
             vs.links_tuple = links_tuple
             vs.valid = False
+            vs.prev_dist = None
+            vs.dist_epoch = -1
+            vs.root_sig = None
+
+        # incremental eligibility: a resident distance plane whose
+        # epoch window is covered by the drain journal, an unchanged
+        # root out-link signature, and no zero-weight edges (equal-
+        # distance parent cycles break subtree invalidation). Any
+        # failed gate simply dispatches the full pipeline.
+        root_sig = (root_nbr.tobytes(), (root_w < INF_E).tobytes())
+        incr = None
+        if (
+            self.incremental_spf
+            and vs.valid
+            and vs.prev_dist is not None
+            and vs.root_sig == root_sig
+            and not plan.has_zero_w
+        ):
+            merged = _merge_drain_log(ad, vs.dist_epoch)
+            if merged is not None:
+                s_map, r_map = merged
+                cap = _dirty_bucket(max(len(s_map), len(r_map), 1))
+                if cap is not None:
+                    s_pad = plan.s_cap * plan.n_cap  # OOB -> dropped
+                    r_pad = r_cap * kr_cap
+                    sd_idx = np.full(cap, s_pad, np.int32)
+                    sd_old = np.zeros(cap, np.int32)
+                    sd_idx[:len(s_map)] = list(s_map.keys())
+                    sd_old[:len(s_map)] = list(s_map.values())
+                    rd_idx = np.full(cap, r_pad, np.int32)
+                    rd_old = np.zeros(cap, np.int32)
+                    rd_idx[:len(r_map)] = list(r_map.keys())
+                    rd_old[:len(r_map)] = list(r_map.values())
+                    denom = d_cap * plan.n_nodes
+                    incr = {
+                        "cap": cap,
+                        "sd_idx": sd_idx, "sd_old": sd_old,
+                        "rd_idx": rd_idx, "rd_old": rd_old,
+                        "cone_limit": np.int32(
+                            self.incremental_cone_frac * denom
+                        ),
+                        "denom": denom,
+                    }
 
         t1 = _time.perf_counter()
         return {
@@ -1810,6 +2043,8 @@ class TpuSpfSolver:
             "fuse_key": (shape_key, lfa, block_v4),
             "vs": vs, "lfa": lfa, "block_v4": block_v4,
             "d_cap": d_cap, "p_cap": p_cap, "a_cap": a_cap,
+            "incr": incr, "root_sig": root_sig,
+            "dist_epoch": ad.drain_epoch,
             "t0": t0, "t1": t1,
         }
 
@@ -1825,19 +2060,54 @@ class TpuSpfSolver:
 
     def _dispatch_one(self, pv: dict):
         """Dispatch one area's pipeline and start the async result copy;
-        returns the prepare() closure for the materialization worker."""
+        returns the prepare() closure for the materialization worker.
+        With incremental_spf on, an eligible vantage dispatches the
+        incr-namespace kernel seeded from its resident distance plane;
+        either way the distance plane is emitted and kept resident as
+        the next solve's seed."""
+        emit = self.incremental_spf
+        incr = pv.get("incr")
+        if incr is not None:
+            kernel_name, run = _instrumented_incr(
+                *pv["shape_key"], _DELTA_BUDGET, incr["cap"],
+                pv["lfa"], pv["block_v4"], self.enable_sentinels,
+            )
+            args = self._lane_args(pv) + (
+                pv["vs"].prev_dist,
+                incr["sd_idx"], incr["sd_old"],
+                incr["rd_idx"], incr["rd_old"], incr["cone_limit"],
+            )
+            delta_buf, full_buf, *new_prev = run(*args)
+            # resident incremental state for the device-only probe
+            # (bench.py incr_device_ms): prev outputs chain through
+            # o[2:7], the distance plane through o[7], the dirty tail
+            # re-applies verbatim
+            self._last_exec_incr = (
+                run, args[:9], tuple(new_prev[:5]), new_prev[5],
+                args[15:],
+            )
+            return self._make_prepare(
+                pv, kernel_name, delta_buf, full_buf, new_prev,
+                emit=True, incr=True,
+            )
         kernel_name, run = _instrumented_pipeline(
             *pv["shape_key"], _DELTA_BUDGET, pv["lfa"], pv["block_v4"],
-            self.enable_sentinels,
+            self.enable_sentinels, emit,
         )
         args = self._lane_args(pv)
         delta_buf, full_buf, *new_prev = run(*args)
+        counters.increment("decision.solver.full.solves")
+        if self.incremental_spf:
+            # full dispatch while incremental is on: first / ineligible
+            # solve or a host-gate fallback (journal gap, root churn,
+            # zero-weight edges, oversized dirty set)
+            counters.increment("decision.solver.incr.full_fallbacks")
         # resident pipeline state for device-only throughput probes
         # (bench.py device_compute_ms): re-invokable with outputs fed
         # forward as the next prev
-        self._last_exec = (run, args[:9], tuple(new_prev))
+        self._last_exec = (run, args[:9], tuple(new_prev[:5]))
         return self._make_prepare(
-            pv, kernel_name, delta_buf, full_buf, new_prev
+            pv, kernel_name, delta_buf, full_buf, new_prev, emit=emit
         )
 
     def _dispatch_fused(self, group: list[dict]) -> list[tuple]:
@@ -1858,6 +2128,7 @@ class TpuSpfSolver:
         outs = run(*area_args)
         counters.increment("decision.device.fused_dispatches")
         counters.increment("decision.device.fused_areas", g)
+        counters.increment("decision.solver.full.solves", g)
         result = []
         for pv, out in zip(group, outs):
             delta_buf, full_buf, *new_prev = out
@@ -1867,7 +2138,8 @@ class TpuSpfSolver:
         return result
 
     def _make_prepare(self, pv: dict, kernel_name: str, delta_buf,
-                      full_buf, new_prev, fused: int = 0):
+                      full_buf, new_prev, fused: int = 0,
+                      emit: bool = False, incr: bool = False):
         """Start the async device->host copy of the buffer the solve
         will consume and build the prepare() closure that patches the
         vantage's columnar RIB on the materialization worker.
@@ -1881,6 +2153,7 @@ class TpuSpfSolver:
         d_cap, p_cap, a_cap = pv["d_cap"], pv["p_cap"], pv["a_cap"]
         t0, t1 = pv["t0"], pv["t1"]
         was_valid = vs.valid
+        incr_denom = (pv.get("incr") or {}).get("denom", 1)
         # start the device->host copy of the buffer we will consume; it
         # flies while the caller does unrelated host work
         (delta_buf if was_valid else full_buf).copy_to_host_async()
@@ -1891,7 +2164,14 @@ class TpuSpfSolver:
             # work raises before collection, the next solve still
             # compares against the outputs last applied, so the aborted
             # solve's changed rows are not silently treated as applied
-            vs.prev = tuple(new_prev)
+            vs.prev = tuple(new_prev[:5])
+            if emit:
+                # the emitted distance plane becomes the next solve's
+                # warm seed, stamped with the drain epoch and root
+                # signature it was computed under
+                vs.prev_dist = new_prev[5]
+                vs.dist_epoch = pv["dist_epoch"]
+                vs.root_sig = pv["root_sig"]
             wa = -(-a_cap // 16)
             wd = -(-d_cap // 16)
             b = _DELTA_BUDGET
@@ -1951,14 +2231,37 @@ class TpuSpfSolver:
                     None if lfa_slot is None else lfa_slot[live][:count],
                     None if lfa_metric is None else lfa_metric[live][:count],
                 )
-            if sentinels:
+            if sentinels or incr:
                 # the sentinel scalars ride the tail of whichever
                 # buffer this solve pulled (appended last in
-                # _plan_pipeline, after the lfa columns)
+                # _plan_pipeline, after the lfa columns); the
+                # incremental kernel appends [cone, fell_back] after
+                # them, shifting the sentinels to [-4]/[-3]
                 sbuf = fbuf if full_pull else dbuf
+            if incr:
+                cone = int(sbuf[-2])
+                fell_back = bool(sbuf[-1])
+                stats["incremental"] = True
+                stats["cone"] = cone
+                stats["fell_back"] = fell_back
+                if fell_back:
+                    counters.increment(
+                        "decision.solver.incr.full_fallbacks"
+                    )
+                else:
+                    counters.increment("decision.solver.incr.solves")
+                counters.add_stat_value(
+                    "decision.solver.incr.cone_frac",
+                    cone / max(incr_denom, 1),
+                )
+                counters.add_stat_value(
+                    "decision.solver.incr.changed_rows", count or 0
+                )
+            if sentinels:
+                off = -2 if incr else 0
                 stats["sentinels"] = {
-                    "unreachable_rows": int(sbuf[-2]),
-                    "saturated_rows": int(sbuf[-1]),
+                    "unreachable_rows": int(sbuf[off - 2]),
+                    "saturated_rows": int(sbuf[off - 1]),
                 }
             stats["trips"] = trips
             t3 = _time.perf_counter()
@@ -2258,7 +2561,33 @@ class TpuSpfSolver:
         t0 = _time.perf_counter()
         o = out
         for _ in range(iters):
-            o = run(*dev_args, *o[2:])
+            # outputs 2..6 are the 5 resident prev_* arrays (slot 7,
+            # when present, is the emitted distance plane)
+            o = run(*dev_args, *o[2:7])
+        jax.block_until_ready(o)
+        return (_time.perf_counter() - t0) * 1e3 / iters
+
+    def incr_device_compute_ms(self, iters: int = 8) -> Optional[float]:
+        """Amortized device-only time per INCREMENTAL pipeline
+        execution — the incremental analogue of device_compute_ms.
+        Chains the last incremental dispatch with its own dirty tail
+        re-applied each iteration: prev outputs feed through o[2:7],
+        the emitted distance plane through o[7], so every link in the
+        chain pays the full parent-plane + cone + warm-re-relax cost
+        (bench.py incr_device_ms)."""
+        import time as _time
+
+        import jax
+
+        if self._last_exec_incr is None:
+            return None
+        run, dev_args, prev, prev_dist, tail = self._last_exec_incr
+        out = run(*dev_args, *prev, prev_dist, *tail)
+        jax.block_until_ready(out)
+        t0 = _time.perf_counter()
+        o = out
+        for _ in range(iters):
+            o = run(*dev_args, *o[2:7], o[7], *tail)
         jax.block_until_ready(o)
         return (_time.perf_counter() - t0) * 1e3 / iters
 

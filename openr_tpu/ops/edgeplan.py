@@ -118,10 +118,19 @@ class EdgePlan:
     # delta-update state
     synced_generation: int = -1
     needs_rebuild: bool = False
-    # dirty entries since last device sync: lists of flat indices/values
-    dirty_shift: list = field(default_factory=list)  # (k, u, w)
-    dirty_res: list = field(default_factory=list)  # (v, col, w)
+    # dirty entries since last device sync. Each entry carries the
+    # PRE-WRITE value alongside the new one so consumers that need the
+    # previous device plane (the incremental SSSP seed path) can
+    # reconstruct it from the new plane + these old values, without a
+    # second resident copy.
+    dirty_shift: list = field(default_factory=list)  # (k, u, w, old_w)
+    dirty_res: list = field(default_factory=list)  # (row, col, w, old_w)
     dirty_res_nbr: bool = False  # residual nbr indices changed (new slots)
+    # sticky flag: a zero-weight live edge existed at build time or was
+    # written since. Zero-weight edges allow equal-distance parent
+    # cycles, which break the incremental solver's tree-descendant
+    # invalidation — consumers fall back to the full solve while set.
+    has_zero_w: bool = False
     # bumped when node index mapping changes (matrix cache key)
     index_version: int = 0
 
@@ -349,6 +358,7 @@ def build_plan(
         node_overloaded=node_over,
         node_names=names,
         node_index=index,
+        has_zero_w=bool(m) and bool((w == 0).any()),
         edge_loc=None,
         _links_sorted=links_sorted,
         _loc_kind=loc_kind,
@@ -368,16 +378,20 @@ def _set_edge_w(plan: EdgePlan, link: Link, src_name: str, w: int) -> None:
     if loc is None:
         plan.needs_rebuild = True
         return
+    if w == 0:
+        plan.has_zero_w = True
     if loc[0] == "s":
         _, k, u = loc
-        if plan.shift_w[k, u] != w:
+        old = int(plan.shift_w[k, u])
+        if old != w:
             plan.shift_w[k, u] = w
-            plan.dirty_shift.append((k, u, w))
+            plan.dirty_shift.append((k, u, w, old))
     else:
         _, row, col = loc
-        if plan.res_w[row, col] != w:
+        old = int(plan.res_w[row, col])
+        if old != w:
             plan.res_w[row, col] = w
-            plan.dirty_res.append((row, col, w))
+            plan.dirty_res.append((row, col, w, old))
 
 
 def _refresh_link(plan: EdgePlan, link: Link) -> None:
@@ -438,9 +452,12 @@ def _add_link(plan: EdgePlan, link: Link) -> None:
         plan._res_fill[row] = col + 1
         plan.res_nbr[row, col] = u
         plan.res_w[row, col] = w
+        if w == 0:
+            plan.has_zero_w = True
         plan.k_res = max(plan.k_res, col + 1)
         plan.edge_loc.setdefault(link, [None, None])[idx] = ("r", row, col)
-        plan.dirty_res.append((row, col, w))
+        # a fresh slot's pre-write value is the INF pad
+        plan.dirty_res.append((row, col, w, int(INF32E)))
         # res_nbr/res_rows changed too — consumer re-uploads those arrays
         plan.dirty_res_nbr = True
 
@@ -492,31 +509,48 @@ def apply_events(
     return True
 
 
+def _consolidate(entries: list, stride: int):
+    """(a, b, new, old) entries -> unique flat indices in first-seen
+    order, keeping the FIRST old and the LAST new per slot. A slot
+    dirtied twice between drains (flap down then up) must scatter its
+    final value — duplicate indices in one XLA scatter have unspecified
+    winner — and its old value must be the true pre-drain device value."""
+    merged: dict[int, list] = {}
+    for a, b, w, old in entries:
+        f = a * stride + b
+        hit = merged.get(f)
+        if hit is None:
+            merged[f] = [w, old]
+        else:
+            hit[0] = w
+    idx = np.fromiter(merged.keys(), np.int32, len(merged))
+    val = np.fromiter((v[0] for v in merged.values()), np.int32, len(merged))
+    old = np.fromiter((v[1] for v in merged.values()), np.int32, len(merged))
+    return idx, val, old
+
+
 def drain_dirty(plan: EdgePlan):
-    """Consume pending scatter updates: ((shift_flat_idx, shift_vals),
-    (res_flat_idx, res_vals), res_nbr_changed). Flat indices index the
-    raveled [s_cap, n_cap] / [n_cap, k_res_cap] device arrays."""
-    n_cap = plan.n_cap
-    kr = plan.res_nbr.shape[1]
+    """Consume pending scatter updates: ((shift_flat_idx, shift_vals,
+    shift_olds), (res_flat_idx, res_vals, res_olds), res_nbr_changed).
+    Flat indices index the raveled [s_cap, n_cap] / [r_cap, k_res_cap]
+    device arrays; indices are de-duplicated (last new value wins) and
+    the old arrays carry each slot's pre-drain value so the incremental
+    SSSP kernel can rebuild the previous weight plane on device."""
     if plan.dirty_shift:
-        s_idx = np.array(
-            [k * n_cap + u for k, u, _ in plan.dirty_shift], np.int32
-        )
-        s_val = np.array([w for _, _, w in plan.dirty_shift], np.int32)
+        s_idx, s_val, s_old = _consolidate(plan.dirty_shift, plan.n_cap)
     else:
-        s_idx = s_val = None
+        s_idx = s_val = s_old = None
     if plan.dirty_res:
-        r_idx = np.array(
-            [row * kr + c for row, c, _ in plan.dirty_res], np.int32
+        r_idx, r_val, r_old = _consolidate(
+            plan.dirty_res, plan.res_nbr.shape[1]
         )
-        r_val = np.array([w for _, _, w in plan.dirty_res], np.int32)
     else:
-        r_idx = r_val = None
+        r_idx = r_val = r_old = None
     nbr_changed = plan.dirty_res_nbr
     plan.dirty_shift = []
     plan.dirty_res = []
     plan.dirty_res_nbr = False
-    return (s_idx, s_val), (r_idx, r_val), nbr_changed
+    return (s_idx, s_val, s_old), (r_idx, r_val, r_old), nbr_changed
 
 
 def sync_plan(

@@ -134,9 +134,13 @@ def bounded_jit_cache(max_buckets: int = 8, namespace: str = ""):
     use namespace="whatif" so a burst of interactive sweep shapes
     churns only its own LRU and can never evict a live-solve
     executable — and the counter split shows which workload is
-    compiling. The namespace is also folded into the bucket signature,
-    so two namespaces can never alias a capacity bucket even if they
-    were ever pointed at a shared table.
+    compiling. The incremental-SSSP factories (tpu_solver
+    _incr_pipeline/_instrumented_incr) likewise use namespace="incr":
+    dirty-set cap churn buckets under xla_cache.incr_* and cannot
+    evict the full-solve or sweep executables. The namespace is also
+    folded into the bucket signature, so two namespaces can never
+    alias a capacity bucket even if they were ever pointed at a
+    shared table.
 
     Hashable positional keys only — same contract the lru_cache sites
     already honor. Exposes `cache_clear()` for tests."""

@@ -55,6 +55,45 @@ def test_bench_config_smoke_device_path():
     xc = res["xla_cache"]
     assert xc["factory_hits"] > 0, xc
     assert xc["executable_evictions"] == 0, xc
+    # ISSUE 7: the incremental churn lane must engage the seed-from-
+    # previous path on a plain metric-flap sequence (no fallbacks) and
+    # must not churn the incr executable namespace
+    assert res["incr_runs"] == 2, res
+    assert res["incr_engaged"] == res["incr_runs"], res
+    assert res["incr_changed_rows"] >= 0, res
+    assert "incr_tpu_ms" in res, res
+    ixc = res["incr_xla_cache"]
+    assert ixc["incr_executable_evictions"] == 0, ixc
+
+
+def test_bench_incremental_lane_single_flap_counters():
+    """ISSUE 7 tier-1 smoke: a single-metric-flap churn sequence takes
+    the incremental path (decision.solver.incr.solves advances) with
+    zero incr-namespace executable evictions."""
+    from bench import bench_config
+    from openr_tpu.models import topologies
+    from openr_tpu.runtime.counters import counters
+
+    s0 = int(counters.get_counter("decision.solver.incr.solves") or 0)
+    e0 = int(
+        counters.get_counter("xla_cache.incr_executable_evictions") or 0
+    )
+    res, _, _ = bench_config(
+        "smoke-incr",
+        lambda: topologies.grid(6, node_labels=False),
+        "node-3-3",
+        runs=3,
+        flap_victims=1,
+    )
+    s1 = int(counters.get_counter("decision.solver.incr.solves") or 0)
+    e1 = int(
+        counters.get_counter("xla_cache.incr_executable_evictions") or 0
+    )
+    assert s1 - s0 >= res["incr_engaged"] >= 1, (s0, s1, res)
+    assert e1 - e0 == 0, (e0, e1)
+    # changed_rows is reported uniformly (0 or actual, never null)
+    assert isinstance(res["changed_rows"], int), res
+    assert isinstance(res["incr_changed_rows"], int), res
 
 
 def test_bench_config_small_graph_delegation_still_reports():
@@ -72,3 +111,6 @@ def test_bench_config_small_graph_delegation_still_reports():
     )
     assert tpu_ms > 0 and res["full_ms"] > 0
     assert "tpu_ms" in res
+    # ISSUE 7 satellite: changed_rows reports 0 (not null) on delegated
+    # small configs, uniform with the device-path configs
+    assert res["changed_rows"] == 0, res

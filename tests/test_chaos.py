@@ -171,6 +171,125 @@ class TestKillTheTpuDrill:
             await stop_all(nodes)
 
 
+class TestIncrementalSolverFailoverDrill:
+    @run_async
+    async def test_fault_during_incremental_solve_fails_over(self):
+        """ISSUE 7 drill: a warm solver on the incremental (seed-from-
+        previous) path takes an armed solver.exec fault mid-churn. The
+        failover must carry the event to the CPU oracle with NO stale-
+        route window — the fib lands on the post-churn next-hop set —
+        and after the device heals, churn re-engages the incremental
+        path. Engagement is driven by pumping prefix events (the
+        wrapper's own adjacency re-origination makes any single
+        topology event race the root-signature gate)."""
+        registry.clear()
+        counters.set_counter("decision.solver.degraded", 0)
+        # 4-node ring: node-0 reaches node-2 via ECMP {node-1, node-3},
+        # and the 1<->2 edge is NOT one of node-0's root links, so its
+        # churn is exactly the incremental path's home turf
+        names = [f"node-{i}" for i in range(4)]
+        links = [
+            ("node-0", "if-01", "node-1", "if-10"),
+            ("node-1", "if-12", "node-2", "if-21"),
+            ("node-2", "if-23", "node-3", "if-32"),
+            ("node-3", "if-30", "node-0", "if-03"),
+        ]
+        mesh, nodes = await start_mesh(
+            names,
+            links,
+            solver_backend="tpu",
+            decision_config=DecisionConfig(
+                debounce_min_ms=5,
+                debounce_max_ms=25,
+                incremental_spf=True,
+                solver_probe_initial_backoff_s=0.2,
+                solver_probe_max_backoff_s=0.5,
+            ),
+        )
+        try:
+            for i, n in enumerate(names):
+                nodes[n].advertise_prefix(loopback(i))
+
+            def nh_set(pfx):
+                entry = nodes["node-0"].fib_routes.get(pfx)
+                if entry is None:
+                    return set()
+                return {nh.neighbor_node_name for nh in entry.nexthops}
+
+            await wait_until(
+                lambda: nh_set(loopback(2)) == {"node-1", "node-3"},
+                timeout_s=CONVERGENCE_S,
+            )
+
+            async def pump_incremental(tag):
+                """Flap the (non-root-for-node-0) 1<->2 link until an
+                incremental solve lands; leaves the link connected.
+                Each half waits for fib convergence, so a pass also
+                proves the warm path kept routing correct."""
+                incr0 = _counter("decision.solver.incr.solves")
+                for _ in range(10):
+                    mesh.disconnect(
+                        "node-1", "if-12", "node-2", "if-21"
+                    )
+                    await wait_until(
+                        lambda: nh_set(loopback(2)) == {"node-3"},
+                        timeout_s=CONVERGENCE_S,
+                    )
+                    mesh.connect("node-1", "if-12", "node-2", "if-21")
+                    await wait_until(
+                        lambda: nh_set(loopback(2))
+                        == {"node-1", "node-3"},
+                        timeout_s=CONVERGENCE_S,
+                    )
+                    if (
+                        _counter("decision.solver.incr.solves") > incr0
+                    ):
+                        return
+                raise AssertionError(
+                    f"incremental path never engaged ({tag})"
+                )
+
+            # healthy churn first: the warm solvers must take the
+            # seed-from-previous path
+            await pump_incremental(0)
+
+            # topology churn away from node-0's root links
+            mesh.disconnect("node-1", "if-12", "node-2", "if-21")
+            await wait_until(
+                lambda: nh_set(loopback(2)) == {"node-3"},
+                timeout_s=CONVERGENCE_S,
+            )
+
+            # the device dies; the link comes back. The solve for this
+            # event would be incremental — the armed fault must push it
+            # to the CPU oracle, which lands the restored ECMP set
+            # directly (no window serving the stale single-path route)
+            failovers0 = _counter("decision.solver.failovers")
+            promotions0 = _counter("decision.solver.promotions")
+            registry.arm("solver.exec")
+            mesh.connect("node-1", "if-12", "node-2", "if-21")
+            await wait_until(
+                lambda: nh_set(loopback(2)) == {"node-1", "node-3"}
+                and _counter("decision.solver.degraded") == 1,
+                timeout_s=CONVERGENCE_S,
+            )
+            assert _counter("decision.solver.failovers") > failovers0
+
+            # heal: probes promote the device back, and the next churn
+            # runs incremental again off a freshly seeded plane
+            registry.clear("solver.exec")
+            await wait_until(
+                lambda: _counter("decision.solver.degraded") == 0
+                and _counter("decision.solver.promotions") > promotions0,
+                timeout_s=CONVERGENCE_S,
+            )
+            await pump_incremental(1)
+        finally:
+            registry.clear()
+            counters.set_counter("decision.solver.degraded", 0)
+            await stop_all(nodes)
+
+
 class TestDecisionFiberCrashDrill:
     @run_async
     async def test_supervisor_restarts_crashed_ingest_fiber(self):

@@ -1,0 +1,325 @@
+"""Incremental device SSSP (ISSUE 7) — parity + fallback drills.
+
+The incremental path (ops/incremental.py, tpu_solver._incr_pipeline)
+seeds each solve from the previous device-resident distance plane,
+re-anchors the subtree behind any metric increase, and re-relaxes only
+the affected cone. Its one promise is EXACT parity with a cold full
+solve — same int32 fixpoint, same ECMP/LFA/UCMP planes — so every test
+here compares three solvers on every churn step:
+
+  cpu   the SpfSolver oracle (reference semantics)
+  full  TpuSpfSolver with incremental_spf=False (cold path)
+  incr  TpuSpfSolver with incremental_spf=True  (warm path)
+
+and additionally asserts the warm RIB is identical to the cold RIB.
+Fallback ladders (in-kernel cone fraction, host gates: zero-weight
+edges, dirty-set overflow) are driven explicitly and checked against
+the decision.solver.incr.* counter split.
+"""
+
+import numpy as np
+
+from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.decision.tpu_solver import TpuSpfSolver
+from openr_tpu.models import topologies
+from openr_tpu.runtime.counters import counters
+from openr_tpu.types import Adjacency, AdjacencyDatabase
+from tests.test_tpu_solver import assert_rib_equal
+
+ME = "node-2-2"
+
+
+def _cnt(key):
+    return int(counters.get_counter(key) or 0)
+
+
+def _grid():
+    adj_dbs, prefix_dbs = topologies.grid(5, node_labels=False)
+    states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    return adj_dbs, states, ps
+
+
+def _rebuild(db, adjs, area="0"):
+    return AdjacencyDatabase(
+        this_node_name=db.this_node_name,
+        adjacencies=tuple(adjs),
+        node_label=db.node_label,
+        area=area,
+    )
+
+
+class _Churn:
+    """Symmetric churn driver over a live LinkState: metric changes and
+    link down/up applied to BOTH directions of an edge, through the
+    real update path (changelog -> device scatter)."""
+
+    def __init__(self, adj_dbs, states, area="0"):
+        self.area = area
+        self.states = states
+        self.dbs = {db.this_node_name: db for db in adj_dbs}
+
+    def _put(self, db):
+        self.dbs[db.this_node_name] = db
+        self.states[self.area].update_adjacency_database(db)
+
+    def set_metric(self, u, v, metric):
+        for a_name, b_name in ((u, v), (v, u)):
+            db = self.dbs[a_name]
+            adjs = [
+                Adjacency(**{**a.__dict__, "metric": metric})
+                if a.other_node_name == b_name else a
+                for a in db.adjacencies
+            ]
+            self._put(_rebuild(db, adjs, self.area))
+
+    def link_down(self, u, v):
+        for a_name, b_name in ((u, v), (v, u)):
+            db = self.dbs[a_name]
+            adjs = [
+                a for a in db.adjacencies if a.other_node_name != b_name
+            ]
+            self._put(_rebuild(db, adjs, self.area))
+
+    def link_up(self, u, v, saved_u, saved_v):
+        self._put(saved_u)
+        self._put(saved_v)
+
+    def edges(self):
+        out = []
+        for name, db in sorted(self.dbs.items()):
+            for a in db.adjacencies:
+                if name < a.other_node_name:
+                    out.append((name, a.other_node_name))
+        return out
+
+
+def _trio(states, ps, **incr_kw):
+    cpu = SpfSolver(ME)
+    full = TpuSpfSolver(ME, incremental_spf=False)
+    incr = TpuSpfSolver(ME, incremental_spf=True, **incr_kw)
+
+    def solve(ctx):
+        cpu_db = cpu.build_route_db(ME, states, ps)
+        full_db = full.build_route_db(ME, states, ps)
+        incr_db = incr.build_route_db(ME, states, ps)
+        assert_rib_equal(cpu_db, incr_db, f"{ctx}: warm vs oracle")
+        assert_rib_equal(cpu_db, full_db, f"{ctx}: cold vs oracle")
+        # bit-identical promise: warm output == cold output exactly
+        assert incr_db.unicast_routes == full_db.unicast_routes, ctx
+        assert incr_db.mpls_routes == full_db.mpls_routes, ctx
+        return incr.last_device_stats
+
+    return solve, incr
+
+
+def test_randomized_churn_property_parity():
+    """Randomized metric inc/dec + link down/up sequence: the warm path
+    must match the oracle AND the cold device path exactly on every
+    step, whichever lane (incremental or fallback) each step takes."""
+    adj_dbs, states, ps = _grid()
+    churn = _Churn(adj_dbs, states)
+    solve, incr = _trio(states, ps)
+    solve("round0")  # first solve: full (no previous plane)
+
+    rng = np.random.default_rng(7)
+    metrics = (1, 3, 50, 100000)
+    edges = churn.edges()
+    engaged = 0
+    down = None  # at most one link down at a time
+    for i in range(10):
+        if down is not None and rng.integers(3) == 0:
+            u, v, su, sv = down
+            churn.link_up(u, v, su, sv)
+            ctx = f"round{i + 1}: up {u}<->{v}"
+            down = None
+        elif down is None and rng.integers(4) == 0:
+            while True:
+                u, v = edges[rng.integers(len(edges))]
+                # never isolate the vantage: keep ME's links intact so
+                # the lane stays on the incremental-eligible shape
+                if ME not in (u, v):
+                    break
+            down = (u, v, churn.dbs[u], churn.dbs[v])
+            churn.link_down(u, v)
+            ctx = f"round{i + 1}: down {u}<->{v}"
+        else:
+            u, v = edges[rng.integers(len(edges))]
+            m = int(metrics[rng.integers(len(metrics))])
+            churn.set_metric(u, v, m)
+            ctx = f"round{i + 1}: metric {u}<->{v}={m}"
+        st = solve(ctx)
+        if st.get("incremental"):
+            engaged += 1
+    # the sequence must actually exercise the warm path, not fall back
+    # on every round (root-link churn legitimately falls back)
+    assert engaged >= 5, engaged
+
+
+def test_metric_increase_reanchors_subtree():
+    """Deterministic metric-increase drill: raising a victim node's
+    link metrics invalidates the subtree hanging off its parent edges
+    (cone > 0) and still reproduces the cold solve exactly."""
+    adj_dbs, states, ps = _grid()
+    churn = _Churn(adj_dbs, states)
+    solve, incr = _trio(states, ps)
+    solve("cold")
+
+    victim = adj_dbs[1].this_node_name
+    nbrs = [a.other_node_name for a in churn.dbs[victim].adjacencies]
+    for nb in nbrs:
+        churn.set_metric(victim, nb, 50)  # 1 -> 50: pure increase
+    st = solve("increase-50")
+    assert st.get("incremental") is True, st
+    assert not st.get("fell_back"), st
+    # the victim's parent edge is in the flapped set, so its subtree
+    # re-anchors: a non-empty cone, then exact re-relaxation
+    assert st.get("cone", 0) > 0, st
+    for nb in nbrs:
+        churn.set_metric(victim, nb, 100000)  # 50 -> 100000
+    st = solve("increase-100000")
+    assert st.get("incremental") is True, st
+    assert st.get("cone", 0) > 0, st
+    # decrease back down: prev plane is a pure over-estimate, no cone
+    for nb in nbrs:
+        churn.set_metric(victim, nb, 2)
+    st = solve("decrease-2")
+    assert st.get("incremental") is True, st
+
+
+def test_cone_fraction_fallback_boundary():
+    """incremental_cone_frac=0.0 keeps the incremental kernel but makes
+    ANY non-empty cone exceed the limit: the kernel must select the
+    cold seed plane in-device (fell_back), count a full fallback (not
+    an incremental solve), and still produce the exact RIB."""
+    adj_dbs, states, ps = _grid()
+    churn = _Churn(adj_dbs, states)
+    solve, incr = _trio(states, ps, incremental_cone_frac=0.0)
+    solve("cold")
+
+    victim = adj_dbs[1].this_node_name
+    s0, f0 = (_cnt("decision.solver.incr.solves"),
+              _cnt("decision.solver.incr.full_fallbacks"))
+    for a in churn.dbs[victim].adjacencies:
+        churn.set_metric(victim, a.other_node_name, 60)  # increase
+        break
+    st = solve("frac0-increase")
+    assert st.get("incremental") is True, st
+    assert st.get("cone", 0) > 0, st
+    assert st.get("fell_back") is True, st
+    assert _cnt("decision.solver.incr.full_fallbacks") > f0
+    assert _cnt("decision.solver.incr.solves") == s0
+
+
+def test_zero_weight_edge_gates_to_full():
+    """A zero-metric link makes equal-distance parent cycles possible,
+    defeating subtree invalidation — the plan's sticky has_zero_w flag
+    must force the host full-solve fallback (with the counter split
+    showing it) while parity holds."""
+    adj_dbs, states, ps = _grid()
+    churn = _Churn(adj_dbs, states)
+    solve, incr = _trio(states, ps)
+    solve("cold")
+    churn.set_metric("node-0-0", "node-0-1", 0)
+    s0, f0 = (_cnt("decision.solver.incr.solves"),
+              _cnt("decision.solver.incr.full_fallbacks"))
+    st = solve("zero-weight")
+    assert not st.get("incremental"), st
+    assert _cnt("decision.solver.incr.full_fallbacks") > f0
+    assert _cnt("decision.solver.incr.solves") == s0
+    # the gate is sticky: later non-zero churn still solves full
+    churn.set_metric("node-0-0", "node-0-1", 5)
+    st = solve("after-zero")
+    assert not st.get("incremental"), st
+
+
+def test_dirty_overflow_gates_to_full(monkeypatch):
+    """A churn batch larger than the biggest dirty bucket must take the
+    host full-solve fallback instead of compiling an unbounded-cap
+    incremental executable."""
+    from openr_tpu.decision import tpu_solver as ts
+
+    adj_dbs, states, ps = _grid()
+    churn = _Churn(adj_dbs, states)
+    solve, incr = _trio(states, ps)
+    solve("cold")
+    monkeypatch.setattr(ts, "_DIRTY_BUCKETS", (1,))
+    victim = adj_dbs[1].this_node_name
+    for a in churn.dbs[victim].adjacencies:
+        churn.set_metric(victim, a.other_node_name, 7)
+    f0 = _cnt("decision.solver.incr.full_fallbacks")
+    st = solve("overflow")
+    assert not st.get("incremental"), st
+    assert _cnt("decision.solver.incr.full_fallbacks") > f0
+    # with real buckets restored the next delta re-engages
+    monkeypatch.setattr(ts, "_DIRTY_BUCKETS", (64, 256, 1024, 4096))
+    churn.set_metric(victim, a.other_node_name, 9)
+    st = solve("re-engage")
+    assert st.get("incremental") is True, st
+
+
+def test_incr_namespace_counters_isolated():
+    """The incremental factories compile under the xla_cache "incr"
+    namespace: their hit/miss/eviction counters exist separately and a
+    steady churn evicts nothing."""
+    adj_dbs, states, ps = _grid()
+    churn = _Churn(adj_dbs, states)
+    solve, incr = _trio(states, ps)
+    solve("cold")
+    main0 = _cnt("xla_cache.factory_misses")
+    hits0 = _cnt("xla_cache.incr_factory_hits")
+    for i in range(3):
+        churn.set_metric("node-0-0", "node-0-1", 10 + i)
+        st = solve(f"r{i}")
+        assert st.get("incremental") is True, st
+    assert _cnt("xla_cache.incr_factory_hits") > hits0
+    assert _cnt("xla_cache.incr_executable_evictions") == 0
+    # warm churn compiles nothing new in the main (full-solve) namespace
+    assert _cnt("xla_cache.factory_misses") == main0
+
+
+def test_consolidate_and_drain_journal_units():
+    """drain_dirty consolidation (last-new / first-old per slot) and the
+    drain-journal merge used to bridge a vantage's previous plane over
+    any number of syncs it slept through."""
+    from collections import deque
+    from types import SimpleNamespace
+
+    from openr_tpu.decision.tpu_solver import _merge_drain_log
+    from openr_tpu.ops.edgeplan import _consolidate
+
+    idx, val, old = _consolidate(
+        [(0, 1, 5, 1), (0, 1, 7, 5), (2, 3, 4, 9)], 10
+    )
+    assert idx.tolist() == [1, 23]
+    assert val.tolist() == [7, 4]  # last new wins
+    assert old.tolist() == [1, 9]  # first old wins
+
+    ad = SimpleNamespace(
+        drain_epoch=3,
+        drain_log=deque([(2, {5: 1}, {}), (3, {5: 9, 7: 2}, {1: 4})]),
+    )
+    merged = _merge_drain_log(ad, 1)
+    assert merged == ({5: 1, 7: 2}, {1: 4})  # first old per slot
+    assert _merge_drain_log(ad, 3) == ({}, {})
+    # gap: epoch 1's entry already rotated out of the journal
+    assert _merge_drain_log(ad, 0) is None
+    # reset marker (rebuild / residual-shape change) poisons the window
+    ad.drain_log = deque([(2, None, None), (3, {5: 9}, {})])
+    assert _merge_drain_log(ad, 1) is None
+
+
+def test_incremental_solve_exact_on_link_down_up():
+    """Deterministic link down -> up round trip away from the vantage:
+    both transitions take the warm path and match the cold solve."""
+    adj_dbs, states, ps = _grid()
+    churn = _Churn(adj_dbs, states)
+    solve, incr = _trio(states, ps)
+    solve("cold")
+    u, v = "node-1-1", "node-1-2"
+    su, sv = churn.dbs[u], churn.dbs[v]
+    churn.link_down(u, v)
+    st = solve("down")
+    assert st.get("incremental") is True, st
+    churn.link_up(u, v, su, sv)
+    st = solve("up")
+    assert st.get("incremental") is True, st
