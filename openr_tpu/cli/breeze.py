@@ -169,6 +169,18 @@ def flood_topo(ctx, area) -> None:
     _print(_call(ctx, "ctrl.kvstore.flood_topo", {"area": area}))
 
 
+@kvstore.command("divergence")
+@click.option("--no-resolve", is_flag=True,
+              help="skip pulling suspects' key hashes (digest compare only)")
+@click.pass_context
+def kv_divergence(ctx, no_resolve) -> None:
+    """LSDB divergence check: compare peers' lsdb-digest beacons
+    against our recent local digests; by default each suspect peer is
+    interrogated for the first divergent key."""
+    _print(_call(ctx, "ctrl.kvstore.divergence",
+                 {"resolve": not no_resolve}))
+
+
 @kvstore.command("nodes")
 @click.option("--area", default="0")
 @click.pass_context
@@ -427,6 +439,17 @@ def decision_path(ctx, src, dst, area, k) -> None:
     path)."""
     _print(_call(ctx, "ctrl.decision.path",
                  {"src": src, "dst": dst, "area": area, "k": k}))
+
+
+@decision.command("explain")
+@click.argument("prefix")
+@click.pass_context
+def decision_explain(ctx, prefix) -> None:
+    """Route provenance: which kvstore event (key / originator / area)
+    put this route in the RIB, the solve epoch that materialized it,
+    which solver kind ran (full / incremental / failover-cpu), and
+    whether the Fib agent has it programmed."""
+    _print(_call(ctx, "ctrl.decision.explain", {"prefix": prefix}))
 
 
 @decision.command("validate")
@@ -906,9 +929,21 @@ def monitor() -> None:
 
 @monitor.command()
 @click.option("--prefix", default="")
+@click.option("--json", "as_json", is_flag=True,
+              help="raw JSON instead of the aligned table")
 @click.pass_context
-def counters(ctx, prefix) -> None:
-    _print(_call(ctx, "monitor.counters", {"prefix": prefix}))
+def counters(ctx, prefix, as_json) -> None:
+    """Counter dump: aligned name/value table by default, --json for
+    the raw machine-readable map."""
+    data = _call(ctx, "monitor.counters", {"prefix": prefix})
+    if as_json:
+        _print(data)
+        return
+    width = max((len(k) for k in data), default=0)
+    for key in sorted(data):
+        v = data[key]
+        sv = str(int(v)) if float(v).is_integer() else f"{v:.3f}"
+        click.echo(f"{key:<{width}}  {sv}")
 
 
 @monitor.command("logs")

@@ -68,6 +68,18 @@ class KvstoreConfig:
     # otherwise (an any-address PLAINTEXT peer plane would let any
     # on-path host inject LSDB state). Set explicitly to override.
     listen_addr: str = ""
+    # LSDB divergence beacons (observatory): advertise a TTL'd per-area
+    # digest key monitor:lsdb-digest:<node> every interval and compare
+    # against every peer's beacon — two stores that silently disagree
+    # flip the kvstore.divergence.* gauges within one interval
+    enable_lsdb_digest: bool = True
+    digest_interval_s: float = 15.0
+    # flood-latency probes (opt-in): originate a timestamped synthetic
+    # monitor:flood-probe:<node> key every interval; every RECEIVING
+    # store measures propagation delay into kvstore.flood_rtt_ms, so a
+    # single probing node maps the whole fleet's flood latency
+    enable_flood_probes: bool = False
+    flood_probe_interval_s: float = 5.0
 
 
 @dataclass
@@ -231,6 +243,11 @@ class MonitorConfig:
     # monitor:health:<node> key so `breeze monitor fleet` reads every
     # node from any node
     enable_fleet_health: bool = True
+    # OpenMetrics exposition (runtime/metrics_export.py): serve
+    # GET /metrics from the Monitor's event base. None = disabled;
+    # 0 = bind an ephemeral port (tests read it back from the exporter)
+    metrics_port: Optional[int] = None
+    metrics_listen_addr: str = "127.0.0.1"
 
 
 @dataclass
@@ -579,6 +596,15 @@ class Config:
         kc = cfg.kvstore_config
         if kc.key_ttl_ms <= 0 and kc.key_ttl_ms != -1:
             raise ConfigError("kvstore key_ttl_ms must be positive or -1 (infinite)")
+        if kc.enable_lsdb_digest and kc.digest_interval_s <= 0:
+            raise ConfigError("kvstore digest_interval_s must be positive")
+        if kc.enable_flood_probes and kc.flood_probe_interval_s <= 0:
+            raise ConfigError("kvstore flood_probe_interval_s must be positive")
+        mc = cfg.monitor_config
+        if mc.metrics_port is not None and not (0 <= mc.metrics_port <= 65535):
+            raise ConfigError(
+                f"monitor metrics_port {mc.metrics_port} not in [0, 65535]"
+            )
         sr = cfg.segment_routing_config
         if sr.enable_segment_routing:
             lo, hi = sr.sr_node_label_range

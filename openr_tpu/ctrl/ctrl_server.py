@@ -113,6 +113,7 @@ class CtrlServer(Actor):
             s.register("ctrl.kvstore.areas", self._kv_area_summary)
             s.register("ctrl.kvstore.long_poll_adj", self._kv_long_poll_adj)
             s.register("ctrl.kvstore.flood_topo", self._kv_flood_topo)
+            s.register("ctrl.kvstore.divergence", self._kv_divergence)
         s.register("ctrl.config.dryrun", self._dryrun_config)
         s.register("ctrl.config.get", self._get_config)
         s.register("openr.drain_state", self._drain_state)
@@ -131,6 +132,7 @@ class CtrlServer(Actor):
                 "ctrl.decision.received_routes", self._decision_received
             )
             s.register("ctrl.decision.path", self._decision_path)
+            s.register("ctrl.decision.explain", self._decision_explain)
             if self.kvstore is not None:
                 s.register(
                     "ctrl.decision.validate", self._decision_validate
@@ -696,6 +698,17 @@ class CtrlServer(Actor):
             src or self.node_name, dst, area=area, k=int(k)
         )
 
+    async def _decision_explain(self, prefix: str = "") -> dict:
+        """Route provenance (`breeze decision explain`): the originating
+        kvstore event, solve epoch and solver kind behind one RIB entry,
+        joined with the Fib agent's programmed state for that prefix."""
+        if not prefix:
+            return {"error": "prefix required"}
+        out = await self.decision.explain_route(prefix)
+        if self.fib is not None and "error" not in out:
+            out["fib"] = await self.fib.get_route_detail(out["prefix"])
+        return out
+
     async def _whatif_sweep(
         self, order: int = 1, area: str = "",
         roots: Optional[list] = None, max_scenarios: int = 0,
@@ -1065,6 +1078,13 @@ class CtrlServer(Actor):
             "flood_peers": sorted(spt) if spt is not None else None,
             "roots": st.dual.status(),
         }
+
+    async def _kv_divergence(self, resolve: bool = True) -> dict:
+        """LSDB divergence beacons (`breeze kv divergence`): compare
+        peers' advertised digests against our recent local digests; with
+        resolve, pull each suspect's key hashes and name the first
+        divergent key."""
+        return await self.kvstore.divergence_report(resolve=bool(resolve))
 
     async def _kv_long_poll_adj(
         self,

@@ -35,6 +35,7 @@ from openr_tpu.decision.prefix_state import PrefixState
 from openr_tpu.decision.rib import (
     DecisionRouteDb,
     DecisionRouteUpdate,
+    RouteProvenance,
     RouteUpdateType,
 )
 from openr_tpu.decision.rib_policy import RibPolicy
@@ -77,6 +78,12 @@ class PendingUpdates:
     # wins); later publications' contexts are closed as "coalesced" so
     # a burst doesn't multiply spans across one rebuild
     trace: Optional[TraceContext] = None
+    # provenance: per-prefix (kv_key, originator, area) tags and the
+    # last topology event ingested into THIS batch — they ride the
+    # snapshot through async dispatch so a coalesced solve still stamps
+    # routes with the event that actually changed them
+    provenance_tags: dict[str, tuple] = field(default_factory=dict)
+    topo_tag: Optional[tuple] = None
 
     def apply_link_state_change(
         self, change: LinkStateChange, node_name: str
@@ -96,6 +103,8 @@ class PendingUpdates:
         self.count = 0
         self.perf_events = None
         self.trace = None
+        self.provenance_tags = {}
+        self.topo_tag = None
 
 
 def make_solver(
@@ -209,6 +218,14 @@ class Decision(Actor):
         # what-if engine (decision/whatif.py): lazy, device backend only;
         # read-only planning workload riding the solver's resident mirrors
         self._whatif_engine = None
+        # route provenance (observatory): prefix -> RouteProvenance side
+        # map beside route_db, stamped per delta in _finish_rebuild;
+        # _ingest_tags remembers each prefix's last originating kv event
+        # across builds (topology-driven full rebuilds change routes
+        # whose own advertisement is long past)
+        self._provenance: dict[str, RouteProvenance] = {}
+        self._ingest_tags: dict[str, tuple] = {}
+        self._solve_epoch = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -285,10 +302,13 @@ class Decision(Actor):
             # so a label change must force the full path or it never
             # programs (rare event: label allocation churn)
             self.pending.needs_full_rebuild = True
-        self.pending.apply_prefix_changes(
-            set(update.unicast_routes_to_update)
-            | set(update.unicast_routes_to_delete)
+        changed = set(update.unicast_routes_to_update) | set(
+            update.unicast_routes_to_delete
         )
+        for p in changed:
+            # statics have no kv event; tag the source module instead
+            self.pending.provenance_tags[p] = ("", "prefix-manager", "")
+        self.pending.apply_prefix_changes(changed)
         self._trigger_rebuild()
 
     # -- publication parsing (ref Decision.cpp:731-844) --------------------
@@ -302,8 +322,10 @@ class Decision(Actor):
                 if value.value is None:
                     continue  # ttl refresh only
                 self._update_key_in_lsdb(area, key, value.value)
+                self._note_ingest(area, key, value.originator_id)
             for key in pub.expired_keys:
                 self._delete_key_from_lsdb(area, key)
+                self._note_ingest(area, key, "<expired>")
         if ctx is not None:
             if self.pending.count == before:
                 # nothing route-relevant changed; close so the trace
@@ -315,6 +337,19 @@ class Decision(Actor):
                 tracer.end_trace(ctx, status="coalesced")
         if self.pending.count > 0:
             self._trigger_rebuild()
+
+    def _note_ingest(self, area: str, key: str, originator: str) -> None:
+        """Record the originating-event tag for provenance stamping:
+        prefix keys tag their prefix directly; adj keys become the
+        batch's topology tag (a topology change re-routes prefixes whose
+        own advertisement didn't move)."""
+        tag = (key, originator, area)
+        parsed = parse_prefix_key(key)
+        if parsed is not None:
+            self.pending.provenance_tags[parsed[2]] = tag
+            return
+        if parse_adj_key(key) is not None:
+            self.pending.topo_tag = tag
 
     def _update_key_in_lsdb(self, area: str, key: str, raw: bytes) -> None:
         if not raw:
@@ -446,6 +481,9 @@ class Decision(Actor):
         a.needs_full_rebuild = a.needs_full_rebuild or b.needs_full_rebuild
         a.updated_prefixes |= b.updated_prefixes
         a.count += b.count
+        a.provenance_tags.update(b.provenance_tags)
+        if b.topo_tag is not None:
+            a.topo_tag = b.topo_tag
         if a.perf_events is None:
             a.perf_events = b.perf_events
         if b.trace is not None:
@@ -495,7 +533,7 @@ class Decision(Actor):
             new_db = self._solve_full(ctx, spf_sp)
         else:
             new_db = self._incremental_db(pending)
-        self._finish_rebuild(pending, ctx, spf_sp, t0, new_db)
+        self._finish_rebuild(pending, ctx, spf_sp, t0, new_db, full)
 
     async def _rebuild_async(self, pending: PendingUpdates) -> None:
         """Dispatch-fiber rebuild: identical to _rebuild except the full
@@ -506,14 +544,17 @@ class Decision(Actor):
             new_db = await self._solve_full_async(ctx, spf_sp)
         else:
             new_db = self._incremental_db(pending)
-        self._finish_rebuild(pending, ctx, spf_sp, t0, new_db)
+        self._finish_rebuild(pending, ctx, spf_sp, t0, new_db, full)
 
     def _finish_rebuild(
-        self, pending: PendingUpdates, ctx, spf_sp, t0, new_db
+        self, pending: PendingUpdates, ctx, spf_sp, t0, new_db, full=True
     ) -> None:
         if new_db is None:
             tracer.end_span(spf_sp)
             tracer.end_trace(ctx, status="not_in_lsdb")
+            # keep the batch's advertisement memory: these events must
+            # still attribute routes once we do appear in the LSDB
+            self._ingest_tags.update(pending.provenance_tags)
             return  # we are not yet in the LSDB
         tracer.end_span(spf_sp)
         counters.add_stat_value(
@@ -542,6 +583,9 @@ class Decision(Actor):
         build_ms = (time.perf_counter() - t0) * 1e3
         counters.add_stat_value("decision.route_build_ms", build_ms)
         counters.increment("decision.route_builds")
+        self._solve_epoch += 1
+        counters.set_counter("decision.solve_epoch", self._solve_epoch)
+        self._stamp_provenance(update, pending, full)
 
         if not self._first_build_done or not update.empty():
             perf = pending.perf_events or PerfEvents()
@@ -554,6 +598,55 @@ class Decision(Actor):
         if not self._first_build_done:
             self._first_build_done = True
             self._route_updates_q.push(InitializationEvent.RIB_COMPUTED)
+
+    # -- route provenance (observatory) ------------------------------------
+
+    def _solver_kind(self, full: bool) -> str:
+        """Which machinery materialized this build: "failover-cpu" while
+        degraded (the oracle carries the load), "incremental" for the
+        per-prefix path AND for full solves where the device dispatched
+        the seed-from-previous SSSP kernel, else "full"."""
+        if self._degraded:
+            return "failover-cpu"
+        if not full:
+            return "incremental"
+        tm = getattr(self.solver, "last_timing", None)
+        if isinstance(tm, dict) and tm.get("incremental"):
+            return "incremental"
+        return "full"
+
+    def _stamp_provenance(
+        self, update: DecisionRouteUpdate, pending: PendingUpdates, full: bool
+    ) -> None:
+        """Tag every route this build changed with its originating
+        event. Precedence per prefix: its own advertisement in this
+        batch; else (full rebuilds) the batch's topology event; else the
+        prefix's last-remembered advertisement from an earlier batch."""
+        kind = self._solver_kind(full)
+        now_ms = int(time.time() * 1000)
+        topo = pending.topo_tag if full else None
+        for prefix in update.unicast_routes_to_delete:
+            self._provenance.pop(prefix, None)
+            self._ingest_tags.pop(prefix, None)
+        for prefix in update.unicast_routes_to_update:
+            tag = (
+                pending.provenance_tags.get(prefix)
+                or topo
+                or self._ingest_tags.get(prefix)
+                or ("", "", "")
+            )
+            self._provenance[prefix] = RouteProvenance(
+                kv_key=tag[0],
+                originator=tag[1],
+                area=tag[2],
+                solve_epoch=self._solve_epoch,
+                solver_kind=kind,
+                ts_ms=now_ms,
+            )
+        # remember each prefix's own advertisement for future builds
+        # (after stamping: a delete+re-advertise in one batch must tag
+        # with the new event, not the popped one)
+        self._ingest_tags.update(pending.provenance_tags)
 
     # -- mid-flight solver failover ----------------------------------------
 
@@ -917,6 +1010,45 @@ class Decision(Actor):
         for prefix, entries in self.prefix_state.prefixes().items():
             for (node, area), entry in entries.items():
                 out.setdefault(node, {}).setdefault(area, {})[prefix] = entry
+        return out
+
+    async def explain_route(self, prefix: str) -> dict:
+        """Route provenance: where did this RIB entry come from — the
+        originating kvstore key/node/area, the solve epoch that
+        materialized it, and which solver kind (full / incremental /
+        failover-cpu) produced it (ref none; observatory extension,
+        `breeze decision explain`)."""
+        canon = prefix
+        if canon not in self.route_db.unicast_routes:
+            import ipaddress
+
+            try:
+                canon = str(ipaddress.ip_network(prefix, strict=False))
+            except ValueError:
+                return {"prefix": prefix, "error": f"bad prefix {prefix!r}"}
+        entry = self.route_db.unicast_routes.get(canon)
+        if entry is None:
+            return {"prefix": canon, "installed": False, "error": "no route"}
+        out = {
+            "prefix": canon,
+            "installed": not entry.do_not_install,
+            "igp_cost": entry.igp_cost,
+            "best_node_area": list(entry.best_node_area),
+            "nexthops": sorted(
+                {nh.neighbor_node_name or nh.address for nh in entry.nexthops}
+            ),
+            "num_nexthops": len(entry.nexthops),
+        }
+        prov = self._provenance.get(canon)
+        if prov is not None:
+            out["provenance"] = {
+                "kv_key": prov.kv_key,
+                "originator": prov.originator,
+                "area": prov.area,
+                "solve_epoch": prov.solve_epoch,
+                "solver_kind": prov.solver_kind,
+                "ts_ms": prov.ts_ms,
+            }
         return out
 
     # -- what-if engine (decision/whatif.py) -------------------------------

@@ -119,6 +119,26 @@ class RibMplsEntry:
     nexthops: frozenset[NextHop] = frozenset()
 
 
+@dataclass(frozen=True)
+class RouteProvenance:
+    """Originating-event tag for one RIB entry: which kv-store event
+    last changed this route and which solve materialized it. Kept in a
+    per-prefix side map beside DecisionRouteDb (RibUnicastEntry is
+    frozen and flows through the columnar RIB's row compare — widening
+    it would dirty every row on upgrade). Queryable per prefix via
+    ctrl.decision.explain / `breeze decision explain`. The reference
+    has no provenance; this is the TPU build's auditability extension
+    for the incremental solver (a route produced by seed-from-previous
+    must be attributable to its triggering event)."""
+
+    kv_key: str = ""  # originating kvstore key ("" = static/unknown)
+    originator: str = ""  # advertising node (Value.originator_id)
+    area: str = ""
+    solve_epoch: int = 0  # monotonic per-Decision build counter
+    solver_kind: str = "full"  # full | incremental | failover-cpu
+    ts_ms: int = 0  # wall clock at stamping
+
+
 class RouteUpdateType(enum.IntEnum):
     """ref RouteUpdate.h:34."""
 

@@ -571,6 +571,18 @@ class Fib(Actor):
     async def get_perf_db(self) -> list[PerfEvents]:
         return list(self.perf_db)
 
+    async def get_route_detail(self, prefix: str) -> dict:
+        """Programmed-state view of one prefix — joined into
+        ctrl.decision.explain so provenance answers both "which event
+        produced this route" and "did it actually land in the agent"."""
+        rs = self.route_state
+        return {
+            "desired": prefix in rs.unicast_routes,
+            "dirty": prefix in rs.dirty_prefixes,
+            "fib_state": rs.state.name,
+            "synced": self.synced,
+        }
+
     @property
     def synced(self) -> bool:
         return (

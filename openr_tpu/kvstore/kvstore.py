@@ -26,6 +26,9 @@ emits KvStoreSyncEvent to kvStoreEventsQueue (ref Main.cpp:223-266 wiring).
 from __future__ import annotations
 
 import asyncio
+import collections
+import hashlib
+import json
 import logging
 import time
 from dataclasses import dataclass, field
@@ -68,6 +71,22 @@ _PEER_SYNC_BACKOFF_MIN_S = 0.2  # scaled-down ref Constants (4s/256s) for
 _PEER_SYNC_BACKOFF_MAX_S = 10.0  # single-process emulation timescales
 _INITIAL_PARALLEL_SYNCS = 2  # doubles to max on progress (ref KvStore.cpp)
 _TTL_ERASE_MS = 256  # short ttl for unset tombstones
+
+# observatory key namespace: per-node TTL'd telemetry keys that ride the
+# flooding fabric but are NOT protocol state — excluded from the LSDB
+# digest (each node's beacons/health differ by design and would read as
+# permanent divergence)
+MONITOR_KEY_PREFIX = "monitor:"
+LSDB_DIGEST_PREFIX = "monitor:lsdb-digest:"
+FLOOD_PROBE_PREFIX = "monitor:flood-probe:"
+# beacons a node advertised more than this many intervals ago are
+# ignored by the divergence check (also the beacon TTL multiple, so a
+# dead node's beacon ages out of the comparison set by itself)
+_DIGEST_STALE_INTERVALS = 3
+# local digests remembered per area: a peer beacon matching ANY recent
+# digest means the peer is merely behind on in-flight floods, not
+# diverged — churn the fabric converges through must not flap the gauge
+_DIGEST_HISTORY = 4
 
 
 @dataclass
@@ -112,9 +131,34 @@ class KvStoreArea:
         self.initial_sync_done = False  # all initial peers INITIALIZED
         # DUAL SPT flood topology (ref Dual.h; None = full-mesh flooding)
         self.dual: Optional["Dual"] = None
+        # recent local LSDB digests, newest last (divergence beacons)
+        self.digest_history: collections.deque[str] = collections.deque(
+            maxlen=_DIGEST_HISTORY
+        )
 
     def hashes(self) -> dict[str, Value]:
         return dump_hash_with_filters(self.area, self.kv).key_vals
+
+    def digest(self) -> tuple[str, int]:
+        """Rolling LSDB digest: blake2b over the sorted
+        (key, version, ttl_version, value-hash) tuples — the same
+        per-key identity `breeze kv compare` and the 3-way sync deltas
+        compare on (Value.hash covers version/originator/value). Two
+        stores with equal digests hold the same protocol state; the
+        `monitor:` telemetry namespace is excluded (per-node by
+        design)."""
+        h = hashlib.blake2b(digest_size=8)
+        n = 0
+        for key in sorted(self.kv):
+            if key.startswith(MONITOR_KEY_PREFIX):
+                continue
+            v = self.kv[key]
+            h.update(
+                f"{key}\x00{v.version}\x00{v.ttl_version}\x00{v.hash}\x01"
+                .encode()
+            )
+            n += 1
+        return h.hexdigest(), n
 
 
 class KvStore(Actor):
@@ -184,6 +228,13 @@ class KvStore(Actor):
         # (ref initialization protocol): an empty initial event means a
         # standalone node, which is synced trivially.
         self._initial_peers_received = False
+        # observatory state: version counters seeded from the wall clock
+        # so a restarted node's first beacon beats its previous
+        # incarnation's TTL'd remnant (same idiom as monitor:health)
+        self._digest_version = int(time.time())
+        self._probe_version = int(time.time())
+        self._probe_seq = 0
+        self._divergence: dict = {}  # last computed divergence report
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -232,6 +283,14 @@ class KvStore(Actor):
         if self.cfg.sync_interval_s > 0:
             self.add_supervised_task(
                 self._anti_entropy_loop, name=f"{self.name}.anti-entropy"
+            )
+        if self.cfg.enable_lsdb_digest:
+            self.add_supervised_task(
+                self._digest_loop, name=f"{self.name}.digest"
+            )
+        if self.cfg.enable_flood_probes:
+            self.add_supervised_task(
+                self._flood_probe_loop, name=f"{self.name}.flood-probe"
             )
 
     async def on_stop(self) -> None:
@@ -406,6 +465,17 @@ class KvStore(Actor):
         counters.increment(
             f"kvstore.{self.node_name}.updated_key_vals", len(updates)
         )
+        # flood-latency probes: every RECEIVING store stamps propagation
+        # delay at merge time, so one probing node maps the whole
+        # fleet's flood latency (measurement is unconditional — it only
+        # fires when probe keys actually flow)
+        for key, val in updates.items():
+            if (
+                key.startswith(FLOOD_PROBE_PREFIX)
+                and val.value is not None
+                and val.originator_id != self.node_name
+            ):
+                self._record_probe_rtt(val)
         for key in updates:
             live = st.kv.get(key)
             if live is not None:
@@ -995,6 +1065,218 @@ class KvStore(Actor):
                     )
         return flagged
 
+    # -- observatory: LSDB digest beacons + flood-latency probes -----------
+
+    async def _digest_loop(self) -> None:
+        """Advertise a TTL'd per-area LSDB digest beacon and compare
+        every peer's beacon against our recent digests — two stores
+        that silently disagree flip the kvstore.divergence.* gauges
+        within one interval, fleet-wide, over the flooding fabric
+        itself (same self-observation idiom as monitor:health)."""
+        while True:
+            await asyncio.sleep(self.cfg.digest_interval_s)
+            self._advertise_digests()
+            self._check_divergence()
+
+    def _advertise_digests(self) -> None:
+        ttl_ms = max(
+            int(self.cfg.digest_interval_s * 1000 * _DIGEST_STALE_INTERVALS),
+            2500,
+        )
+        key = f"{LSDB_DIGEST_PREFIX}{self.node_name}"
+        for st in self.areas.values():
+            digest, nkeys = st.digest()
+            if not st.digest_history or st.digest_history[-1] != digest:
+                st.digest_history.append(digest)
+            self._digest_version += 1
+            payload = json.dumps(
+                {
+                    "node": self.node_name,
+                    "area": st.area,
+                    "ts_ms": int(time.time() * 1000),
+                    "digest": digest,
+                    "keys": nkeys,
+                },
+                sort_keys=True,
+            ).encode()
+            self._merge_and_flood(
+                Publication(
+                    key_vals={
+                        key: Value(
+                            version=self._digest_version,
+                            originator_id=self.node_name,
+                            value=payload,
+                            ttl_ms=ttl_ms,
+                        )
+                    },
+                    area=st.area,
+                )
+            )
+        counters.increment(f"kvstore.{self.node_name}.digest_advertisements")
+
+    def _check_divergence(self) -> dict:
+        """Compare every fresh peer beacon in each area against our
+        digest history. Matching ANY recent local digest means the peer
+        is merely behind on in-flight floods (a state we ourselves
+        passed through); matching none of them is divergence."""
+        now_ms = int(time.time() * 1000)
+        stale_ms = int(
+            self.cfg.digest_interval_s * 1000 * _DIGEST_STALE_INTERVALS
+        )
+        areas: dict[str, dict] = {}
+        suspects: set[str] = set()
+        for st in self.areas.values():
+            digest, nkeys = st.digest()
+            known = set(st.digest_history) | {digest}
+            mismatched = []
+            compared = 0
+            for key, val in st.kv.items():
+                if not key.startswith(LSDB_DIGEST_PREFIX) or val.value is None:
+                    continue
+                peer = key[len(LSDB_DIGEST_PREFIX):]
+                if peer == self.node_name:
+                    continue
+                try:
+                    blob = json.loads(val.value.decode())
+                except (ValueError, UnicodeDecodeError):
+                    continue
+                if now_ms - int(blob.get("ts_ms", 0)) > stale_ms:
+                    continue  # beacon older than its own TTL horizon
+                compared += 1
+                if blob.get("digest") not in known:
+                    mismatched.append(
+                        {
+                            "peer": peer,
+                            "digest": blob.get("digest"),
+                            "keys": blob.get("keys"),
+                            "ts_ms": blob.get("ts_ms"),
+                        }
+                    )
+                    suspects.add(peer)
+            areas[st.area] = {
+                "local_digest": digest,
+                "keys": nkeys,
+                "compared": compared,
+                "mismatched": mismatched,
+            }
+        diverged = sorted(suspects)
+        counters.set_counter(
+            "kvstore.divergence.detected", 1.0 if diverged else 0.0
+        )
+        counters.set_counter(
+            "kvstore.divergence.suspect_peers", float(len(diverged))
+        )
+        counters.set_counter(
+            "kvstore.divergence.areas_diverged",
+            float(sum(1 for a in areas.values() if a["mismatched"])),
+        )
+        counters.increment("kvstore.divergence.checks")
+        self._divergence = {
+            "node": self.node_name,
+            "ts_ms": now_ms,
+            "diverged": bool(diverged),
+            "suspect_peers": diverged,
+            "areas": areas,
+        }
+        return self._divergence
+
+    async def _first_divergent_key(self, st: KvStoreArea, peer: Peer) -> dict:
+        """Attribute a digest mismatch: pull the suspect peer's
+        hash-only dump (the 3-way-sync comparison view) and report the
+        lexicographically first key whose (version, ttl_version, hash)
+        identity differs — the starting point of the operator's
+        `breeze kv compare` drill-down."""
+        client, temp = peer.client, False
+        if client is None:
+            client, temp = self._make_peer_client(peer), True
+        try:
+            resp = await client.request(
+                "kvstore.dump_hashes", {"area": st.area, "prefix": ""}
+            )
+            theirs = from_plain(resp, Publication).key_vals
+        finally:
+            if temp:
+                await client.close()
+        mine = st.kv
+        for key in sorted(set(mine) | set(theirs)):
+            if key.startswith(MONITOR_KEY_PREFIX):
+                continue
+            m, t = mine.get(key), theirs.get(key)
+            if m is None or t is None:
+                return {
+                    "first_divergent_key": key,
+                    "reason": "missing_local" if m is None else "missing_peer",
+                }
+            if (m.version, m.ttl_version, m.hash) != (
+                t.version, t.ttl_version, t.hash
+            ):
+                return {
+                    "first_divergent_key": key,
+                    "reason": "mismatch",
+                    "local": {
+                        "version": m.version,
+                        "ttl_version": m.ttl_version,
+                        "hash": m.hash,
+                    },
+                    "peer": {
+                        "version": t.version,
+                        "ttl_version": t.ttl_version,
+                        "hash": t.hash,
+                    },
+                }
+        # digests disagreed but the hash dumps agree: the store converged
+        # between the peer's beacon and this dump — divergence was
+        # transient and the next beacon tick clears the gauge
+        return {"first_divergent_key": None, "reason": "converged"}
+
+    async def _flood_probe_loop(self) -> None:
+        """Opt-in: originate a timestamped synthetic key every interval;
+        every receiving store measures propagation delay into the
+        kvstore.flood_rtt_ms percentile windows — the first direct
+        measurement of the fabric's flood latency."""
+        while True:
+            await asyncio.sleep(self.cfg.flood_probe_interval_s)
+            self._originate_flood_probe()
+
+    def _originate_flood_probe(self) -> None:
+        ttl_ms = max(int(self.cfg.flood_probe_interval_s * 3000), 1000)
+        self._probe_seq += 1
+        self._probe_version += 1
+        key = f"{FLOOD_PROBE_PREFIX}{self.node_name}"
+        payload = json.dumps(
+            {"node": self.node_name, "seq": self._probe_seq, "ts": time.time()}
+        ).encode()
+        for st in self.areas.values():
+            self._merge_and_flood(
+                Publication(
+                    key_vals={
+                        key: Value(
+                            version=self._probe_version,
+                            originator_id=self.node_name,
+                            value=payload,
+                            ttl_ms=ttl_ms,
+                        )
+                    },
+                    area=st.area,
+                )
+            )
+        counters.increment(f"kvstore.{self.node_name}.flood_probes_sent")
+
+    def _record_probe_rtt(self, val: Value) -> None:
+        """Receiving-side probe stamp. Cross-machine deployments measure
+        origin wall clock vs ours, so the stat carries clock skew; on
+        the in-process emulation it is pure flood-path latency."""
+        try:
+            blob = json.loads(val.value.decode())
+            delay_ms = max(0.0, (time.time() - float(blob["ts"])) * 1000.0)
+        except (ValueError, KeyError, TypeError, UnicodeDecodeError):
+            return
+        counters.add_stat_value("kvstore.flood_rtt_ms", delay_ms)
+        counters.add_stat_value(
+            f"kvstore.flood_rtt_ms.{val.originator_id}", delay_ms
+        )
+        counters.increment(f"kvstore.{self.node_name}.flood_probes_received")
+
     # -- TTL expiry --------------------------------------------------------
 
     def _resched_ttl(self) -> None:
@@ -1073,6 +1355,32 @@ class KvStore(Actor):
         st = self.areas[area]
         filters = KvStoreFilters(key_prefixes=(prefix,) if prefix else ())
         return dump_hash_with_filters(area, st.kv, filters).key_vals
+
+    async def divergence_report(self, resolve: bool = True) -> dict:
+        """Fresh divergence verdict (ctrl.kvstore.divergence). With
+        `resolve`, each suspect peer's mismatch is attributed to its
+        first-divergent key by pulling that peer's hash dump — an RPC
+        per suspect, so resolution runs on demand, not on the beacon
+        tick."""
+        report = self._check_divergence()
+        if not resolve or not report["diverged"]:
+            return report
+        for area, entry in report["areas"].items():
+            st = self.areas[area]
+            for mm in entry["mismatched"]:
+                peer = st.peers.get(mm["peer"])
+                if peer is None:
+                    mm["resolution"] = {"error": "suspect is not a peer"}
+                    continue
+                try:
+                    mm["resolution"] = await self._first_divergent_key(
+                        st, peer
+                    )
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    mm["resolution"] = {"error": str(e)}
+        return report
 
     def get_area_summary(self) -> dict[str, dict]:
         """ref getKvStoreAreaSummary: per-area key count, payload bytes,

@@ -141,6 +141,24 @@ class CounterRegistry:
             for k, (samples, maxlen) in snap.items()
         }
 
+    def export_snapshot(
+        self, windows: tuple = (60.0, 600.0, 3600.0)
+    ) -> tuple[dict[str, float], dict[str, dict]]:
+        """One consistent (counters, stat-windows) view for exposition
+        (runtime/metrics_export.py). Same locking discipline as
+        get_statistics: only the ring copy happens under the lock."""
+        with self._lock:
+            counters_snap = dict(self._counters)
+            stat_snap = {
+                k: (list(st.samples), st.samples.maxlen)
+                for k, st in self._stats.items()
+            }
+        stats = {
+            k: _aggregate_windows(samples, maxlen, windows)
+            for k, (samples, maxlen) in stat_snap.items()
+        }
+        return counters_snap, stats
+
     def get_counters(self, prefix: str = "") -> dict[str, float]:
         with self._lock:
             out = {k: v for k, v in self._counters.items() if k.startswith(prefix)}

@@ -1,0 +1,231 @@
+"""OpenMetrics / Prometheus exposition for the counters fabric.
+
+Zero-dependency: the scrape endpoint is a minimal asyncio HTTP server
+(no aiohttp/prometheus_client in the image) served from the Monitor's
+event base when `monitor_config.metrics_port` is set. It renders the
+entire `CounterRegistry` — plain counters/gauges plus the
+p50/p95/p99 windows from `_aggregate_windows` — as exposition text an
+off-the-shelf Prometheus scraper accepts.
+
+Name mapping: the fabric uses fb303-style dotted names
+(`decision.spf_ms`, `kvstore.<node>.updated_key_vals`); Prometheus
+identifiers are `[a-zA-Z_:][a-zA-Z0-9_:]*`. `normalize_metric_name`
+maps one to the other deterministically (dots and other invalid bytes
+become `_`, everything is prefixed `openr_tpu_`). The mapping is
+lossy — `a.b` and `a_b` collide — so `tools/check_metric_names.py`
+statically verifies at lint time that every counter name bumped in the
+codebase normalizes to a unique identifier.
+
+Stat windows render as one summary family per stat with a
+`window="60|600|3600"` label and `quantile` samples, plus `_sum`,
+`_count`, and sibling `_max` / `_truncated` gauge families (`avg` is
+derivable as sum/count and is not exported).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import re
+from typing import Optional
+
+from openr_tpu.runtime.counters import CounterRegistry, counters
+
+METRIC_PREFIX = "openr_tpu_"
+
+# exposition identifier grammar (Prometheus data model)
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_INVALID_CHARS_RE = re.compile(r"[^a-zA-Z0-9_:]")
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{([^{}]*)\})?"
+    r" (-?(?:[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?|Inf)|NaN|\+Inf)$"
+)
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+# the stat-window quantiles _aggregate_windows computes
+_QUANTILES = (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99"))
+
+
+def normalize_metric_name(name: str) -> str:
+    """Dotted fabric name -> exposition identifier. Deterministic and
+    total (any input maps to a valid identifier); NOT injective — the
+    CI checker guards collisions."""
+    return METRIC_PREFIX + _INVALID_CHARS_RE.sub("_", name)
+
+
+def is_valid_metric_name(name: str) -> bool:
+    return bool(_NAME_RE.match(name))
+
+
+def _fmt(v: float) -> str:
+    # repr round-trips floats exactly: float(_fmt(v)) == v
+    f = float(v)
+    if f != f:
+        return "NaN"
+    if f in (float("inf"), float("-inf")):
+        return "+Inf" if f > 0 else "-Inf"
+    return repr(f)
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def render_exposition(
+    counters_snap: dict[str, float], stats_snap: dict[str, dict]
+) -> str:
+    """(counters, stat-windows) -> exposition text. Input shape is
+    exactly CounterRegistry.export_snapshot()'s output."""
+    lines: list[str] = []
+    emitted: set[str] = set()
+
+    def family(name: str, mtype: str, help_text: str) -> bool:
+        # one HELP/TYPE block per family; a post-normalization collision
+        # (guarded at lint time by tools/check_metric_names.py) is
+        # dropped rather than emitting an invalid duplicate family
+        if name in emitted:
+            return False
+        emitted.add(name)
+        lines.append(f"# HELP {name} {_escape_help(help_text)}")
+        lines.append(f"# TYPE {name} {mtype}")
+        return True
+
+    for key in sorted(counters_snap):
+        name = normalize_metric_name(key)
+        if family(name, "gauge", f"openr_tpu counter '{key}'"):
+            lines.append(f"{name} {_fmt(counters_snap[key])}")
+
+    for key in sorted(stats_snap):
+        name = normalize_metric_name(key)
+        windows = stats_snap[key]
+        if family(name, "summary", f"openr_tpu stat '{key}' (windowed)"):
+            for w in sorted(windows, key=int):
+                agg = windows[w]
+                for q, field in _QUANTILES:
+                    lines.append(
+                        f'{name}{{window="{w}",quantile="{q}"}} '
+                        f"{_fmt(agg[field])}"
+                    )
+                lines.append(f'{name}_sum{{window="{w}"}} {_fmt(agg["sum"])}')
+                lines.append(
+                    f'{name}_count{{window="{w}"}} {_fmt(agg["count"])}'
+                )
+        for suffix, field, help_text in (
+            ("_max", "max", "window maximum"),
+            ("_truncated", "truncated", "1 when the sample ring wrapped "
+             "before the window cutoff"),
+        ):
+            if family(
+                name + suffix, "gauge",
+                f"openr_tpu stat '{key}' {help_text}",
+            ):
+                for w in sorted(windows, key=int):
+                    lines.append(
+                        f'{name}{suffix}{{window="{w}"}} '
+                        f"{_fmt(float(windows[w][field]))}"
+                    )
+
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+def parse_exposition(text: str) -> dict[tuple, float]:
+    """Strict line parse of exposition text back into
+    {(name, ((label, value), ...)): float}. Raises ValueError on any
+    malformed sample line — the round-trip test uses this to prove the
+    endpoint serves valid text for 100% of registry entries."""
+    out: dict[tuple, float] = {}
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"malformed exposition line: {line!r}")
+        name, label_blob, value = m.group(1), m.group(2), m.group(3)
+        labels: tuple = ()
+        if label_blob:
+            pairs = _LABEL_RE.findall(label_blob)
+            # reject label blobs the pair grammar didn't fully consume
+            if _LABEL_RE.sub("", label_blob).strip(", ") != "":
+                raise ValueError(f"malformed labels: {line!r}")
+            labels = tuple(sorted(pairs))
+        out[(name, labels)] = float(value)
+    return out
+
+
+def render_registry(registry: Optional[CounterRegistry] = None) -> str:
+    reg = registry if registry is not None else counters
+    counters_snap, stats_snap = reg.export_snapshot()
+    return render_exposition(counters_snap, stats_snap)
+
+
+class MetricsExporter:
+    """Minimal asyncio HTTP/1.0 scrape server: GET /metrics -> the
+    registry exposition. Runs on the Monitor's event loop; one render
+    per scrape, no background work between scrapes."""
+
+    def __init__(
+        self,
+        registry: Optional[CounterRegistry] = None,
+        listen_addr: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self._registry = registry if registry is not None else counters
+        self._listen_addr = listen_addr
+        self._requested_port = port
+        self._server: Optional[asyncio.base_events.Server] = None
+        self.port: int = 0  # bound port (differs from requested when 0)
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, self._listen_addr, self._requested_port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            request = await asyncio.wait_for(reader.readline(), timeout=5.0)
+            parts = request.decode("latin-1").split()
+            # drain headers; scrape requests carry no body
+            while True:
+                line = await asyncio.wait_for(reader.readline(), timeout=5.0)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            if len(parts) >= 2 and parts[0] == "GET" and (
+                parts[1] == "/metrics" or parts[1].startswith("/metrics?")
+            ):
+                body = render_registry(self._registry).encode()
+                status = "200 OK"
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+                counters.increment("monitor.metrics_scrapes")
+            else:
+                body = b"openr_tpu exporter: scrape /metrics\n"
+                status = "404 Not Found"
+                ctype = "text/plain; charset=utf-8"
+            writer.write(
+                (
+                    f"HTTP/1.0 {status}\r\n"
+                    f"Content-Type: {ctype}\r\n"
+                    f"Content-Length: {len(body)}\r\n"
+                    "Connection: close\r\n\r\n"
+                ).encode()
+                + body
+            )
+            await writer.drain()
+        except (asyncio.TimeoutError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):  # pragma: no cover
+                pass

@@ -103,6 +103,9 @@ class Monitor(Actor):
         # seed from wall clock so a restarted node's first advertisement
         # beats the TTL'd remnant of its previous incarnation
         self._health_version = int(time.time())
+        # OpenMetrics scrape server (runtime/metrics_export.py), started
+        # in on_start when cfg.metrics_port is set
+        self.metrics_exporter = None
         # the monitor owns the observability config, so the tracing
         # kill-switch rides on it (ISSUE: disabled tracing must cost no
         # more than a dict lookup per queue push)
@@ -123,6 +126,27 @@ class Monitor(Actor):
         self.add_task(self._metrics_loop(), name=f"{self.name}.metrics")
         if self.cfg.enable_fleet_health:
             self.add_task(self._health_loop(), name=f"{self.name}.health")
+        if self.cfg.metrics_port is not None:
+            # OpenMetrics exposition on the monitor's own event base —
+            # a scrape renders the registry inline, no background work
+            from openr_tpu.runtime.metrics_export import MetricsExporter
+
+            self.metrics_exporter = MetricsExporter(
+                listen_addr=self.cfg.metrics_listen_addr,
+                port=self.cfg.metrics_port,
+            )
+            await self.metrics_exporter.start()
+            log.info(
+                "monitor %s: /metrics on %s:%d",
+                self.node_name,
+                self.cfg.metrics_listen_addr,
+                self.metrics_exporter.port,
+            )
+
+    async def on_stop(self) -> None:
+        if self.metrics_exporter is not None:
+            await self.metrics_exporter.stop()
+            self.metrics_exporter = None
 
     async def _log_loop(self) -> None:
         while True:
@@ -203,6 +227,38 @@ class Monitor(Actor):
             ),
             "event_logs_dropped": int(
                 counters.get_counter("monitor.event_logs.dropped") or 0
+            ),
+            # what-if planning activity (PR 6): errors > 0 means an
+            # operator's planning query failed — never degraded mode,
+            # but worth triage
+            "whatif_runs": int(
+                (counters.get_counter("whatif.sweeps") or 0)
+                + (counters.get_counter("whatif.drains") or 0)
+                + (counters.get_counter("whatif.optimizes") or 0)
+            ),
+            "whatif_errors": int(counters.get_counter("whatif.errors") or 0),
+            # incremental-solver engagement (PR 7): a fleet where
+            # full_fallbacks tracks solves 1:1 is paying cold-solve
+            # latency on every churn event
+            "incr_solves": int(
+                counters.get_counter("decision.solver.incr.solves") or 0
+            ),
+            "incr_full_fallbacks": int(
+                counters.get_counter("decision.solver.incr.full_fallbacks") or 0
+            ),
+            # namespaced executable-cache churn: evictions in the incr /
+            # whatif LRU budgets mean shape churn is recompiling kernels
+            "xla_evictions": int(
+                (counters.get_counter("xla_cache.incr_executable_evictions") or 0)
+                + (
+                    counters.get_counter("xla_cache.whatif_executable_evictions")
+                    or 0
+                )
+            ),
+            # LSDB divergence beacons (kvstore digest fabric): true while
+            # any peer's advertised digest disagrees with ours
+            "lsdb_diverged": bool(
+                counters.get_counter("kvstore.divergence.detected") or 0
             ),
         }
 

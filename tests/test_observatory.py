@@ -1,0 +1,287 @@
+"""Fleet observatory system tests: LSDB divergence beacons, flood
+latency probes, and route provenance, over real in-process meshes.
+
+The divergence bar: a seeded 3-node split is detected and attributed
+to the first divergent key within one beacon interval. The provenance
+bar: `explain` names the originating kv event and solver kind for
+routes from both full and incremental solves.
+"""
+
+from openr_tpu.config import KvstoreConfig
+from openr_tpu.kvstore.wrapper import wait_until
+from openr_tpu.runtime.counters import counters
+from openr_tpu.runtime.openr_wrapper import OpenrWrapper
+from openr_tpu.spark import MockIoMesh
+from openr_tpu.types import TTL_INFINITY, Value
+from tests.conftest import run_async
+from tests.test_system import loopback, stop_all
+
+CONVERGENCE_S = 20.0
+
+LINE_LINKS = [
+    ("node-0", "if-01", "node-1", "if-10"),
+    ("node-1", "if-12", "node-2", "if-21"),
+]
+
+
+async def start_line(kv_cfg: KvstoreConfig):
+    names = ["node-0", "node-1", "node-2"]
+    mesh = MockIoMesh()
+    kv_ports: dict[str, int] = {}
+    nodes = {
+        n: OpenrWrapper(n, mesh.provider(n), kv_ports, kvstore_config=kv_cfg)
+        for n in names
+    }
+    for a, if_a, b, if_b in LINE_LINKS:
+        mesh.connect(a, if_a, b, if_b)
+    await nodes["node-0"].start("if-01")
+    await nodes["node-1"].start("if-10", "if-12")
+    await nodes["node-2"].start("if-21")
+    return mesh, nodes
+
+
+async def converge_loopbacks(nodes):
+    for i, n in enumerate(nodes):
+        nodes[n].advertise_prefix(loopback(i))
+    await wait_until(
+        lambda: all(
+            loopback(j) in nodes[n].fib_routes
+            for i, n in enumerate(nodes)
+            for j in range(len(nodes))
+            if j != i
+        ),
+        timeout_s=CONVERGENCE_S,
+    )
+
+
+class TestLsdbDivergence:
+    @run_async
+    async def test_seeded_split_detected_and_attributed(self):
+        """Seed a silent split (a key present only in node-2's store,
+        bypassing the flood path) and assert node-1 flags node-2 as the
+        suspect and names the seeded key — within one beacon interval
+        of the beacon that carries the bad digest."""
+        interval = 0.25
+        mesh, nodes = await start_line(
+            KvstoreConfig(enable_lsdb_digest=True, digest_interval_s=interval)
+        )
+        try:
+            await converge_loopbacks(nodes)
+            kv1 = nodes["node-1"].kvstore
+            kv2 = nodes["node-2"].kvstore
+
+            # healthy mesh first: beacons from both neighbors arrive
+            # and node-1's check finds no divergence
+            await wait_until(
+                lambda: sum(
+                    a["compared"]
+                    for a in kv1._check_divergence()["areas"].values()
+                ) >= 2,
+                timeout_s=CONVERGENCE_S,
+            )
+            assert not kv1._check_divergence()["diverged"]
+
+            # the seed: write straight into node-2's area store — no
+            # flood, no merge; exactly the silent corruption the
+            # beacons exist to catch
+            st2 = kv2.areas["0"]
+            st2.kv["adj:ghost-node"] = Value(
+                version=1,
+                originator_id="ghost-node",
+                value=b"not-a-real-db",
+                ttl_ms=TTL_INFINITY,
+            )
+
+            # detection: node-2's next beacon carries the poisoned
+            # digest; node-1 must flag it
+            await wait_until(
+                lambda: "node-2" in kv1._check_divergence()["suspect_peers"],
+                timeout_s=CONVERGENCE_S,
+            )
+
+            # attribution: resolve pulls node-2's hash dump and names
+            # the seeded key as first-divergent
+            report = await kv1.divergence_report(resolve=True)
+            assert report["diverged"]
+            assert report["suspect_peers"] == ["node-2"]
+            mismatches = report["areas"]["0"]["mismatched"]
+            assert mismatches and mismatches[0]["peer"] == "node-2"
+            res = mismatches[0]["resolution"]
+            assert res["first_divergent_key"] == "adj:ghost-node"
+            assert res["reason"] == "missing_local"
+
+            # the gauges flipped too (process-global registry: any
+            # node's check writes them, but all agree on the split)
+            assert counters.get_counter("kvstore.divergence.detected") == 1.0
+
+            # heal and watch the verdict clear
+            del st2.kv["adj:ghost-node"]
+            await wait_until(
+                lambda: not kv1._check_divergence()["diverged"],
+                timeout_s=CONVERGENCE_S,
+            )
+        finally:
+            await stop_all(nodes)
+
+    @run_async
+    async def test_healthy_mesh_never_flags(self):
+        """TTL refreshes and in-flight floods must not flap the
+        divergence verdict: converge, then watch several beacon
+        intervals of steady state."""
+        import asyncio
+
+        interval = 0.2
+        mesh, nodes = await start_line(
+            KvstoreConfig(enable_lsdb_digest=True, digest_interval_s=interval)
+        )
+        try:
+            await converge_loopbacks(nodes)
+            kv1 = nodes["node-1"].kvstore
+            await wait_until(
+                lambda: sum(
+                    a["compared"]
+                    for a in kv1._check_divergence()["areas"].values()
+                ) >= 2,
+                timeout_s=CONVERGENCE_S,
+            )
+            for _ in range(8):
+                await asyncio.sleep(interval)
+                report = kv1._check_divergence()
+                assert not report["diverged"], report
+        finally:
+            await stop_all(nodes)
+
+
+class TestFloodProbes:
+    @run_async
+    async def test_probe_rtt_measured_on_receivers(self):
+        mesh, nodes = await start_line(
+            KvstoreConfig(
+                enable_lsdb_digest=False,
+                enable_flood_probes=True,
+                flood_probe_interval_s=0.15,
+            )
+        )
+        try:
+            await converge_loopbacks(nodes)
+            # every node originates probes; every OTHER node must
+            # measure them — including node-2's probes crossing two
+            # hops to node-0
+            await wait_until(
+                lambda: all(
+                    (counters.get_counter(
+                        f"kvstore.{n}.flood_probes_received"
+                    ) or 0) > 0
+                    for n in nodes
+                ),
+                timeout_s=CONVERGENCE_S,
+            )
+            _, stats = counters.export_snapshot()
+            assert "kvstore.flood_rtt_ms" in stats
+            agg = stats["kvstore.flood_rtt_ms"]["3600"]
+            assert agg["count"] > 0
+            assert agg["p99"] >= 0.0
+            # per-origin breakdown exists for at least one origin
+            assert any(
+                k.startswith("kvstore.flood_rtt_ms.node-") for k in stats
+            )
+        finally:
+            await stop_all(nodes)
+
+
+class TestRouteProvenance:
+    @run_async
+    async def test_incremental_and_full_kinds_attributed(self):
+        mesh, nodes = await start_line(KvstoreConfig())
+        try:
+            await converge_loopbacks(nodes)
+            dec0 = nodes["node-0"].decision
+
+            # -- incremental: a fresh prefix advertisement after steady
+            # state takes the per-prefix path and must be attributed to
+            # node-2's kv event
+            nodes["node-2"].advertise_prefix("10.9.9.0/24")
+            await wait_until(
+                lambda: "10.9.9.0/24" in nodes["node-0"].fib_routes,
+                timeout_s=CONVERGENCE_S,
+            )
+            out = await dec0.explain_route("10.9.9.0/24")
+            assert out["installed"]
+            prov = out["provenance"]
+            assert prov["solver_kind"] == "incremental"
+            assert prov["kv_key"].startswith("prefix:")
+            assert "node-2" in prov["kv_key"]
+            assert prov["originator"] == "node-2"
+            assert prov["area"] == "0"
+            epoch_incr = prov["solve_epoch"]
+            assert epoch_incr > 0
+
+            # -- full: cut and heal the 1-2 link; the route to node-2's
+            # loopback disappears and comes back via a topology-driven
+            # FULL rebuild, attributed to the adjacency event
+            mesh.disconnect("node-1", "if-12", "node-2", "if-21")
+            await wait_until(
+                lambda: loopback(2) not in nodes["node-0"].fib_routes,
+                timeout_s=CONVERGENCE_S,
+            )
+            gone = await dec0.explain_route(loopback(2))
+            assert gone.get("error") == "no route"
+
+            mesh.connect("node-1", "if-12", "node-2", "if-21")
+            await wait_until(
+                lambda: loopback(2) in nodes["node-0"].fib_routes,
+                timeout_s=CONVERGENCE_S,
+            )
+            out = await dec0.explain_route(loopback(2))
+            prov = out["provenance"]
+            assert prov["solver_kind"] == "full"
+            assert prov["kv_key"].startswith("adj:")
+            assert prov["solve_epoch"] > epoch_incr
+
+            # unknown prefixes answer cleanly
+            missing = await dec0.explain_route("203.0.113.0/24")
+            assert missing.get("error") == "no route"
+            bad = await dec0.explain_route("not-a-prefix")
+            assert "error" in bad
+        finally:
+            await stop_all(nodes)
+
+    @run_async
+    async def test_ctrl_explain_joins_fib_state(self):
+        """ctrl.decision.explain end-to-end: provenance plus the Fib
+        agent's programmed verdict for the same prefix."""
+        names = ["node-0", "node-1", "node-2"]
+        mesh = MockIoMesh()
+        kv_ports: dict[str, int] = {}
+        nodes = {
+            n: OpenrWrapper(
+                n, mesh.provider(n), kv_ports, enable_ctrl=(n == "node-0")
+            )
+            for n in names
+        }
+        for a, if_a, b, if_b in LINE_LINKS:
+            mesh.connect(a, if_a, b, if_b)
+        await nodes["node-0"].start("if-01")
+        await nodes["node-1"].start("if-10", "if-12")
+        await nodes["node-2"].start("if-21")
+        try:
+            await converge_loopbacks(nodes)
+            from openr_tpu.runtime.rpc import RpcClient
+
+            client = RpcClient(
+                "127.0.0.1", nodes["node-0"].ctrl.port, name="test"
+            )
+            try:
+                out = await client.request(
+                    "ctrl.decision.explain", {"prefix": loopback(2)}
+                )
+            finally:
+                await client.close()
+            assert out["prefix"] == loopback(2)
+            assert out["provenance"]["solver_kind"] in (
+                "full", "incremental"
+            )
+            assert out["fib"]["desired"]
+            assert out["fib"]["fib_state"] == "SYNCED"
+        finally:
+            await stop_all(nodes)
