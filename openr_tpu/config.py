@@ -251,6 +251,20 @@ class MonitorConfig:
 
 
 @dataclass
+class RuntimeConfig:
+    """Cross-cutting runtime/debug knobs (no reference analogue — the
+    reference gets these invariants from its threading model)."""
+
+    # thread-ownership sentinel (runtime/affinity.py): actors and the
+    # device solver record their owning thread and raise
+    # AffinityViolation on cross-thread access to guarded state. A
+    # debug/CI knob — default off (the disabled cost is one bool read
+    # per guarded site); CI test+chaos lanes enable it via the
+    # OPENR_TPU_AFFINITY_CHECKS env var, which seeds the same switch.
+    affinity_checks: bool = False
+
+
+@dataclass
 class FaultInjectionConfig:
     """Deterministic fault injection (runtime/faults.py). Schedules armed
     here apply from daemon startup; ctrl.fault.{inject,clear,list} and
@@ -422,6 +436,7 @@ class OpenrConfig:
     fib_config: FibConfig = field(default_factory=FibConfig)
     watchdog_config: WatchdogConfig = field(default_factory=WatchdogConfig)
     monitor_config: MonitorConfig = field(default_factory=MonitorConfig)
+    runtime_config: RuntimeConfig = field(default_factory=RuntimeConfig)
     fault_injection_config: FaultInjectionConfig = field(
         default_factory=FaultInjectionConfig
     )

@@ -326,6 +326,7 @@ class CtrlServer(Actor):
             from importlib.metadata import version as _pkg_version
 
             pkg = _pkg_version("openr-tpu")
+        # lint: allow(broad-except) uninstalled checkout reports "dev"
         except Exception:
             pkg = "dev"
         return {
@@ -1300,6 +1301,7 @@ class CtrlServer(Actor):
                 if not get_t.done():
                     get_t.cancel()
                     break
+                # lint: allow(blocking-call) task is done() — no wait
                 on_item(get_t.result())
         except QueueClosedError:
             pass

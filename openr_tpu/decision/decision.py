@@ -131,6 +131,7 @@ def make_solver(
         except Exception:
             if backend == "tpu":
                 raise
+            counters.increment("decision.solver.backend_fallbacks")
             log.warning("tpu solver unavailable; falling back to cpu")
             kwargs.pop("xla_cache_dir", None)
             kwargs.pop("small_graph_nodes", None)
@@ -361,6 +362,7 @@ class Decision(Actor):
             try:
                 adj_db = deserialize(raw, AdjacencyDatabase)
             except Exception:
+                counters.increment("decision.lsdb_parse_errors")
                 log.exception("%s: bad adj db for %s", self.name, key)
                 return
             self._update_adjacency_db(area, adj_db)
@@ -370,6 +372,7 @@ class Decision(Actor):
             try:
                 prefix_db = deserialize(raw, PrefixDatabase)
             except Exception:
+                counters.increment("decision.lsdb_parse_errors")
                 log.exception("%s: bad prefix db for %s", self.name, key)
                 return
             changed = self.prefix_state.update_prefix_database(prefix_db)
@@ -699,6 +702,8 @@ class Decision(Actor):
                     self.node_name, self.area_link_states, self.prefix_state
                 )
                 loop = asyncio.get_running_loop()
+                # collect_route_db is @affinity.executor_safe: phase 2
+                # reads only device buffers + the pending snapshot
                 return await loop.run_in_executor(
                     None, self.solver.collect_route_db, build
                 )
@@ -835,6 +840,7 @@ class Decision(Actor):
                     values={"category": "sentinel", **values},
                 )
             )
+        # lint: allow(broad-except) best-effort telemetry must not kill
         except Exception:  # pragma: no cover - telemetry must not kill
             log.debug("%s: solver log sample failed", self.name)
 
@@ -1101,9 +1107,12 @@ class Decision(Actor):
             for chunk in job.chunks:
                 await self._whatif_gate()
                 chunk.dispatch()
-                rows.extend(
-                    await loop.run_in_executor(None, chunk.collect)
-                )
+                # chunk.collect blocks only on its own device output
+                # buffers; the LSDB snapshot was taken on-loop in
+                # plan_sweep, so nothing it touches is actor-owned
+                # lint: allow(executor-escape) reads device buffers only
+                res = await loop.run_in_executor(None, chunk.collect)
+                rows.extend(res)
             out = job.result(rows)
             if top:
                 out["rows"] = out["rows"][:top]
@@ -1155,6 +1164,7 @@ class Decision(Actor):
         try:
             # the GD loop touches only device/host arrays — run it off
             # the actor loop so route processing stays live throughout
+            # lint: allow(executor-escape) job snapshot taken on-loop
             return await loop.run_in_executor(None, job.run)
         except Exception as e:
             counters.increment("whatif.errors")

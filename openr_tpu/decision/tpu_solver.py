@@ -72,6 +72,7 @@ from openr_tpu.decision.link_state import LinkState, NodeUcmpResult
 from openr_tpu.decision.prefix_state import PrefixState
 from openr_tpu.decision.rib import DecisionRouteDb
 from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.runtime import affinity
 from openr_tpu.runtime.counters import counters
 from openr_tpu.ops.csr import (
     INF32,
@@ -1284,6 +1285,11 @@ class TpuSpfSolver:
         LinkState/PrefixState (the actor loop); collect_route_db touches
         only device buffers and the pending snapshot, so the async
         dispatch fiber may run it in an executor."""
+        # TSan-lite: the docstring's "must run on the owning thread" is
+        # asserted when runtime affinity checks are on (CI test+chaos
+        # lanes); first call binds the owner, later calls verify it
+        if affinity.enabled():
+            affinity.assert_owner(self, "dispatch_route_db")
         if not any(
             ls.has_node(my_node_name) for ls in area_link_states.values()
         ):
@@ -1359,9 +1365,15 @@ class TpuSpfSolver:
             # main thread dispatches the rest and runs the host slow
             # path — sync/exec/mat pipeline instead of serializing
             for pv, prepare in self._dispatch_fused(group):
+                # lint: allow(executor-escape) rib-mat pool is single-worker
                 futures.append((pv["area"], self._pool().submit(prepare)))
         for pv in singles:
             prepare = self._dispatch_one(pv)
+            # the prepare closures touch per-vantage state, but the
+            # rib-mat pool has exactly ONE worker (_pool), so their
+            # execution is serialized by construction — the escape is
+            # the whole point of the sync/exec/mat pipeline
+            # lint: allow(executor-escape) rib-mat pool is single-worker
             futures.append((pv["area"], self._pool().submit(prepare)))
         # batch the per-destination second-pass SSSPs on device and prime
         # the k-paths cache; the oracle loop below then assembles KSP2
@@ -1393,6 +1405,7 @@ class TpuSpfSolver:
         pending.bytes_uploaded = self._bytes_uploaded
         return pending
 
+    @affinity.executor_safe
     def collect_route_db(
         self, pending: Optional[_PendingBuild]
     ) -> Optional[DecisionRouteDb]:
@@ -1762,6 +1775,11 @@ class TpuSpfSolver:
 
     def _sync_area(self, area: str, link_state: LinkState,
                    prefix_state: PrefixState, prefixes: list) -> _AreaDev:
+        # guards the LSDB reads AND the drain-journal writes
+        # (ad.drain_log / drain_epoch) — the state a cross-thread
+        # caller would silently corrupt
+        if affinity.enabled():
+            affinity.assert_owner(self, "_sync_area")
         ad = self._area_dev.get(area)
         if ad is None:
             ad = self._area_dev[area] = _AreaDev()

@@ -127,6 +127,7 @@ class Fib(Actor):
         # first poll would miss a restart that happens before that poll
         try:
             self._agent_alive_since = await self.service.alive_since()
+        # lint: allow(broad-except) agent not up yet is the normal cold
         except Exception:
             pass  # keepalive loop will establish it
         self.add_supervised_task(
@@ -432,6 +433,7 @@ class Fib(Actor):
                     rs.dirty_prefixes.pop(p, None)
                     programmed.unicast_routes_to_update[p] = rs.unicast_routes[p]
         except Exception as e:
+            counters.increment("fib.program_error")
             log.warning("%s: add_unicast failed: %s", self.name, e)
             ok = False
 
@@ -453,6 +455,7 @@ class Fib(Actor):
                     rs.dirty_prefixes.pop(p, None)
                     programmed.unicast_routes_to_delete.append(p)
         except Exception as e:
+            counters.increment("fib.program_error")
             log.warning("%s: delete_unicast failed: %s", self.name, e)
             ok = False
 
@@ -471,6 +474,7 @@ class Fib(Actor):
                     rs.dirty_labels.pop(l, None)
                     programmed.mpls_routes_to_update[l] = rs.mpls_routes[l]
         except Exception as e:
+            counters.increment("fib.program_error")
             log.warning("%s: add_mpls failed: %s", self.name, e)
             ok = False
 
@@ -487,6 +491,7 @@ class Fib(Actor):
                     rs.dirty_labels.pop(l, None)
                     programmed.mpls_routes_to_delete.append(l)
         except Exception as e:
+            counters.increment("fib.program_error")
             log.warning("%s: delete_mpls failed: %s", self.name, e)
             ok = False
 
@@ -549,6 +554,9 @@ class Fib(Actor):
             try:
                 alive = await self.service.alive_since()
             except Exception:
+                # an unreachable agent is a normal transient here; the
+                # counter (not a log line every 200 ms) is the signal
+                counters.increment("fib.keepalive_failure")
                 continue
             if self._agent_alive_since is None:
                 self._agent_alive_since = alive

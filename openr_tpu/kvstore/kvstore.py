@@ -420,6 +420,9 @@ class KvStore(Actor):
                 # flood failure: reset the session — the peer_down/up
                 # cycle discards pending replies and re-introduces state
                 # on both sides.
+                counters.increment(
+                    f"kvstore.{self.node_name}.dual_send_failure"
+                )
                 log.info(
                     "%s: dual send to %s failed; resetting peer",
                     self.name, peer_name,
@@ -598,6 +601,9 @@ class KvStore(Actor):
         except Exception as e:
             # transport failure resets the peer to IDLE for re-sync
             # (ref processThriftFailure KvStore.cpp:2134-2141)
+            counters.increment(
+                f"kvstore.{self.node_name}.thrift.num_flood_failure"
+            )
             log.info(
                 "%s: flood to %s failed: %s", self.name, peer.node_name, e
             )
@@ -1378,6 +1384,9 @@ class KvStore(Actor):
                     )
                 except asyncio.CancelledError:
                     raise
+                # the failure is surfaced to the ctrl caller in the
+                # report row itself, not swallowed
+                # lint: allow(broad-except) error returned in the report
                 except Exception as e:
                     mm["resolution"] = {"error": str(e)}
         return report

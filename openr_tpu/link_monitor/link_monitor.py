@@ -178,6 +178,7 @@ class LinkMonitor(Actor):
             try:
                 self.state = deserialize(raw, LinkMonitorState)
             except Exception:
+                counters.increment("link_monitor.bad_persisted_state")
                 log.exception("%s: bad persisted state; using defaults", self.name)
 
     def _save_state(self) -> None:

@@ -98,6 +98,13 @@ async def run_daemon(args) -> None:
     )
     log.info("starting openr_tpu node %s", node_name)
 
+    # -- thread-ownership sentinel (debug; env var seeds the default) -----
+    if oc.runtime_config.affinity_checks:
+        from openr_tpu.runtime import affinity
+
+        affinity.set_enabled(True)
+        log.info("runtime affinity checks enabled")
+
     # -- fault injection: arm config-declared chaos schedules -------------
     from openr_tpu.runtime.faults import registry as fault_registry
 

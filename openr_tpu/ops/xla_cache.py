@@ -55,6 +55,7 @@ def _hook_cache_monitoring() -> bool:
         return True
     try:
         from jax._src import monitoring
+    # lint: allow(broad-except) private jax API; absence returns False
     except Exception:  # pragma: no cover - depends on jax internals
         return False
 
@@ -67,6 +68,7 @@ def _hook_cache_monitoring() -> bool:
 
     try:
         monitoring.register_event_listener(_on_event)
+    # lint: allow(broad-except) private jax API; absence returns False
     except Exception:  # pragma: no cover
         return False
     _monitoring_hooked = True
@@ -97,6 +99,7 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
         # them quickly — a restart replays dozens of them
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    # lint: allow(broad-except) cache is best-effort; cold compile works
     except Exception as e:  # pragma: no cover - cache is best-effort
         log.warning("compilation cache unavailable (%s); compiling cold", e)
         _applied = ""
@@ -205,6 +208,7 @@ def _extract_cost(compiled) -> dict:
     are XLA's spellings ("bytes accessed")."""
     try:
         ca = compiled.cost_analysis()
+    # lint: allow(broad-except) cost analysis is optional telemetry
     except Exception:
         return {}
     if isinstance(ca, (list, tuple)):
@@ -288,6 +292,7 @@ def instrument_jit(name: str, jitted):
                 fn = jitted.lower(*args, **kwargs).compile()
                 compile_ms = (time.perf_counter() - t0) * 1e3
                 ledger.record(name, compile_ms, _extract_cost(fn))
+            # lint: allow(broad-except) degrades to plain jit, ledgered
             except Exception as e:
                 log.debug("AOT compile failed for %s (%s)", name, e)
                 fn = jitted

@@ -235,6 +235,7 @@ class NetlinkDataplane:
         # the C++ pipeline releases the GIL but would still block THIS
         # event loop (which serves every platform RPC) for the whole
         # program — run it on a worker thread
+        # lint: allow(executor-escape) C function; touches no actor state
         return await asyncio.get_running_loop().run_in_executor(
             None,
             openr_tpu_native.bulk_route_op,

@@ -20,6 +20,7 @@ import time
 from typing import Any, Callable, Coroutine, Optional
 
 from openr_tpu.messaging import QueueClosedError
+from openr_tpu.runtime import affinity
 from openr_tpu.runtime.counters import counters
 from openr_tpu.runtime.tasks import record_crash, spawn_logged
 from openr_tpu.runtime.throttle import ExponentialBackoff
@@ -99,6 +100,11 @@ class Actor:
 
     async def start(self) -> None:
         """Override run() for main logic; start() spawns it."""
+        # the loop thread running start() owns this actor's state from
+        # here on (role of the reference's per-module EventBase thread);
+        # guarded operations assert against it when checks are enabled
+        if affinity.enabled():
+            affinity.bind_owner(self, self.name)
         self._running = True
         self.add_task(self._heartbeat_loop(), name=f"{self.name}.heartbeat")
         await self.on_start()
@@ -121,6 +127,7 @@ class Actor:
                 await task
             except (asyncio.CancelledError, QueueClosedError):
                 pass
+            # lint: allow(broad-except) teardown must drain every task
             except Exception:  # pragma: no cover
                 log.exception("%s: task failed during stop", self.name)
         self._tasks.clear()
@@ -136,6 +143,11 @@ class Actor:
     ) -> asyncio.Task:
         """Role of OpenrEventBase::addFiberTask. QueueClosedError and
         cancellation terminate the task quietly (shutdown path)."""
+        # spawning a fiber mutates _tasks and schedules onto the owning
+        # loop — a cross-thread add_task would race both (use
+        # call_soon_threadsafe from other threads)
+        if affinity.enabled():
+            affinity.assert_owner(self, "add_task")
 
         async def runner():
             try:
@@ -231,6 +243,11 @@ class Actor:
                 try:
                     await self.on_fiber_restart(name)
                 except Exception:
+                    # the restart still proceeds — a broken recovery
+                    # hook must not wedge the supervisor loop
+                    counters.increment(
+                        "runtime.supervisor.recovery_errors"
+                    )
                     log.exception(
                         "%s: recovery hook failed for fiber %s",
                         self.name, name,
@@ -264,6 +281,7 @@ class Actor:
                         },
                     )
                 )
+            # lint: allow(broad-except) best-effort telemetry only
             except Exception:  # pragma: no cover - telemetry must not kill
                 log.debug("%s: restart log sample failed", self.name)
         try:
@@ -278,6 +296,7 @@ class Actor:
             )
             if ctx is not None:
                 tracer.end_trace(ctx, status="supervisor_restart")
+        # lint: allow(broad-except) best-effort telemetry only
         except Exception:  # pragma: no cover
             log.debug("%s: restart span failed", self.name)
 

@@ -1,0 +1,108 @@
+"""Thread-ownership sentinel — a Python TSan-lite for the actor model.
+
+The reference OpenR gets actor isolation by construction: each module
+owns a folly::EventBase thread (PAPER.md §Threading) and the framework
+makes cross-thread state access hard. Our port enforces the same
+single-writer discipline by convention only — `dispatch_route_db`
+documents "must run on the owning thread" but nothing checks it, and
+one silent cross-thread touch of `prev_dist`/drain-journal state
+corrupts routes without crashing.
+
+This module turns the convention into a checkable invariant:
+
+  - `bind_owner(obj)` records the current thread as `obj`'s owner
+    (actors bind at start(); the solver binds on first dispatch).
+  - `assert_owner(obj, what)` raises `AffinityViolation` (and bumps
+    `runtime.affinity.violations`) when called from any other thread.
+  - `executor_safe(fn)` marks a callable as reviewed-safe to run off
+    the owning thread (e.g. `TpuSpfSolver.collect_route_db`, which by
+    contract touches only device buffers and the pending snapshot).
+    The static checker (`tools/lint/affinity.py`) reads the decorator
+    to exempt those targets from its executor-escape rule.
+
+Default OFF: every guard site is behind `if affinity.enabled():`, so
+the disabled cost is one module-global bool read — nothing measurable
+on the dispatch path. CI turns it on in the unit-test and chaos lanes
+via `OPENR_TPU_AFFINITY_CHECKS=1` (or `runtime_config.affinity_checks`
+for a deployed debug daemon), so latent races fail loudly where a
+human is watching instead of corrupting routes in production.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, TypeVar
+
+from openr_tpu.runtime.counters import counters
+
+_ENV = "OPENR_TPU_AFFINITY_CHECKS"
+_TRUTHY = ("1", "true", "on", "yes")
+
+_enabled = os.environ.get(_ENV, "").strip().lower() in _TRUTHY
+
+F = TypeVar("F", bound=Callable)
+
+
+class AffinityViolation(AssertionError):
+    """Guarded actor state was touched from a non-owning thread."""
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(on: bool) -> None:
+    """Config hook (runtime_config.affinity_checks); the env var
+    `OPENR_TPU_AFFINITY_CHECKS` seeds the initial value so test lanes
+    can enable it without plumbing config."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def executor_safe(fn: F) -> F:
+    """Mark `fn` as reviewed-safe to run off its object's owning thread.
+
+    Purely declarative — no runtime wrapping, so the decorated function
+    costs nothing. The static affinity checker collects the decorated
+    names and exempts them from the executor-escape rule; everything
+    else handed to `run_in_executor`/`Executor.submit`/`Thread(target=)`
+    must carry a `# lint: allow(executor-escape) <reason>` pragma or an
+    allowlist entry.
+    """
+    fn.__executor_safe__ = True
+    return fn
+
+
+def bind_owner(obj: Any, name: str = "") -> None:
+    """Record the calling thread as `obj`'s owner (re-binding is
+    allowed: a supervised restart or a test re-running an actor on a
+    fresh loop re-claims ownership from the new thread)."""
+    if not _enabled:
+        return
+    obj.__dict__["_affinity_ident"] = threading.get_ident()
+    obj.__dict__["_affinity_owner"] = name or type(obj).__name__
+
+
+def assert_owner(obj: Any, what: str = "") -> None:
+    """Raise AffinityViolation if the calling thread is not `obj`'s
+    owner. First touch binds (so objects created on one thread and
+    handed to their owner before use — the main.py construction
+    pattern — claim ownership at the first guarded operation)."""
+    if not _enabled:
+        return
+    ident = obj.__dict__.get("_affinity_ident")
+    if ident is None:
+        bind_owner(obj)
+        return
+    cur = threading.get_ident()
+    if cur != ident:
+        counters.increment("runtime.affinity.violations")
+        owner = obj.__dict__.get("_affinity_owner", type(obj).__name__)
+        cur_name = threading.current_thread().name
+        raise AffinityViolation(
+            f"{owner}.{what or '<state>'}: touched from thread "
+            f"{cur_name!r} (ident {cur}) but owned by ident {ident} — "
+            f"route cross-actor access through ReplicateQueue / "
+            f"call_soon_threadsafe / the dispatch-collect split"
+        )

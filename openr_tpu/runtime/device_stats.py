@@ -65,6 +65,7 @@ def _jax(allow_import: bool):
             import jax
 
             return jax
+        # lint: allow(broad-except) stats degrade to "unavailable"
         except Exception:  # pragma: no cover - jax is baked into the image
             return None
     return sys.modules.get("jax")
@@ -83,6 +84,7 @@ def collect_device_stats(allow_import: bool = False) -> dict:
     try:
         backend = jax.default_backend()
         devices = jax.local_devices()
+    # lint: allow(broad-except) failure surfaced in the returned payload
     except Exception as e:  # pragma: no cover - backend init failure
         return {"backend": "error", "error": str(e), "devices": []}
     out: dict = {"backend": backend, "devices": []}
@@ -90,6 +92,7 @@ def collect_device_stats(allow_import: bool = False) -> dict:
         entry: dict = {"id": i, "platform": getattr(dev, "platform", backend)}
         try:
             ms = dev.memory_stats()
+        # lint: allow(broad-except) CPU backends have no HBM accounting
         except Exception:
             ms = None
         if ms:
@@ -113,6 +116,7 @@ def live_buffer_census(allow_import: bool = False) -> dict:
         return {"count": 0, "bytes": 0, "pools": {}, "other_bytes": 0}
     try:
         arrays = jax.live_arrays()
+    # lint: allow(broad-except) census degrades to empty, never crashes
     except Exception:
         arrays = []
     total_n, total_b = 0, 0
@@ -129,6 +133,7 @@ def live_buffer_census(allow_import: bool = False) -> dict:
             for a in fn():
                 n += 1
                 b += int(getattr(a, "nbytes", 0) or 0)
+        # lint: allow(broad-except) torn-down pool reads as empty
         except Exception:
             pass  # a torn-down pool reads as empty, not as a crash
         pools_out[name] = {"count": n, "bytes": b}

@@ -181,6 +181,7 @@ class Monitor(Actor):
                     # already imported it (device_stats._jax)
                     device_stats.export_device_gauges()
                 except Exception:
+                    counters.increment("monitor.device_poll_errors")
                     log.debug("device gauge export failed", exc_info=True)
             await asyncio.sleep(self._interval_s)
 
@@ -276,6 +277,7 @@ class Monitor(Actor):
             try:
                 await self._advertise_health(interval_s)
             except Exception:
+                counters.increment("monitor.health_advert_errors")
                 log.debug("fleet health advertisement failed", exc_info=True)
 
     async def _advertise_health(self, interval_s: float) -> None:

@@ -26,6 +26,7 @@ import json
 import logging
 from typing import Any, Awaitable, Callable, Optional
 
+from openr_tpu.runtime.counters import counters
 from openr_tpu.runtime.faults import maybe_fail
 
 log = logging.getLogger(__name__)
@@ -154,6 +155,7 @@ class RpcServer:
             except Exception:
                 # cancellation is the expected path; anything else is a
                 # real teardown bug — surface it instead of masking
+                counters.increment("rpc.teardown_errors")
                 log.warning(
                     "%s: connection handler failed during stop",
                     self.name, exc_info=True,
@@ -209,6 +211,7 @@ class RpcServer:
             writer.close()
             try:
                 await writer.wait_closed()
+            # lint: allow(broad-except) peer already gone during close
             except Exception:
                 pass
 
@@ -310,7 +313,9 @@ class RpcClient:
                     timeout_s,
                 )
             except (OSError, asyncio.TimeoutError) as e:
-                raise RpcConnectionError(f"{self.name}: connect failed: {e}")
+                raise RpcConnectionError(
+                    f"{self.name}: connect failed: {e}"
+                ) from e
             if self.expected_peer and self.ssl is None:
                 # fail closed: a pin without TLS would silently yield an
                 # unverified plaintext connection the caller believes is
@@ -348,6 +353,7 @@ class RpcClient:
                 except asyncio.CancelledError:
                     pass
                 except Exception:
+                    counters.increment("rpc.teardown_errors")
                     log.warning(
                         "%s: read loop failed during close",
                         self.name, exc_info=True,
@@ -426,12 +432,16 @@ class RpcClient:
         except (ConnectionResetError, BrokenPipeError, AttributeError) as e:
             self._pending.pop(req_id, None)
             self._teardown(RpcConnectionError(f"{self.name}: send failed"))
-            raise RpcConnectionError(f"{self.name}: send failed: {e}")
+            raise RpcConnectionError(
+                f"{self.name}: send failed: {e}"
+            ) from e
         try:
             return await asyncio.wait_for(fut, timeout_s)
-        except asyncio.TimeoutError:
+        except asyncio.TimeoutError as e:
             self._pending.pop(req_id, None)
-            raise RpcConnectionError(f"{self.name}: {method} timed out")
+            raise RpcConnectionError(
+                f"{self.name}: {method} timed out"
+            ) from e
 
     async def subscribe(
         self, method: str, params: Optional[dict] = None
@@ -452,5 +462,7 @@ class RpcClient:
         except (ConnectionResetError, BrokenPipeError, AttributeError) as e:
             self._stream_queues.pop(req_id, None)
             self._teardown(RpcConnectionError(f"{self.name}: send failed"))
-            raise RpcConnectionError(f"{self.name}: subscribe failed: {e}")
+            raise RpcConnectionError(
+                f"{self.name}: subscribe failed: {e}"
+            ) from e
         return q

@@ -39,6 +39,7 @@ def record_crash(task_name: str, exc: BaseException) -> None:
         return
     try:
         exc._openr_crash_recorded = True  # type: ignore[attr-defined]
+    # lint: allow(broad-except) __slots__ exceptions reject the marker
     except Exception:
         pass  # exceptions with __slots__; double-count is the worst case
     from openr_tpu.runtime.counters import counters

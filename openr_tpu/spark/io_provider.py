@@ -177,6 +177,7 @@ class UdpIoProvider(IoProvider):
             def datagram_received(self, data: bytes, addr) -> None:
                 try:
                     packet = deserialize(data, SparkPacket)
+                # lint: allow(broad-except) garbage datagrams are normal
                 except Exception:
                     return
                 inbox.put_nowait(

@@ -345,6 +345,9 @@ class Spark(Actor):
                 elif pkt.packet.heartbeat is not None:
                     self._process_heartbeat(pkt)
             except Exception:
+                # one malformed/hostile packet must not kill the recv
+                # fiber, but it must not vanish either
+                counters.increment("spark.packet_process_errors")
                 log.exception("%s: error processing packet", self.name)
 
     def _rate_limit_ok(self, sender: str) -> bool:

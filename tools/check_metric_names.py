@@ -1,97 +1,50 @@
 #!/usr/bin/env python3
-"""Lint-time guard for the OpenMetrics exposition endpoint.
+"""Thin shim — the checker moved into the lint framework.
 
-`normalize_metric_name` (runtime/metrics_export.py) maps the fabric's
-dotted counter names onto Prometheus identifiers by rewriting every
-invalid byte to `_`. That mapping is total but not injective — `a.b`
-and `a_b` both become `openr_tpu_a_b` — so a collision would make the
-endpoint silently drop one family. This checker walks the source for
-every counter/stat name the code can emit and fails the lint lane when
-
-  - any name normalizes to an invalid exposition identifier, or
-  - two DIFFERENT raw names normalize to the SAME identifier, or
-  - a stat's derived families (`<stat>_sum/_count/_max/_truncated`)
-    collide with an explicitly-bumped counter.
-
-Dynamic name segments (f-string placeholders like
-`kvstore.{node}.sent_messages`) are abstracted to a fixed token — two
-call sites with the same shape are one family; runtime-value collisions
-are out of static reach and accepted.
+The real implementation is `tools/lint/metric_names.py`, run as part
+of `python -m tools.lint` (see docs/StaticAnalysis.md). This path is
+kept so existing docs, muscle memory, and any out-of-tree CI config
+keep working; it preserves the old CLI, exit-code contract, and the
+`collect(package_dir)` / `check(counters, stats)` module API.
 
 Usage: python tools/check_metric_names.py [package_dir]
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO_ROOT))
 
+from tools.lint import metric_names as _mn  # noqa: E402
+from tools.lint.core import Project  # noqa: E402
 from openr_tpu.runtime.metrics_export import (  # noqa: E402
     is_valid_metric_name,
     normalize_metric_name,
 )
 
-# CounterRegistry write methods whose first argument names a family
-COUNTER_METHODS = {"increment", "set_counter"}
-STAT_METHODS = {"add_stat_value"}
-# what one stat family expands to in the exposition
-STAT_SUFFIXES = ("", "_sum", "_count", "_max", "_truncated")
-PLACEHOLDER = "X"
+STAT_SUFFIXES = _mn.STAT_SUFFIXES
 
 
-def _name_of(node: ast.AST) -> str | None:
-    """First-argument metric name, with f-string fields abstracted."""
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return node.value
-    if isinstance(node, ast.JoinedStr):
-        parts = []
-        for piece in node.values:
-            if isinstance(piece, ast.Constant):
-                parts.append(str(piece.value))
-            else:
-                parts.append(PLACEHOLDER)
-        return "".join(parts)
-    return None  # computed name (variable); not statically checkable
+def collect(package_dir) -> tuple[dict, dict, list]:
+    """Old API: walk `package_dir` -> ({counter name: "file:line"},
+    same for stats, parse-error strings)."""
+    rel = Path(package_dir).resolve().relative_to(REPO_ROOT).as_posix()
+    project = Project(REPO_ROOT, [rel])
+    counters, stats = _mn.collect(project)
+
+    def sites(bucket: dict) -> dict:
+        return {
+            name: f"{r}:{line}" for name, (r, line, _scope) in bucket.items()
+        }
+
+    return sites(counters), sites(stats), list(project.parse_errors)
 
 
-def collect(package_dir: Path) -> tuple[dict, dict, list]:
-    """-> ({raw counter name: site}, {raw stat name: site}, errors)."""
-    counter_names: dict[str, str] = {}
-    stat_names: dict[str, str] = {}
-    errors: list[str] = []
-    for path in sorted(package_dir.rglob("*.py")):
-        try:
-            tree = ast.parse(path.read_text(), filename=str(path))
-        except SyntaxError as e:
-            errors.append(f"{path}: unparseable: {e}")
-            continue
-        rel = path.relative_to(package_dir.parent)
-        for node in ast.walk(tree):
-            if not (
-                isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.args
-            ):
-                continue
-            method = node.func.attr
-            if method in COUNTER_METHODS:
-                bucket = counter_names
-            elif method in STAT_METHODS:
-                bucket = stat_names
-            else:
-                continue
-            raw = _name_of(node.args[0])
-            if raw is None:
-                continue
-            bucket.setdefault(raw, f"{rel}:{node.lineno}")
-    return counter_names, stat_names, errors
-
-
-def check(counter_names: dict, stat_names: dict) -> list[str]:
+def check(counter_names: dict, stat_names: dict) -> list:
+    """Old API: name -> site maps in, error strings out."""
     errors: list[str] = []
     # exposition family -> (raw name, site); stats expand to their
     # derived families so `a.b` (stat) vs `a.b_max` (counter) is caught
@@ -107,8 +60,8 @@ def check(counter_names: dict, stat_names: dict) -> list[str]:
         prev = families.get(family)
         if prev is not None and prev[0] != raw:
             errors.append(
-                f"{site}: metric {raw!r} collides with {prev[0]!r} "
-                f"({prev[1]}) — both normalize to {family!r}"
+                f"{site}: metric {raw!r} and {prev[0]!r} ({prev[1]}) "
+                f"collide — both normalize to {family!r}"
             )
             return
         families.setdefault(family, (raw, site))
@@ -123,16 +76,21 @@ def check(counter_names: dict, stat_names: dict) -> list[str]:
 
 
 def main(argv: list[str]) -> int:
-    package_dir = Path(argv[1]) if len(argv) > 1 else REPO_ROOT / "openr_tpu"
-    counter_names, stat_names, errors = collect(package_dir)
-    errors += check(counter_names, stat_names)
-    if errors:
-        for err in errors:
-            print(f"check_metric_names: {err}", file=sys.stderr)
+    package = "openr_tpu"
+    if len(argv) > 1:
+        package = Path(argv[1]).resolve().relative_to(REPO_ROOT).as_posix()
+    project = Project(REPO_ROOT, [package])
+    findings = _mn.run(project)
+    for err in project.parse_errors:
+        print(f"check_metric_names: {err}", file=sys.stderr)
+    for fd in findings:
+        print(f"check_metric_names: {fd.render()}", file=sys.stderr)
+    if findings or project.parse_errors:
         return 1
+    counters, stats = _mn.collect(project)
     print(
-        f"check_metric_names: OK — {len(counter_names)} counter and "
-        f"{len(stat_names)} stat families normalize cleanly"
+        f"check_metric_names: OK — {len(counters)} counter and "
+        f"{len(stats)} stat families normalize cleanly"
     )
     return 0
 

@@ -1,0 +1,5 @@
+"""Static-analysis suite for the openr_tpu actor/trace invariants.
+
+Run `python -m tools.lint` (or `--all` to add ruff) — see
+docs/StaticAnalysis.md for the checker catalog and suppression format.
+"""

@@ -1,0 +1,124 @@
+"""Entry point: `python -m tools.lint [--all] [--checker NAME ...]`.
+
+Runs the five project checkers over `openr_tpu/` (exit 1 on any
+unsuppressed finding); `--all` additionally shells out to ruff when it
+is installed (the CI lint lane installs it; a dev box without ruff
+gets a skip note, not a failure, since the container image is fixed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+from tools.lint import affinity, blocking, excepts, metric_names, purity
+from tools.lint.core import (
+    DEFAULT_ALLOWLIST,
+    REPO_ROOT,
+    Allowlist,
+    Project,
+    apply_suppressions,
+)
+
+CHECKERS = {
+    "affinity": affinity.run,
+    "purity": purity.run,
+    "blocking": blocking.run,
+    "excepts": excepts.run,
+    "metric-names": metric_names.run,
+}
+
+
+def _run_ruff() -> int | None:
+    """Exit code, or None when ruff isn't installed (skip, not fail)."""
+    if shutil.which("ruff") is None:
+        print(
+            "tools.lint: ruff not installed — skipping ruff lane "
+            "(CI installs it; config lives in pyproject.toml)"
+        )
+        return None
+    proc = subprocess.run(
+        ["ruff", "check", "openr_tpu/", "tools/", "tests/"],
+        cwd=REPO_ROOT,
+    )
+    return proc.returncode
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m tools.lint")
+    ap.add_argument(
+        "--checker", action="append", choices=sorted(CHECKERS),
+        help="run only the named checker(s); default: all five",
+    )
+    ap.add_argument(
+        "--all", action="store_true",
+        help="also run ruff (the full CI lint lane)",
+    )
+    ap.add_argument(
+        "--allowlist", type=Path, default=DEFAULT_ALLOWLIST,
+        help="allowlist JSON path (default tools/lint/allowlist.json)",
+    )
+    ap.add_argument(
+        "--package", default="openr_tpu",
+        help="package directory to scan (default openr_tpu)",
+    )
+    args = ap.parse_args(argv)
+
+    project = Project(REPO_ROOT, [args.package])
+    allowlist = Allowlist.load(args.allowlist)
+
+    failures = 0
+    for err in project.parse_errors:
+        print(f"tools.lint: {err}", file=sys.stderr)
+        failures += 1
+    for err in allowlist.errors:
+        print(f"tools.lint: {err}", file=sys.stderr)
+        failures += 1
+
+    selected = args.checker or sorted(CHECKERS)
+    findings = []
+    for name in selected:
+        findings.extend(CHECKERS[name](project))
+    # a pragma without a reason is itself a finding
+    for sf in project.files:
+        findings.extend(sf.pragma_errors)
+
+    remaining = apply_suppressions(findings, project, allowlist)
+    remaining.sort(key=lambda f: (f.path, f.line, f.code))
+    for fd in remaining:
+        print(fd.render(), file=sys.stderr)
+    failures += len(remaining)
+
+    # stale allowlist entries rot into blanket permission — warn loudly
+    # (only when every checker ran; a partial run can't prove staleness)
+    if not args.checker:
+        for key in allowlist.unused():
+            print(f"tools.lint: WARNING unused allowlist entry: {key}")
+
+    ruff_ran = False
+    if args.all:
+        rc = _run_ruff()
+        ruff_ran = rc is not None
+        if ruff_ran and rc != 0:
+            failures += 1
+
+    checked = "+".join(selected) + ("+ruff" if ruff_ran else "")
+    if failures:
+        print(
+            f"tools.lint: FAIL — {failures} problem(s) [{checked}] "
+            f"(suppress with `# lint: allow(<code>) <reason>` or an "
+            f"allowlist entry; see docs/StaticAnalysis.md)",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"tools.lint: OK — {len(project.files)} files clean [{checked}]"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

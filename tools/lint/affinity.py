@@ -1,0 +1,259 @@
+"""Actor-affinity checker (`executor-escape`, `cross-actor-write`).
+
+The concurrency model gives every actor a single writer: its own
+fibers on the shared loop. State leaves that protection exactly when a
+callable escapes to another thread — `loop.run_in_executor(...)`,
+`Executor.submit(...)`, `threading.Thread(target=...)`. This checker
+makes every such escape an explicit, reviewed decision:
+
+`executor-escape` — flags an escape whose target can reach actor/solver
+state, i.e. a bound method (`self.x`, `obj.attr`) or a closure defined
+inside the enclosing function (captures `self`/locals). Exempt:
+
+  - targets whose terminal name carries `@affinity.executor_safe`
+    anywhere in the project (e.g. `TpuSpfSolver.collect_route_db`,
+    which by contract reads no LSDB state),
+  - plain module-level functions and imported callables (no implicit
+    path to actor state; they manage their own locking),
+  - escapes with a `# lint: allow(executor-escape) <reason>` pragma or
+    an allowlist entry — the reason documents WHY the target is safe
+    off-thread (single-worker pool serialization, device-buffer-only
+    reads, ...).
+
+`cross-actor-write` — flags `self.<actor_attr>.<field> = ...`
+assignments where `<actor_attr>` holds an Actor instance (inferred
+from `self.X = <param>` bindings whose class is an Actor subclass
+name, case-normalized). Writing another actor's state directly — from
+a ctrl handler or a sibling actor — bypasses the single-writer
+discipline; route it through ReplicateQueue or an async request
+method.
+
+The runtime half lives in `openr_tpu/runtime/affinity.py`: what this
+checker can't see statically (which thread actually runs a guarded
+write), the sentinel asserts at runtime in the CI test+chaos lanes.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tools.lint.core import Finding, Project, SourceFile
+
+CODE_ESCAPE = "executor-escape"
+CODE_XWRITE = "cross-actor-write"
+
+_SUBMIT_ATTRS = {"submit", "run_in_executor"}
+
+
+def _escape_target(node: ast.Call) -> Optional[ast.AST]:
+    """The callable a call-site hands to another thread, or None."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and fn.attr == "run_in_executor":
+        # loop.run_in_executor(executor, fn, *args)
+        if len(node.args) >= 2:
+            return node.args[1]
+    elif isinstance(fn, ast.Attribute) and fn.attr == "submit":
+        if node.args:
+            return node.args[0]
+    elif (
+        isinstance(fn, ast.Attribute)
+        and fn.attr == "Thread"
+        or isinstance(fn, ast.Name)
+        and fn.id == "Thread"
+    ):
+        for kw in node.keywords:
+            if kw.arg == "target":
+                return kw.value
+    return None
+
+
+def _binds_name(target: ast.AST, name: str) -> bool:
+    for node in ast.walk(target):
+        if isinstance(node, ast.Name) and node.id == name:
+            return True
+    return False
+
+
+def _mentions_self(expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and node.id == "self":
+            return True
+    return False
+
+
+def _self_derived(enclosing: ast.AST, name: str) -> bool:
+    """True when `name` was bound (assignment or loop unpack) in
+    `enclosing` from an expression involving `self` — a factory-made
+    closure (`prepare = self._dispatch_one(pv)`, or `for pv, prepare
+    in self._dispatch_fused(group):`)."""
+    for node in ast.walk(enclosing):
+        if isinstance(node, ast.Assign):
+            if any(_binds_name(t, name) for t in node.targets):
+                if _mentions_self(node.value):
+                    return True
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            if _binds_name(node.target, name) and _mentions_self(
+                node.iter
+            ):
+                return True
+    return False
+
+
+class _FuncIndex(ast.NodeVisitor):
+    """Per-file map of function-def nesting: name -> is it defined at
+    module/class level (False) or nested inside another function (True)."""
+
+    def __init__(self):
+        self.nested: set[int] = set()  # id() of nested FunctionDef nodes
+        self._depth = 0
+        # (enclosing function node id, local def name) pairs
+        self.local_defs: dict[tuple[int, str], ast.AST] = {}
+        self._stack: list[ast.AST] = []
+
+    def _visit_def(self, node) -> None:
+        if self._stack:
+            self.local_defs[(id(self._stack[-1]), node.name)] = node
+            self.nested.add(id(node))
+        self._stack.append(node)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_def
+    visit_AsyncFunctionDef = _visit_def
+
+
+def _check_escapes(
+    sf: SourceFile, project: Project, findings: list[Finding]
+) -> None:
+    idx = _FuncIndex()
+    idx.visit(sf.tree)
+
+    def walk(node: ast.AST, enclosing: Optional[ast.AST]) -> None:
+        for child in ast.iter_child_nodes(node):
+            enc = child if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) else enclosing
+            if isinstance(child, ast.Call):
+                target = _escape_target(child)
+                if target is not None:
+                    _judge(child, target, enclosing)
+            walk(child, enc)
+
+    def _judge(
+        call: ast.Call, target: ast.AST, enclosing: Optional[ast.AST]
+    ) -> None:
+        detail: Optional[str] = None
+        reach = None  # why the target can reach owned state
+        if isinstance(target, ast.Attribute):
+            # bound method (self.x, obj.attr): state travels with it —
+            # unless the terminal name is marked @executor_safe
+            if target.attr in project.executor_safe_names:
+                return
+            detail = ast.unparse(target)
+            reach = "a bound method carries its object's state"
+        elif isinstance(target, ast.Lambda):
+            detail = "<lambda>"
+            reach = "a lambda captures the enclosing frame"
+        elif isinstance(target, ast.Name) and enclosing is not None:
+            if target.id in project.executor_safe_names:
+                return
+            # a closure defined inside this function captures locals;
+            # plain module-level functions resolve no enclosing frame
+            # and are not flagged
+            if (id(enclosing), target.id) in idx.local_defs:
+                detail = target.id
+                reach = "a nested closure captures enclosing locals"
+            elif _self_derived(enclosing, target.id):
+                # prepare = self._dispatch_one(pv) — the factory bakes
+                # solver state into the closure it returns
+                detail = target.id
+                reach = (
+                    "a closure built by a self method carries that "
+                    "object's state"
+                )
+        if detail is None:
+            return
+        findings.append(Finding(
+            sf.rel, call.lineno, CODE_ESCAPE,
+            sf.scope_at(call.lineno), detail,
+            f"`{detail}` escapes to another thread "
+            f"({ast.unparse(call.func)}) — {reach}; mark the target "
+            f"@affinity.executor_safe after review, or pragma/allowlist "
+            f"with the reason it is safe off the owning thread",
+        ))
+
+    walk(sf.tree, None)
+
+
+def _actor_attrs_of_class(
+    cls: ast.ClassDef, actor_classes: set[str]
+) -> set[str]:
+    """Attribute names bound in __init__ from parameters whose names
+    case-normalize to a known Actor subclass (self.decision = decision)."""
+    norm_actors = {c.lower().replace("_", "") for c in actor_classes}
+    attrs: set[str] = set()
+    for node in cls.body:
+        if not (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name == "__init__"
+        ):
+            continue
+        for stmt in ast.walk(node):
+            if not isinstance(stmt, ast.Assign):
+                continue
+            if not isinstance(stmt.value, ast.Name):
+                continue
+            src = stmt.value.id.lower().replace("_", "")
+            if src not in norm_actors:
+                continue
+            for tgt in stmt.targets:
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    attrs.add(tgt.attr)
+    return attrs
+
+
+def _check_cross_writes(
+    sf: SourceFile, project: Project, findings: list[Finding]
+) -> None:
+    for cls in ast.walk(sf.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        actor_attrs = _actor_attrs_of_class(cls, project.actor_classes)
+        if not actor_attrs:
+            continue
+        for node in ast.walk(cls):
+            targets: list[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets = list(node.targets)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for tgt in targets:
+                if not (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Attribute)
+                    and isinstance(tgt.value.value, ast.Name)
+                    and tgt.value.value.id == "self"
+                    and tgt.value.attr in actor_attrs
+                ):
+                    continue
+                detail = f"{tgt.value.attr}.{tgt.attr}"
+                findings.append(Finding(
+                    sf.rel, node.lineno, CODE_XWRITE,
+                    sf.scope_at(node.lineno), detail,
+                    f"direct write to another actor's state "
+                    f"`self.{detail}` — route it through ReplicateQueue "
+                    f"or an async request method on the owning actor",
+                ))
+
+
+def run(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in project.files:
+        _check_escapes(sf, project, findings)
+        _check_cross_writes(sf, project, findings)
+    return findings

@@ -1,0 +1,136 @@
+"""Blocking-in-fiber checker (`blocking-call`).
+
+Every actor fiber shares ONE asyncio event loop (runtime/actor.py), so
+a synchronous block inside any `async def` stalls every module at once
+— the reference's per-module EventBase threads would only stall one.
+Flagged inside async function bodies (nested synchronous `def`s are
+excluded — they run wherever they're called, typically an executor):
+
+  - `time.sleep(...)` — use `asyncio.sleep`
+  - `<fut>.result()` / `<fut>.exception()` on concurrent futures —
+    await it, or drain it in an executor
+  - synchronous socket I/O (`socket.socket(...)` construction plus
+    `.recv/.accept/.connect/...` calls) — use loop transports/executors
+  - a direct `collect_route_db(...)` call — the ONE blocking host sync
+    of a solve; the dispatch-collect split exists precisely so this
+    runs via `run_in_executor` (decision.py's `_solve_full_async`)
+
+Handing the bound method itself to an executor
+(`run_in_executor(None, self.solver.collect_route_db, build)`) is not
+a call and is not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.core import Finding, Project
+
+CODE = "blocking-call"
+
+# NOTE: "sendto" is deliberately absent — asyncio's DatagramTransport
+# exposes a non-blocking sendto(), so the name alone can't distinguish
+# the sync-socket case (io_provider.py's transports would all flag)
+_SOCKET_IO = {
+    "recv", "recvfrom", "recv_into", "recvmsg", "sendall",
+    "accept", "connect", "makefile",
+}
+
+
+def _call_repr(fn: ast.AST) -> str:
+    try:
+        return ast.unparse(fn)
+    except Exception:  # pragma: no cover - unparse of exotic nodes
+        return "<call>"
+
+
+class _AsyncBodyVisitor(ast.NodeVisitor):
+    """Collects blocking calls lexically inside async defs, skipping
+    nested synchronous defs (they execute off-loop by construction)."""
+
+    def __init__(self, sf, findings: list[Finding]):
+        self.sf = sf
+        self.findings = findings
+        self.async_depth = 0
+        # id()s of Call nodes directly under an `await` — an awaited
+        # coroutine method (await self.connect(), await self.io.recv())
+        # is the non-blocking pattern, not a sync call
+        self._awaited: set[int] = set()
+
+    def visit_Await(self, node: ast.Await) -> None:
+        if isinstance(node.value, ast.Call):
+            self._awaited.add(id(node.value))
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self.async_depth += 1
+        self.generic_visit(node)
+        self.async_depth -= 1
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        saved = self.async_depth
+        self.async_depth = 0
+        self.generic_visit(node)
+        self.async_depth = saved
+
+    visit_Lambda = visit_FunctionDef  # type: ignore[assignment]
+
+    def _flag(self, node: ast.Call, detail: str, why: str) -> None:
+        self.findings.append(Finding(
+            self.sf.rel, node.lineno, CODE,
+            self.sf.scope_at(node.lineno), detail,
+            f"blocking call `{_call_repr(node.func)}` inside an async "
+            f"fiber — {why}",
+        ))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.async_depth > 0 and id(node) not in self._awaited:
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "time"
+                and fn.attr == "sleep"
+            ):
+                self._flag(node, "time.sleep", "use asyncio.sleep")
+            elif isinstance(fn, ast.Attribute) and fn.attr in (
+                "result", "exception"
+            ) and not node.args and not node.keywords:
+                self._flag(
+                    node, f"{fn.attr}()",
+                    "await the future or drain it in an executor",
+                )
+            elif isinstance(fn, ast.Attribute) and fn.attr in _SOCKET_IO:
+                self._flag(
+                    node, fn.attr,
+                    "sync socket I/O stalls every actor — use loop "
+                    "transports or an executor",
+                )
+            elif (
+                isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "socket"
+                and fn.attr == "socket"
+            ):
+                self._flag(
+                    node, "socket.socket",
+                    "sync socket construction in a fiber — use loop "
+                    "transports",
+                )
+            elif (
+                isinstance(fn, ast.Attribute)
+                and fn.attr == "collect_route_db"
+            ):
+                self._flag(
+                    node, "collect_route_db",
+                    "the one blocking host sync of a solve must run "
+                    "via run_in_executor (dispatch-collect split)",
+                )
+        self.generic_visit(node)
+
+
+def run(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in project.files:
+        _AsyncBodyVisitor(sf, findings).visit(sf.tree)
+    return findings

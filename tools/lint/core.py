@@ -1,0 +1,262 @@
+"""Shared machinery for the `tools.lint` checkers.
+
+One parsed-AST pass over the package feeds all five checkers:
+
+  - `SourceFile` — path, text, AST, per-line suppression pragmas, and a
+    line -> enclosing-scope (dotted qualname) map.
+  - `Project` — the file set plus cross-file indexes the checkers need
+    (Actor subclasses, `@executor_safe` names).
+  - `Allowlist` — the JSON baseline for findings that are intentional
+    but don't warrant an inline pragma. Keys are line-number-free
+    (`path::scope::code::detail`) so routine edits don't churn them.
+
+Suppression, in priority order:
+
+  1. inline pragma on the flagged line or the line above:
+         # lint: allow(<code>) <reason — mandatory>
+     (`# noqa: BLE001 — reason` is also honored for `broad-except`,
+     matching ruff's vocabulary for pre-existing annotations)
+  2. an allowlist entry in `tools/lint/allowlist.json` with a reason.
+
+Both forms REQUIRE a reason string; a bare pragma is itself a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Optional
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+DEFAULT_ALLOWLIST = Path(__file__).resolve().parent / "allowlist.json"
+
+# `# lint: allow(code-a, code-b) reason...`
+_PRAGMA_RE = re.compile(
+    r"#\s*lint:\s*allow\(([A-Za-z0-9_,\- ]+)\)\s*(.*)$"
+)
+# existing ruff-vocabulary annotations count for broad-except
+_NOQA_BLE_RE = re.compile(r"#\s*noqa:\s*BLE001\b\s*[-—–:]*\s*(.*)$")
+
+
+@dataclass
+class Finding:
+    path: str  # repo-relative, forward slashes
+    line: int
+    code: str
+    scope: str  # dotted qualname of enclosing def/class, or <module>
+    detail: str  # stable short token (callable name, metric name, ...)
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.path}::{self.scope}::{self.code}::{self.detail}"
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}: [{self.code}] {self.message}\n"
+            f"    scope={self.scope}  allowlist-key={self.key}"
+        )
+
+
+class SourceFile:
+    def __init__(self, path: Path, root: Path):
+        self.path = path
+        self.rel = path.relative_to(root).as_posix()
+        self.text = path.read_text()
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=str(path))
+        # (qualname, start, end) intervals, innermost match wins —
+        # built first: the pragma scan attributes bare-pragma findings
+        # to their enclosing scope
+        self._scopes: list[tuple[str, int, int]] = []
+        self._build_scopes()
+        # {code: {line numbers where a pragma suppresses that code}}
+        self._pragmas: dict[str, set[int]] = {}
+        self.pragma_errors: list[Finding] = []
+        self._scan_pragmas()
+
+    # -- pragmas -----------------------------------------------------------
+
+    def _scan_pragmas(self) -> None:
+        for i, line in enumerate(self.lines, start=1):
+            m = _PRAGMA_RE.search(line)
+            if m:
+                codes = [c.strip() for c in m.group(1).split(",")]
+                reason = m.group(2).strip()
+                if not reason:
+                    self.pragma_errors.append(Finding(
+                        self.rel, i, "bare-pragma", self.scope_at(i), "",
+                        "lint pragma without a reason string — say why",
+                    ))
+                    continue
+                for code in codes:
+                    if code:
+                        # a pragma covers its own line and the next one
+                        # (annotation-above style)
+                        self._pragmas.setdefault(code, set()).update(
+                            (i, i + 1)
+                        )
+                continue
+            m = _NOQA_BLE_RE.search(line)
+            if m and m.group(1).strip():
+                self._pragmas.setdefault("broad-except", set()).update(
+                    (i, i + 1)
+                )
+
+    def suppressed(self, code: str, line: int) -> bool:
+        return line in self._pragmas.get(code, ())
+
+    # -- scopes ------------------------------------------------------------
+
+    def _build_scopes(self) -> None:
+        def visit(node: ast.AST, stack: list[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+                ):
+                    qual = ".".join(stack + [child.name])
+                    self._scopes.append(
+                        (qual, child.lineno, child.end_lineno or child.lineno)
+                    )
+                    visit(child, stack + [child.name])
+                else:
+                    visit(child, stack)
+
+        visit(self.tree, [])
+
+    def scope_at(self, line: int) -> str:
+        best = "<module>"
+        best_span = None
+        for qual, lo, hi in self._scopes:
+            if lo <= line <= hi:
+                span = hi - lo
+                if best_span is None or span <= best_span:
+                    best, best_span = qual, span
+        return best
+
+
+class Project:
+    """The package file set plus the cross-file indexes checkers share."""
+
+    def __init__(self, root: Path, package_dirs: Iterable[str]):
+        self.root = root
+        self.files: list[SourceFile] = []
+        self.parse_errors: list[str] = []
+        for pkg in package_dirs:
+            base = root / pkg
+            for path in sorted(base.rglob("*.py")):
+                try:
+                    self.files.append(SourceFile(path, root))
+                except (SyntaxError, UnicodeDecodeError) as e:
+                    self.parse_errors.append(f"{path}: unparseable: {e}")
+        # names of classes that (transitively, by name) subclass Actor
+        self.actor_classes: set[str] = self._find_actor_classes()
+        # function/method names carrying @executor_safe anywhere in the
+        # project — name-granular on purpose: the checkers resolve
+        # attributes (`self.solver.collect_route_db`) by terminal name
+        self.executor_safe_names: set[str] = self._find_executor_safe()
+
+    def file(self, rel: str) -> Optional[SourceFile]:
+        for f in self.files:
+            if f.rel == rel:
+                return f
+        return None
+
+    def _find_actor_classes(self) -> set[str]:
+        bases: dict[str, set[str]] = {}
+        for f in self.files:
+            for node in ast.walk(f.tree):
+                if isinstance(node, ast.ClassDef):
+                    names = set()
+                    for b in node.bases:
+                        if isinstance(b, ast.Name):
+                            names.add(b.id)
+                        elif isinstance(b, ast.Attribute):
+                            names.add(b.attr)
+                    bases[node.name] = names
+        actors = {"Actor"}
+        changed = True
+        while changed:
+            changed = False
+            for cls, parents in bases.items():
+                if cls not in actors and parents & actors:
+                    actors.add(cls)
+                    changed = True
+        return actors
+
+    def _find_executor_safe(self) -> set[str]:
+        safe: set[str] = set()
+        for f in self.files:
+            for node in ast.walk(f.tree):
+                if not isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                for dec in node.decorator_list:
+                    name = None
+                    if isinstance(dec, ast.Name):
+                        name = dec.id
+                    elif isinstance(dec, ast.Attribute):
+                        name = dec.attr
+                    if name == "executor_safe":
+                        safe.add(node.name)
+        return safe
+
+
+@dataclass
+class Allowlist:
+    path: Path
+    entries: dict[str, str] = field(default_factory=dict)  # key -> reason
+    used: set[str] = field(default_factory=set)
+    errors: list[str] = field(default_factory=list)
+
+    @classmethod
+    def load(cls, path: Path) -> "Allowlist":
+        al = cls(path=path)
+        if not path.exists():
+            return al
+        data = json.loads(path.read_text())
+        for ent in data.get("entries", []):
+            key = ent.get("key", "")
+            reason = (ent.get("reason") or "").strip()
+            if not key:
+                al.errors.append(f"{path}: entry without a key: {ent!r}")
+                continue
+            if not reason:
+                al.errors.append(
+                    f"{path}: entry {key!r} has no reason — say why"
+                )
+                continue
+            if key in al.entries:
+                al.errors.append(f"{path}: duplicate key {key!r}")
+            al.entries[key] = reason
+        return al
+
+    def matches(self, finding: Finding) -> bool:
+        if finding.key in self.entries:
+            self.used.add(finding.key)
+            return True
+        return False
+
+    def unused(self) -> list[str]:
+        return sorted(set(self.entries) - self.used)
+
+
+def apply_suppressions(
+    findings: list[Finding], project: Project, allowlist: Allowlist
+) -> list[Finding]:
+    """Pragma- and allowlist-filter `findings`; returns what remains."""
+    out = []
+    by_rel = {f.rel: f for f in project.files}
+    for fd in findings:
+        sf = by_rel.get(fd.path)
+        if sf is not None and sf.suppressed(fd.code, fd.line):
+            continue
+        if allowlist.matches(fd):
+            continue
+        out.append(fd)
+    return out

@@ -1,0 +1,80 @@
+"""Exception-hygiene checker (`broad-except`).
+
+The reference daemon's failure policy is "crash loudly, let the
+supervisor restart you" — a swallowed exception is a routing bug that
+presents as silence. Every bare `except:` / `except Exception` /
+`except BaseException` handler must therefore do at least one of:
+
+  - re-raise (a `raise` anywhere in the handler body — conditional
+    re-raise after classification counts),
+  - surface the failure on the metrics plane (`counters.increment`,
+    `counters.set_counter`, `counters.add_stat_value`, or the
+    `record_crash` helper),
+  - carry a `# lint: allow(broad-except) <reason>` pragma (or a
+    pre-existing `# noqa: BLE001 — reason`) explaining why swallowing
+    is the right behavior (teardown paths, best-effort telemetry).
+
+Catching specific exception types is always fine — this checker only
+looks at the broad forms.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.core import Finding, Project
+
+CODE = "broad-except"
+
+_BROAD = {"Exception", "BaseException"}
+_COUNTER_METHODS = {"increment", "set_counter", "add_stat_value"}
+_COUNTER_FUNCS = {"record_crash"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:  # bare except:
+        return True
+    names = []
+    for node in [t] + (list(t.elts) if isinstance(t, ast.Tuple) else []):
+        if isinstance(node, ast.Name):
+            names.append(node.id)
+        elif isinstance(node, ast.Attribute):
+            names.append(node.attr)
+    return bool(set(names) & _BROAD)
+
+
+def _handler_complies(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr in _COUNTER_METHODS:
+                return True
+            if isinstance(fn, ast.Name) and fn.id in _COUNTER_FUNCS:
+                return True
+    return False
+
+
+def run(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in project.files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node):
+                continue
+            if _handler_complies(node):
+                continue
+            caught = (
+                "bare except" if node.type is None
+                else f"except {ast.unparse(node.type)}"
+            )
+            findings.append(Finding(
+                sf.rel, node.lineno, CODE, sf.scope_at(node.lineno),
+                "handler",
+                f"{caught} swallows without re-raise or counter — "
+                f"re-raise, bump a counter, or pragma with a reason",
+            ))
+    return findings

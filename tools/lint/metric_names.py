@@ -1,0 +1,124 @@
+"""Metric-name exposition checker (`metric-collision`, `metric-invalid`).
+
+Port of `tools/check_metric_names.py` onto the shared lint framework
+(the old path remains as a thin shim). `normalize_metric_name`
+(runtime/metrics_export.py) maps dotted counter names onto Prometheus
+identifiers by rewriting every invalid byte to `_`. That mapping is
+total but not injective — `a.b` and `a_b` both become `openr_tpu_a_b` —
+so a collision would make the endpoint silently drop one family. This
+checker walks every counter/stat name the code can emit and flags:
+
+  - a name normalizing to an invalid exposition identifier,
+  - two DIFFERENT raw names normalizing to the SAME identifier,
+  - a stat's derived families (`_sum/_count/_max/_truncated`) colliding
+    with an explicitly-bumped counter.
+
+Dynamic name segments (f-string placeholders like
+`kvstore.{node}.sent_messages`) are abstracted to a fixed token — two
+call sites with the same shape are one family; runtime-value
+collisions are out of static reach and accepted.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from typing import Optional
+
+from tools.lint.core import REPO_ROOT, Finding, Project
+
+CODE_COLLISION = "metric-collision"
+CODE_INVALID = "metric-invalid"
+
+sys.path.insert(0, str(REPO_ROOT))
+
+from openr_tpu.runtime.metrics_export import (  # noqa: E402
+    is_valid_metric_name,
+    normalize_metric_name,
+)
+
+# CounterRegistry write methods whose first argument names a family
+COUNTER_METHODS = {"increment", "set_counter"}
+STAT_METHODS = {"add_stat_value"}
+# what one stat family expands to in the exposition
+STAT_SUFFIXES = ("", "_sum", "_count", "_max", "_truncated")
+PLACEHOLDER = "X"
+
+
+def _name_of(node: ast.AST) -> Optional[str]:
+    """First-argument metric name, with f-string fields abstracted."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for piece in node.values:
+            if isinstance(piece, ast.Constant):
+                parts.append(str(piece.value))
+            else:
+                parts.append(PLACEHOLDER)
+        return "".join(parts)
+    return None  # computed name (variable); not statically checkable
+
+
+def collect(project: Project) -> tuple[dict, dict]:
+    """-> ({raw counter name: (rel, line, scope)}, same for stats)."""
+    counter_names: dict[str, tuple] = {}
+    stat_names: dict[str, tuple] = {}
+    for sf in project.files:
+        for node in ast.walk(sf.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.args
+            ):
+                continue
+            method = node.func.attr
+            if method in COUNTER_METHODS:
+                bucket = counter_names
+            elif method in STAT_METHODS:
+                bucket = stat_names
+            else:
+                continue
+            raw = _name_of(node.args[0])
+            if raw is None:
+                continue
+            bucket.setdefault(
+                raw, (sf.rel, node.lineno, sf.scope_at(node.lineno))
+            )
+    return counter_names, stat_names
+
+
+def run(project: Project) -> list[Finding]:
+    counter_names, stat_names = collect(project)
+    findings: list[Finding] = []
+    # exposition family -> (raw name, site); stats expand to their
+    # derived families so `a.b` (stat) vs `a.b_max` (counter) is caught
+    families: dict[str, tuple[str, tuple]] = {}
+
+    def claim(family: str, raw: str, site: tuple) -> None:
+        rel, line, scope = site
+        if not is_valid_metric_name(family):
+            findings.append(Finding(
+                rel, line, CODE_INVALID, scope, raw,
+                f"metric {raw!r} normalizes to invalid exposition "
+                f"identifier {family!r}",
+            ))
+            return
+        prev = families.get(family)
+        if prev is not None and prev[0] != raw:
+            findings.append(Finding(
+                rel, line, CODE_COLLISION, scope, raw,
+                f"metric {raw!r} collides with {prev[0]!r} "
+                f"({prev[1][0]}:{prev[1][1]}) — both normalize to "
+                f"{family!r}",
+            ))
+            return
+        families.setdefault(family, (raw, site))
+
+    for raw, site in sorted(counter_names.items()):
+        claim(normalize_metric_name(raw), raw, site)
+    for raw, site in sorted(stat_names.items()):
+        base = normalize_metric_name(raw)
+        for suffix in STAT_SUFFIXES:
+            claim(base + suffix, raw, site)
+    return findings

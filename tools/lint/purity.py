@@ -1,0 +1,307 @@
+"""Trace-purity checker (`host-impurity`, `host-sync`, `traced-loop`).
+
+A function traced by XLA runs ONCE at compile time; Python side effects
+inside it silently become trace-time constants (a counter bumps once
+per compile, `time.time()` freezes the compile timestamp into the
+executable) and host syncs (`.item()`, `float(traced)`) serialize the
+async dispatch pipeline. The checker:
+
+1. Collects the traced ROOTS in `ops/` and `decision/tpu_solver.py`:
+   functions decorated `@jax.jit`/`@partial(jax.jit, ...)`, and every
+   local function handed to `jax.jit`, `vmap`, `pmap`, `lax.scan`,
+   `while_loop`, `fori_loop`, `cond`, `switch`, `checkpoint`/`remat`
+   (this covers the `bounded_jit_cache`/`instrument_jit` factories:
+   the pipeline they compile is always a local `def` passed through
+   `jax.jit(...)`).
+2. Closes over the same-module and `openr_tpu.ops.*` import call graph
+   (a traced function's callees are traced too; nested `def`s inherit
+   tracedness).
+3. Flags, inside traced code:
+   - `host-impurity`: `print`, `time.*`, `counters.*`, logging calls,
+     and `np.*` calls outside a static-safe set (dtype constructors,
+     `iinfo`/`finfo` — these fold to constants at trace time by
+     design; everything else on a traced value is a silent host round
+     trip or a trace-time freeze)
+   - `host-sync`: `.item()`, `.tolist()`, `.block_until_ready()`,
+     `jax.device_get`, and `float()/int()/bool()` on non-trivial
+     expressions
+   - `traced-loop`: `while` statements (a Python `while` on a traced
+     predicate can't trace; on static values it usually wants
+     `lax.while_loop` anyway — pragma the intentional static ones)
+
+Static `np.*` on closure constants inside a traced function is
+sometimes legitimate (shape math) — those sites take a
+`# lint: allow(host-impurity) <reason>` pragma documenting that the
+operands are trace-time static.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tools.lint.core import Finding, Project, SourceFile
+
+CODE_IMPURE = "host-impurity"
+CODE_SYNC = "host-sync"
+CODE_LOOP = "traced-loop"
+
+# modules whose call graphs we walk (roots + callees live here)
+_TRACED_MODULE_PREFIXES = ("openr_tpu/ops/",)
+_TRACED_MODULE_FILES = ("openr_tpu/decision/tpu_solver.py",)
+
+# callables whose function-valued arguments execute under trace
+_TRACING_FUNCS = {
+    "jit", "vmap", "pmap", "scan", "while_loop", "fori_loop", "cond",
+    "switch", "checkpoint", "remat", "custom_jvp", "custom_vjp",
+}
+
+# np.* attrs that are static-safe inside traced code: dtype
+# constructors and dtype-introspection fold to constants at trace time
+_ALLOWED_NP = {
+    "float32", "float64", "int8", "int16", "int32", "int64",
+    "uint8", "uint16", "uint32", "uint64", "bool_", "dtype",
+    "iinfo", "finfo", "ndarray",
+}
+
+_SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
+
+
+def _is_traced_file(rel: str) -> bool:
+    return rel in _TRACED_MODULE_FILES or any(
+        rel.startswith(p) for p in _TRACED_MODULE_PREFIXES
+    )
+
+
+def _terminal_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+class _ModuleGraph:
+    """One traced-candidate module: its function defs, the names it
+    imports from other traced modules, and its traced-root set."""
+
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        # qualname-agnostic: name -> def node (innermost wins is fine;
+        # the ops modules don't shadow function names)
+        self.defs: dict[str, ast.AST] = {}
+        # local alias -> (module rel-ish dotted path, remote name)
+        self.imports: dict[str, tuple[str, str]] = {}
+        self.traced: set[str] = set()
+        self._collect()
+
+    def _collect(self) -> None:
+        for node in ast.walk(self.sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs[node.name] = node
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    self.imports[alias.asname or alias.name] = (
+                        node.module, alias.name
+                    )
+        # roots: decorated with a tracing func, or passed to one
+        for name, fn in self.defs.items():
+            for dec in fn.decorator_list:
+                if self._is_tracing_expr(dec):
+                    self.traced.add(name)
+        for node in ast.walk(self.sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tname = _terminal_name(node.func)
+            if tname == "partial" and node.args:
+                tname = _terminal_name(node.args[0])
+                func_args = node.args[1:]
+            else:
+                func_args = node.args
+            if tname not in _TRACING_FUNCS:
+                continue
+            for arg in func_args:
+                aname = _terminal_name(arg)
+                if aname and aname in self.defs:
+                    self.traced.add(aname)
+
+    def _is_tracing_expr(self, dec: ast.AST) -> bool:
+        tname = _terminal_name(dec)
+        if tname in _TRACING_FUNCS:
+            return True
+        if isinstance(dec, ast.Call):
+            tname = _terminal_name(dec.func)
+            if tname in _TRACING_FUNCS:
+                return True
+            if tname == "partial" and dec.args:
+                return _terminal_name(dec.args[0]) in _TRACING_FUNCS
+        return False
+
+
+def _propagate(graphs: dict[str, _ModuleGraph]) -> None:
+    """Traced closure: callees of traced functions become traced, both
+    same-module and across `openr_tpu.ops.*` imports."""
+    # dotted module name -> graph (openr_tpu/ops/spf.py -> openr_tpu.ops.spf)
+    by_dotted = {
+        g.sf.rel[:-3].replace("/", "."): g for g in graphs.values()
+    }
+    changed = True
+    while changed:
+        changed = False
+        for g in graphs.values():
+            for name in list(g.traced):
+                fn = g.defs.get(name)
+                if fn is None:
+                    continue
+                # nested defs inherit tracedness
+                for node in ast.walk(fn):
+                    if (
+                        isinstance(
+                            node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                        )
+                        and node is not fn
+                        and node.name not in g.traced
+                    ):
+                        g.traced.add(node.name)
+                        g.defs.setdefault(node.name, node)
+                        changed = True
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    cname = _terminal_name(node.func)
+                    if cname is None:
+                        continue
+                    if cname in g.defs and cname not in g.traced:
+                        g.traced.add(cname)
+                        changed = True
+                    imp = g.imports.get(cname)
+                    if imp is not None:
+                        tgt = by_dotted.get(imp[0])
+                        if (
+                            tgt is not None
+                            and imp[1] in tgt.defs
+                            and imp[1] not in tgt.traced
+                        ):
+                            tgt.traced.add(imp[1])
+                            changed = True
+
+
+def _flag_impurities(g: _ModuleGraph, findings: list[Finding]) -> None:
+    sf = g.sf
+    for name in sorted(g.traced):
+        fn = g.defs.get(name)
+        if fn is None:
+            continue
+        # nested traced defs are also walked on their own pass; the
+        # (path, line, code, detail) dedup below collapses the overlap
+        for node in ast.walk(fn):
+            if isinstance(node, ast.While):
+                findings.append(Finding(
+                    sf.rel, node.lineno, CODE_LOOP,
+                    sf.scope_at(node.lineno), "while",
+                    "Python `while` inside traced code — a traced "
+                    "predicate can't drive it; use lax.while_loop (or "
+                    "pragma if genuinely trace-time static)",
+                ))
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            fnode = node.func
+            tname = _terminal_name(fnode)
+            scope = sf.scope_at(node.lineno)
+            if tname == "print":
+                findings.append(Finding(
+                    sf.rel, node.lineno, CODE_IMPURE, scope, "print",
+                    "print() inside traced code runs once at compile "
+                    "time, never per solve",
+                ))
+            elif (
+                isinstance(fnode, ast.Attribute)
+                and isinstance(fnode.value, ast.Name)
+                and fnode.value.id == "time"
+            ):
+                findings.append(Finding(
+                    sf.rel, node.lineno, CODE_IMPURE, scope,
+                    f"time.{fnode.attr}",
+                    f"time.{fnode.attr}() inside traced code freezes "
+                    f"the compile-time clock into the executable",
+                ))
+            elif (
+                isinstance(fnode, ast.Attribute)
+                and isinstance(fnode.value, ast.Name)
+                and fnode.value.id in ("counters", "log", "logger",
+                                       "logging")
+            ):
+                findings.append(Finding(
+                    sf.rel, node.lineno, CODE_IMPURE, scope,
+                    f"{fnode.value.id}.{fnode.attr}",
+                    f"{fnode.value.id}.{fnode.attr}() inside traced "
+                    f"code fires once per compile, not per solve — "
+                    f"hoist it to the dispatch wrapper",
+                ))
+            elif (
+                isinstance(fnode, ast.Attribute)
+                and isinstance(fnode.value, ast.Name)
+                and fnode.value.id in ("np", "numpy")
+                and fnode.attr not in _ALLOWED_NP
+            ):
+                findings.append(Finding(
+                    sf.rel, node.lineno, CODE_IMPURE, scope,
+                    f"np.{fnode.attr}",
+                    f"np.{fnode.attr}() inside traced code — on a "
+                    f"traced value this is a silent host round trip; "
+                    f"use jnp, or pragma if the operands are "
+                    f"trace-time static",
+                ))
+            elif (
+                isinstance(fnode, ast.Attribute)
+                and fnode.attr in _SYNC_ATTRS
+                and not node.args
+            ):
+                findings.append(Finding(
+                    sf.rel, node.lineno, CODE_SYNC, scope,
+                    f".{fnode.attr}()",
+                    f".{fnode.attr}() inside traced code forces a "
+                    f"device sync at trace time",
+                ))
+            elif (
+                isinstance(fnode, ast.Attribute)
+                and fnode.attr == "device_get"
+            ):
+                findings.append(Finding(
+                    sf.rel, node.lineno, CODE_SYNC, scope, "device_get",
+                    "jax.device_get inside traced code blocks the "
+                    "dispatch pipeline",
+                ))
+            elif (
+                tname in ("float", "int", "bool")
+                and isinstance(fnode, ast.Name)
+                and len(node.args) == 1
+                and isinstance(node.args[0], (ast.Subscript, ast.Call))
+            ):
+                findings.append(Finding(
+                    sf.rel, node.lineno, CODE_SYNC, scope, f"{tname}()",
+                    f"{tname}() on an indexed/computed value inside "
+                    f"traced code is a host sync on a traced array",
+                ))
+
+
+def run(project: Project) -> list[Finding]:
+    graphs = {
+        sf.rel: _ModuleGraph(sf)
+        for sf in project.files
+        if _is_traced_file(sf.rel)
+    }
+    _propagate(graphs)
+    findings: list[Finding] = []
+    for g in graphs.values():
+        _flag_impurities(g, findings)
+    # a line flagged once is enough even if two traced parents reach it
+    seen: set[tuple] = set()
+    out = []
+    for fd in findings:
+        k = (fd.path, fd.line, fd.code, fd.detail)
+        if k not in seen:
+            seen.add(k)
+            out.append(fd)
+    return out
