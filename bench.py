@@ -84,11 +84,13 @@ def _flap(states, adj_dbs, victims, round_i, area="0"):
 
 
 def bench_config(name, gen, me, runs=5, flap_victims=0, cpu_baseline=True,
-                 small_graph_nodes=0, **solver_kw):
+                 small_graph_nodes=0, tpu_kw=None, **solver_kw):
     """Run one config; returns a result dict. small_graph_nodes > 0
     exercises the "auto" backend's small-graph delegation (the solver
     routes the whole build to the CPU oracle below that node count);
-    extra solver_kw (e.g. enable_lfa) go to BOTH backends."""
+    extra solver_kw (e.g. enable_lfa) go to BOTH backends, tpu_kw only
+    to the device solver (multichip tier knobs have no CPU analogue)."""
+    tpu_kw = dict(tpu_kw or {})
     from openr_tpu.decision.spf_solver import SpfSolver
     from openr_tpu.decision.tpu_solver import TpuSpfSolver
     from openr_tpu.models import topologies
@@ -115,7 +117,8 @@ def bench_config(name, gen, me, runs=5, flap_victims=0, cpu_baseline=True,
         res["cpu_ms"] = round(cpu_ms, 1)
         log(f"[{name}] cpu oracle: {cpu_ms:.1f} ms, {len(cpu_db.unicast_routes)} routes")
 
-    tpu = TpuSpfSolver(me, small_graph_nodes=small_graph_nodes, **solver_kw)
+    tpu = TpuSpfSolver(me, small_graph_nodes=small_graph_nodes,
+                   **tpu_kw, **solver_kw)
     t0 = time.perf_counter()
     tpu_db = tpu.build_route_db(me, states, ps)
     res["compile_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
@@ -130,7 +133,8 @@ def bench_config(name, gen, me, runs=5, flap_victims=0, cpu_baseline=True,
     # cold full rebuild, jit warm: fresh solver state -> plan build + full
     # device pull + full host materialization (what a restarting daemon
     # pays once)
-    tpu2 = TpuSpfSolver(me, small_graph_nodes=small_graph_nodes, **solver_kw)
+    tpu2 = TpuSpfSolver(me, small_graph_nodes=small_graph_nodes,
+                    **tpu_kw, **solver_kw)
     t0 = time.perf_counter()
     cold_db = tpu2.build_route_db(me, states, ps)
     res["full_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
@@ -231,6 +235,18 @@ def bench_config(name, gen, me, runs=5, flap_victims=0, cpu_baseline=True,
         res["speedup"] = round(cpu_ms / tpu_ms, 2)
         if dev_ms:
             res["device_speedup"] = round(cpu_ms / dev_ms, 2)
+    # multichip capacity tier: whether the steady-state solves ran
+    # through the sharded path, the mesh factorization they used, and
+    # the per-shard completion timings (a straggler device is one
+    # outlier entry in shard_ms)
+    mc = getattr(tpu, "last_timing", {}).get("multichip")
+    res["multichip_engaged"] = bool(mc)
+    if mc:
+        res["multichip"] = mc
+    else:
+        # the phase-median loop above folds last_timing's bool flags in
+        # as 0s; an off tier reports only multichip_engaged=False
+        res.pop("multichip", None)
     # executable-cache health over the churn loop (deltas vs the loop
     # start, so other configs/tests in the process don't pollute the
     # reading): a steady state that misses (recompiles) or evicts here
@@ -270,7 +286,7 @@ def bench_config(name, gen, me, runs=5, flap_victims=0, cpu_baseline=True,
         }
         tpu_i = TpuSpfSolver(
             me, small_graph_nodes=small_graph_nodes,
-            incremental_spf=True, **solver_kw,
+            incremental_spf=True, **tpu_kw, **solver_kw,
         )
         tpu_i.build_route_db(me, states, ps)  # first solve: cold seed
         i_samples, engaged, cones, rows = [], 0, [], []
@@ -451,8 +467,62 @@ def main() -> None:
     if r5 is not None:
         headline = ("full_rib_recompute_100k_ms", r5[1], r5[2])
 
+    # 5b: the SAME 100k LSDB forced through the multichip capacity tier
+    # (n_cap 131072 sits exactly AT the default threshold, so halving it
+    # engages the sharded path) — the single-chip vs multichip device_ms
+    # side-by-side is the tier's go/no-go number at this scale
+    if len(jax.devices()) > 1:
+        run(
+            "lsdb100k_mc",
+            lambda: topologies.grid(316, node_labels=False),
+            "node-158-158",
+            runs=3,
+            flap_victims=250,
+            tpu_kw={"multichip_n_cap_threshold": 65536},
+        )
+
+    # 6: 1M-node synthetic LSDB (grid 1000x1000, ~4M directed
+    # adjacencies) through the production Decision path — the multichip
+    # tier engages at the default threshold. Host topology construction
+    # alone holds ~5M python objects, so the lane is memory-gated: on a
+    # short box it reports a skip instead of an OOM kill. The CPU-oracle
+    # parity assert (~minutes of host Dijkstra) is opt-in via
+    # OPENR_TPU_BENCH_1M_ORACLE=1.
+    import os as _os
+
+    mem_gb = None
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    mem_gb = int(line.split()[1]) / 1e6
+                    break
+    except OSError:
+        pass
+    if only in (None, "lsdb1m") and (mem_gb is None or mem_gb >= 12.0):
+        run(
+            "lsdb1m",
+            lambda: topologies.grid(1000, node_labels=False),
+            "node-500-500",
+            runs=1,
+            flap_victims=100,
+            cpu_baseline=_os.environ.get(
+                "OPENR_TPU_BENCH_1M_ORACLE", ""
+            ) == "1",
+        )
+    elif only in (None, "lsdb1m"):
+        configs["lsdb1m"] = {
+            "skipped": f"MemAvailable {mem_gb:.1f} GB < 12 GB"
+        }
+        log(f"[lsdb1m] skipped: MemAvailable {mem_gb:.1f} GB < 12 GB")
+
     if headline is None:
-        last = list(configs)[-1]
+        last = next(
+            (n for n in reversed(configs) if "tpu_ms" in configs[n]),
+            None,
+        )
+        if last is None:
+            sys.exit("no config produced a headline timing")
         headline = (
             f"full_rib_recompute_{last}_ms",
             configs[last]["tpu_ms"],
@@ -469,6 +539,19 @@ def main() -> None:
         "device_ms_100k": dev,
         "incr_device_ms_100k": configs.get("lsdb100k", {}).get(
             "incr_device_ms"
+        ),
+        # the 100k single-chip vs multichip side-by-side: the capacity
+        # tier must beat the single-chip device_ms at this scale to be
+        # worth its pmin halo exchange
+        "device_ms_100k_single": dev,
+        "device_ms_100k_multichip": configs.get("lsdb100k_mc", {}).get(
+            "device_ms"
+        ),
+        "multichip_engaged_100k": configs.get("lsdb100k_mc", {}).get(
+            "multichip_engaged"
+        ),
+        "multichip_engaged_1m": configs.get("lsdb1m", {}).get(
+            "multichip_engaged"
         ),
         # The e2e value above includes one mandatory device->host result
         # round trip; on this tunneled rig that RTT (rig_rtt_ms, measured

@@ -181,6 +181,20 @@ class DecisionConfig:
     # the same dispatch. 0.0 forces every incremental dispatch to
     # degrade to the (bit-identical) cold seed — a bisection lever.
     incremental_cone_frac: float = 0.25
+    # multichip capacity tier (decision/tpu_solver.py +
+    # parallel/sharding.py): an area whose padded node capacity exceeds
+    # this threshold — and with >1 visible device — solves through
+    # NamedSharding-resident arrays over the ('batch','graph') mesh
+    # instead of the single-chip pipeline, lifting the hard single-HBM
+    # n_cap ceiling. Default is exactly one chip's ceiling so the tier
+    # engages only when a single chip cannot hold the fabric; lower it
+    # to force multichip earlier, 0 disables the tier entirely.
+    multichip_n_cap_threshold: int = 131072
+    # multichip mesh factorization: size of the 'batch' axis (vantage
+    # rows); the 'graph' axis (weight columns) takes the rest of the
+    # visible devices. 0 = auto (parallel/sharding.make_mesh — wide
+    # batch, graph=2 from 4 devices up).
+    multichip_batch: int = 0
 
 
 @dataclass
@@ -587,6 +601,12 @@ class Config:
             raise ConfigError(
                 "decision incremental_cone_frac must be in [0, 1]"
             )
+        if dc.multichip_n_cap_threshold < 0:
+            raise ConfigError(
+                "decision multichip_n_cap_threshold must be >= 0"
+            )
+        if dc.multichip_batch < 0:
+            raise ConfigError("decision multichip_batch must be >= 0")
         wc = cfg.watchdog_config
         if wc.supervisor_crash_budget < 0:
             raise ConfigError("supervisor_crash_budget must be >= 0")

@@ -120,6 +120,8 @@ def make_solver(
         kwargs.pop("fuse_n_cap", None)
         kwargs.pop("incremental_spf", None)
         kwargs.pop("incremental_cone_frac", None)
+        kwargs.pop("multichip_n_cap_threshold", None)
+        kwargs.pop("multichip_batch", None)
         return SpfSolver(node_name, **kwargs)
     if backend in ("tpu", "auto"):
         try:
@@ -139,6 +141,8 @@ def make_solver(
             kwargs.pop("fuse_n_cap", None)
             kwargs.pop("incremental_spf", None)
             kwargs.pop("incremental_cone_frac", None)
+            kwargs.pop("multichip_n_cap_threshold", None)
+            kwargs.pop("multichip_batch", None)
             return SpfSolver(node_name, **kwargs)
     raise ValueError(f"unknown solver backend {backend!r}")
 
@@ -190,6 +194,11 @@ class Decision(Actor):
             skw.setdefault(
                 "incremental_cone_frac", config.incremental_cone_frac
             )
+            skw.setdefault(
+                "multichip_n_cap_threshold",
+                config.multichip_n_cap_threshold,
+            )
+            skw.setdefault("multichip_batch", config.multichip_batch)
         self.solver = make_solver(
             node_name,
             backend,
@@ -893,6 +902,10 @@ class Decision(Actor):
             # at least one area dispatched the incremental SSSP kernel
             # this solve (seed-from-previous, ops/incremental.py)
             spf_sp.attributes["incremental"] = True
+        if tm.get("multichip"):
+            # at least one area solved through the multichip capacity
+            # tier (NamedSharding over the ('batch','graph') mesh)
+            spf_sp.attributes["multichip"] = True
         areas = tm.get("areas") or {"": tm}
         cursor = spf_sp.end
         for area, stages in sorted(areas.items(), reverse=True):

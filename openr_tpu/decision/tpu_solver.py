@@ -391,7 +391,7 @@ def _make_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
                    d_cap: int, p_cap: int, a_cap: int, budget: int,
                    lfa: bool = False, block_v4: bool = False,
                    sentinels: bool = True, emit_dist: bool = False,
-                   incr: bool = False):
+                   incr: bool = False, mesh=None):
     """The fused production pipeline (raw closure — _plan_pipeline jits
     it for the single-area path, _fused_pipeline vmaps it over a group
     of same-shape areas). Outputs:
@@ -417,6 +417,14 @@ def _make_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
     is bit-identical to the cold one, so the ENTIRE selection / LFA /
     packing / delta tail below is shared verbatim between the two
     kernels — output parity by construction.
+
+    With `mesh` (the multichip capacity tier) the SSSP core swaps for
+    parallel/sharding.py's shard_mapped twins — shift columns over
+    'graph', vantage lanes over 'batch' — and the distance plane is
+    re-replicated before the selection tail, which the partitioner
+    handles fine (it is only the SSSP's dynamic roll it miscompiles;
+    see make_mc_sssp). Fixpoint uniqueness keeps the output
+    bit-identical to the single-chip tier.
     """
     import jax
     import jax.numpy as jnp
@@ -428,6 +436,22 @@ def _make_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
     wd = -(-d_cap // 16)
     pa = p_cap * a_cap
     max_trips = max(2, -(-n_cap // _UNROLL) + 2)
+
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+        from openr_tpu.parallel.sharding import (
+            make_mc_incremental_sssp, make_mc_sssp,
+        )
+
+        mc_rep = NamedSharding(mesh, PartitionSpec())
+        if incr:
+            mc_sssp_incr = make_mc_incremental_sssp(
+                mesh, s_cap, has_res, n_cap, d_cap, max_trips
+            )
+        else:
+            mc_sssp = make_mc_sssp(
+                mesh, s_cap, has_res, n_cap, d_cap, max_trips
+            )
 
     def pipeline(deltas, shift_w, res_rows, res_nbr, res_w, mbuf,
                  root, root_nbr, root_w,
@@ -452,19 +476,42 @@ def _make_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
         if incr:
             (prev_dist, s_dirty_idx, s_dirty_old,
              r_dirty_idx, r_dirty_old, cone_limit) = incr_args
-            dist_d, trips, cone, fell_back = incremental_sssp(
-                deltas, shift_w, res_rows, res_nbr, res_w, root,
-                root_nbr, root_w, prev_dist,
-                s_dirty_idx, s_dirty_old, r_dirty_idx, r_dirty_old,
-                cone_limit,
-                s_cap, has_res, n_cap, d_cap, max_trips,
-            )  # [D, N]
+            if mesh is not None:
+                dist_d, trips_v, cone_v, fell_v = mc_sssp_incr(
+                    deltas, shift_w, res_rows, res_nbr, res_w, root,
+                    root_nbr, root_w, prev_dist,
+                    s_dirty_idx, s_dirty_old, r_dirty_idx, r_dirty_old,
+                    cone_limit,
+                )
+                trips = trips_v.max()
+                cone, fell_back = cone_v[0], fell_v[0]
+            else:
+                dist_d, trips, cone, fell_back = incremental_sssp(
+                    deltas, shift_w, res_rows, res_nbr, res_w, root,
+                    root_nbr, root_w, prev_dist,
+                    s_dirty_idx, s_dirty_old, r_dirty_idx, r_dirty_old,
+                    cone_limit,
+                    s_cap, has_res, n_cap, d_cap, max_trips,
+                )  # [D, N]
         else:
-            dist_d, trips = _plan_sssp(
-                deltas, shift_w, res_rows, res_nbr, res_w, root,
-                root_nbr, root_w,
-                s_cap, has_res, n_cap, d_cap, max_trips,
-            )  # [D, N]
+            if mesh is not None:
+                dist_d, trips_v = mc_sssp(
+                    deltas, shift_w, res_rows, res_nbr, res_w, root,
+                    root_nbr, root_w,
+                )
+                trips = trips_v.max()
+            else:
+                dist_d, trips = _plan_sssp(
+                    deltas, shift_w, res_rows, res_nbr, res_w, root,
+                    root_nbr, root_w,
+                    s_cap, has_res, n_cap, d_cap, max_trips,
+                )  # [D, N]
+        if mesh is not None:
+            # the resident copy stays lane-sharded (out_shardings pins
+            # it); the selection tail reads a replicated copy so the
+            # partitioner never touches a sharded gather axis
+            dist_res = dist_d
+            dist_d = jax.lax.with_sharding_constraint(dist_d, mc_rep)
         via = root_w[:, None] + dist_d  # <= 2^30, overflow-free
         dist = jnp.minimum(via.min(axis=0), INF_E).at[root].set(0)  # [N]
 
@@ -596,7 +643,7 @@ def _make_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
         outs = (delta_buf, full_buf, metric, s3w, nhw, lfa_slot,
                 lfa_metric)
         if emit_dist:
-            outs += (dist_d,)
+            outs += (dist_res if mesh is not None else dist_d,)
         return outs
 
     return pipeline
@@ -741,6 +788,144 @@ def _instrumented_incr(
     return name, instrument_jit(name, jitted)
 
 
+def _mc_shardings(mesh, n_cap: int, r_cap: int, d_cap: int,
+                  emit_dist: bool):
+    """(in_shardings, out_shardings) for the 14-arg pipeline closure
+    under the multichip tier's ('batch','graph') mesh. Input placements
+    come from parallel.sharding.plan_shardings (weight state over
+    'graph', root tables over 'batch', small planes replicated); BOTH
+    sides are pinned so the executable is stable across calls — without
+    pinned out_shardings the second call would see prev outputs in
+    whatever layout GSPMD chose and recompile."""
+    from openr_tpu.parallel.sharding import plan_shardings
+
+    sh = plan_shardings(mesh, n_cap, r_cap, d_cap)
+    rep = sh["replicated"]
+    in_sh = (
+        rep,              # deltas
+        sh["shift_w"],
+        sh["res_rows"],
+        sh["res_2d"],     # res_nbr
+        sh["res_2d"],     # res_w
+        rep,              # mbuf
+        rep,              # root scalar
+        sh["root_vec"],   # root_nbr
+        sh["root_vec"],   # root_w
+        rep, rep, rep, rep, rep,  # prev outputs
+    )
+    out_sh = [rep] * 7
+    if emit_dist:
+        out_sh.append(sh["dist"])
+    return in_sh, tuple(out_sh), sh
+
+
+@bounded_jit_cache(namespace="multichip")
+def _mc_pipeline(mesh, n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
+                 has_res: bool,
+                 d_cap: int, p_cap: int, a_cap: int, budget: int,
+                 lfa: bool = False, block_v4: bool = False,
+                 sentinels: bool = True, emit_dist: bool = False):
+    """The multichip capacity tier's full-solve executable: the SAME
+    pipeline closure as _plan_pipeline, jitted with NamedSharding
+    annotations over the ('batch','graph') mesh so GSPMD partitions the
+    weight state across devices — parity with the single-chip tier by
+    construction (the int32 min/add/compare algebra is partitioning-
+    invariant, and XLA argmin keeps lowest-index tie-breaks). The mesh
+    rides the cache key as a within-bucket variant; the "multichip"
+    namespace keeps sharded executables from evicting single-chip
+    ones."""
+    import jax
+
+    in_sh, out_sh, _ = _mc_shardings(mesh, n_cap, r_cap, d_cap, emit_dist)
+    return jax.jit(
+        _make_pipeline(
+            n_cap, s_cap, r_cap, kr_cap, has_res, d_cap, p_cap, a_cap,
+            budget, lfa, block_v4, sentinels, emit_dist, mesh=mesh,
+        ),
+        in_shardings=in_sh, out_shardings=out_sh,
+    )
+
+
+@bounded_jit_cache(namespace="multichip")
+def _mc_incr_pipeline(mesh, n_cap: int, s_cap: int, r_cap: int,
+                      kr_cap: int, has_res: bool,
+                      d_cap: int, p_cap: int, a_cap: int, budget: int,
+                      dirty_cap: int, lfa: bool = False,
+                      block_v4: bool = False, sentinels: bool = True):
+    """Incremental-solve executable under the multichip tier: the warm
+    seed plane stays device-resident in its sharded layout (in AND out
+    pinned to the same spec, so chaining solves never reshards)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    in_sh, out_sh, sh = _mc_shardings(mesh, n_cap, r_cap, d_cap, True)
+    rep = sh["replicated"]
+    # + prev_dist [D, N] and the five replicated dirty-tail args
+    in_sh = in_sh + (sh["dist"], rep, rep, rep, rep, rep)
+    return jax.jit(
+        _make_pipeline(
+            n_cap, s_cap, r_cap, kr_cap, has_res, d_cap, p_cap, a_cap,
+            budget, lfa, block_v4, sentinels, emit_dist=True, incr=True,
+            mesh=mesh,
+        ),
+        in_shardings=in_sh, out_shardings=out_sh,
+    )
+
+
+def _mesh_tag(mesh) -> str:
+    return f"{mesh.shape['batch']}x{mesh.shape['graph']}"
+
+
+@bounded_jit_cache(namespace="multichip")
+def _instrumented_mc(
+    mesh, n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
+    has_res: bool, d_cap: int, p_cap: int, a_cap: int, budget: int,
+    lfa: bool, block_v4: bool, sentinels: bool,
+    emit_dist: bool = False,
+) -> tuple:
+    """(kernel name, instrumented callable) for a multichip shape
+    class — the multichip-namespace analogue of
+    _instrumented_pipeline."""
+    from openr_tpu.ops.xla_cache import instrument_jit
+
+    name = (
+        f"pipeline_mc[n={n_cap},s={s_cap},d={d_cap},p={p_cap},"
+        f"a={a_cap},mesh={_mesh_tag(mesh)}"
+        + (",res" if has_res else "")
+        + (",lfa" if lfa else "")
+        + "]"
+    )
+    jitted = _mc_pipeline(
+        mesh, n_cap, s_cap, r_cap, kr_cap, has_res, d_cap, p_cap,
+        a_cap, budget, lfa, block_v4, sentinels, emit_dist,
+    )
+    return name, instrument_jit(name, jitted)
+
+
+@bounded_jit_cache(namespace="multichip")
+def _instrumented_mc_incr(
+    mesh, n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
+    has_res: bool, d_cap: int, p_cap: int, a_cap: int, budget: int,
+    dirty_cap: int, lfa: bool, block_v4: bool, sentinels: bool,
+) -> tuple:
+    """(kernel name, instrumented callable) for a multichip
+    incremental-solve shape class."""
+    from openr_tpu.ops.xla_cache import instrument_jit
+
+    name = (
+        f"pipeline_mc_incr[n={n_cap},s={s_cap},d={d_cap},p={p_cap},"
+        f"a={a_cap},dd={dirty_cap},mesh={_mesh_tag(mesh)}"
+        + (",res" if has_res else "")
+        + (",lfa" if lfa else "")
+        + "]"
+    )
+    jitted = _mc_incr_pipeline(
+        mesh, n_cap, s_cap, r_cap, kr_cap, has_res, d_cap, p_cap,
+        a_cap, budget, dirty_cap, lfa, block_v4, sentinels,
+    )
+    return name, instrument_jit(name, jitted)
+
+
 @bounded_jit_cache()
 def _scatter_jit(donate: bool = False):
     import jax
@@ -755,6 +940,22 @@ def _scatter_jit(donate: bool = False):
         # CPU, where XLA cannot honor the donation and jax warns.
         return jax.jit(scatter, donate_argnums=(0,))
     return jax.jit(scatter)
+
+
+@bounded_jit_cache(namespace="multichip")
+def _mc_scatter_jit(sharding, donate: bool = False):
+    """Delta scatter that PRESERVES the resident array's NamedSharding:
+    pinning out_shardings keeps the multichip tier's weight shards in
+    place, so GSPMD routes each update to the owning device and churn
+    never re-uploads (or re-shards) the full graph."""
+    import jax
+
+    def scatter(arr, idx, vals):
+        shape = arr.shape
+        return arr.ravel().at[idx].set(vals).reshape(shape)
+
+    kw = {"donate_argnums": (0,)} if donate else {}
+    return jax.jit(scatter, out_shardings=sharding, **kw)
 
 
 def _pack_matrix(matrix: PrefixMatrix, node_over: np.ndarray) -> tuple:
@@ -794,6 +995,7 @@ class _AreaDev:
         "plan", "d_deltas", "d_shift_w", "d_res_rows", "d_res_nbr",
         "d_res_w", "matrix_key", "matrix", "flags", "d_mbuf",
         "matrix_version", "pack_over", "drain_epoch", "drain_log",
+        "mc_mesh",
     )
 
     def __init__(self):
@@ -824,6 +1026,11 @@ class _AreaDev:
         # change even at identical shapes, so every vantage's delta state
         # (prev outputs + route cache) must reset against the new rows
         self.matrix_version = 0
+        # the ('batch','graph') mesh this area's mirrors are sharded
+        # over when the multichip capacity tier is engaged; None =
+        # single-chip placement. A tier flip forces a full re-put under
+        # the new placement (_sync_area).
+        self.mc_mesh = None
 
 
 class _VantageState:
@@ -1083,7 +1290,9 @@ class TpuSpfSolver:
         fuse_small_areas: bool = True,
         fuse_n_cap: int = _FUSE_MAX_NCAP,
         incremental_spf: bool = False,
-        incremental_cone_frac: float = 0.25, **solver_kwargs
+        incremental_cone_frac: float = 0.25,
+        multichip_n_cap_threshold: int = 131072,
+        multichip_batch: int = 0, **solver_kwargs
     ):
         # a restarting daemon must not pay the ~80s 100k-node compile
         # again — load executables from the persistent cache
@@ -1117,6 +1326,17 @@ class TpuSpfSolver:
         # fabric's node-lanes (decided on device, same dispatch).
         self.incremental_spf = bool(incremental_spf)
         self.incremental_cone_frac = float(incremental_cone_frac)
+        # multichip capacity tier (parallel/sharding.py): an area whose
+        # padded n_cap exceeds the threshold — with >1 device visible —
+        # solves through NamedSharding-resident mirrors over the
+        # ('batch','graph') mesh, lifting the single-HBM ceiling.
+        # 0 disables the tier.
+        self.multichip_n_cap_threshold = int(multichip_n_cap_threshold)
+        self.multichip_batch = int(multichip_batch)
+        # memoized tier mesh: built once per process (device topology is
+        # static within a solver's lifetime; device LOSS surfaces as a
+        # dispatch failure -> CPU-oracle failover, not a mesh rebuild)
+        self._mc_mesh: object = False  # False = not yet resolved
         self.cpu = SpfSolver(my_node_name, **solver_kwargs)
         # UCMP weight resolution runs on device through the oracle's
         # resolver hook (falls back to the host walk when stale)
@@ -1175,14 +1395,28 @@ class TpuSpfSolver:
 
         def _pool_arrays():
             s = ref()
-            return [] if s is None else list(s._device_arrays())
+            return [] if s is None else list(s._device_arrays(mc=False))
+
+        def _mc_pool_arrays():
+            s = ref()
+            return [] if s is None else list(s._device_arrays(mc=True))
 
         register_pool(f"tpu_solver:{my_node_name}", _pool_arrays)
+        # the multichip tier's sharded mirrors report as their own pool
+        # so the HBM census attributes per-device bytes to the tier
+        # (breeze tpu devices)
+        register_pool(
+            f"tpu_solver.multichip:{my_node_name}", _mc_pool_arrays
+        )
 
-    def _device_arrays(self):
+    def _device_arrays(self, mc: Optional[bool] = None):
         """Device buffers this solver pins: per-area topology mirrors
-        plus per-vantage resident pipeline outputs."""
+        plus per-vantage resident pipeline outputs. `mc` filters by
+        tier: True = only multichip-sharded areas' state, False = only
+        single-chip, None = everything."""
         for ad in self._area_dev.values():
+            if mc is not None and (ad.mc_mesh is not None) != mc:
+                continue
             for attr in (
                 "d_deltas", "d_shift_w", "d_res_rows", "d_res_nbr",
                 "d_res_w", "d_mbuf",
@@ -1190,7 +1424,11 @@ class TpuSpfSolver:
                 arr = getattr(ad, attr, None)
                 if arr is not None:
                     yield arr
-        for vs in self._vstates.values():
+        for (area, _), vs in self._vstates.items():
+            if mc is not None:
+                ad = self._area_dev.get(area)
+                if ((ad is not None and ad.mc_mesh is not None) != mc):
+                    continue
             yield from (getattr(vs, "prev", None) or ())
             pd = getattr(vs, "prev_dist", None)
             if pd is not None:
@@ -1351,7 +1589,14 @@ class TpuSpfSolver:
         groups: dict[tuple, list] = {}
         if self.fuse_small_areas:
             for pv in preps:
-                if pv["plan"].n_cap <= self.fuse_n_cap:
+                # a multichip-tier area never fuses: the vmapped group
+                # dispatch carries no sharding annotations, and its
+                # whole point (amortizing tiny-area dispatch overhead)
+                # is moot above the multichip threshold
+                if (
+                    pv.get("mc") is None
+                    and pv["plan"].n_cap <= self.fuse_n_cap
+                ):
                     groups.setdefault(pv["fuse_key"], []).append(pv)
                 else:
                     singles.append(pv)
@@ -1424,6 +1669,7 @@ class TpuSpfSolver:
         stages = {"sync_ms": 0.0, "exec_ms": 0.0, "mat_ms": 0.0}
         area_timing: dict[str, dict] = {}
         incremental = False
+        multichip: dict | bool = False
         for area, fut in pending.futures:
             res = fut.result()
             views.append(res["view"])
@@ -1434,6 +1680,8 @@ class TpuSpfSolver:
                 incremental = True
             else:
                 self.last_trips = stats["trips"]
+            if stats.get("multichip"):
+                multichip = stats["multichip"]
             self.last_device_stats = stats
             for k, v in res["timing"].items():
                 stages[k] = stages.get(k, 0.0) + v
@@ -1460,6 +1708,10 @@ class TpuSpfSolver:
         route_db.unicast_routes = LazyUnicastRoutes(
             route_db.unicast_routes, views
         )
+        if multichip:
+            # once per SOLVE (dispatches count per area): the signal an
+            # operator alerts on is "the tier is live", not its fan-out
+            counters.increment("decision.solver.multichip.engaged")
         wall = (_time.perf_counter() - pending.t_pipe0) * 1e3
         self.last_timing = {
             **stages,
@@ -1468,6 +1720,7 @@ class TpuSpfSolver:
             "areas": area_timing,
             "bytes_uploaded": float(pending.bytes_uploaded),
             "incremental": incremental,
+            "multichip": multichip,
             **pending.ksp2_timing,
         }
         return route_db
@@ -1733,15 +1986,48 @@ class TpuSpfSolver:
             self._donate = jax.default_backend() != "cpu"
         return self._donate
 
-    def _put_counted(self, arr):
+    def _mc_mesh_for(self, n_cap: int):
+        """The ('batch','graph') mesh the multichip tier solves this
+        capacity class on, or None when the tier stays off: threshold
+        disabled or not exceeded, or fewer than two visible devices
+        (the eligibility ladder's first rung — every rung below it,
+        incremental seeding included, applies unchanged within the
+        chosen tier). The shard_mapped SSSP needs the node axis to
+        divide the graph axis; capacity classes are pow2 so this only
+        trips on exotic meshes, and the tier then stays off rather
+        than fall over."""
+        thr = self.multichip_n_cap_threshold
+        if thr <= 0 or n_cap <= thr:
+            return None
+        if self._mc_mesh is False:
+            import jax
+
+            from openr_tpu.parallel.sharding import make_mesh
+
+            if len(jax.devices()) < 2:
+                self._mc_mesh = None
+            else:
+                self._mc_mesh = make_mesh(
+                    batch=self.multichip_batch or None
+                )
+        mesh = self._mc_mesh
+        if mesh is not None and n_cap % mesh.shape["graph"] != 0:
+            return None
+        return mesh
+
+    def _put_counted(self, arr, sharding=None):
         import jax
 
         self._bytes_uploaded += arr.nbytes
+        if sharding is not None:
+            return jax.device_put(arr, sharding)
         return jax.device_put(arr)
 
-    def _scatter_counted(self, d_arr, idx, vals):
+    def _scatter_counted(self, d_arr, idx, vals, sharding=None):
         """Scatter (idx, vals) into the resident array; uploads only the
-        delta-sized index/value buffers."""
+        delta-sized index/value buffers. With `sharding` (multichip
+        tier) the result is pinned to the resident NamedSharding — a
+        per-shard update, not a gather-to-one-device round trip."""
         self._bytes_uploaded += idx.nbytes + vals.nbytes
         donate = self._donation_on()
         if donate:
@@ -1749,9 +2035,12 @@ class TpuSpfSolver:
             # tuples; those handles die with the donation
             self._last_exec = None
             self._last_exec_incr = None
+        if sharding is not None:
+            return _mc_scatter_jit(sharding, donate)(d_arr, idx, vals)
         return _scatter_jit(donate)(d_arr, idx, vals)
 
-    def _diff_scatter(self, d_arr, old_np, new_np, extra_idx=None):
+    def _diff_scatter(self, d_arr, old_np, new_np, extra_idx=None,
+                      sharding=None):
         """Reconcile a resident device array to `new_np` by scattering
         only the positions where it differs. The device holds `old_np`'s
         content except at `extra_idx` (undrained dirty slots whose
@@ -1768,10 +2057,10 @@ class TpuSpfSolver:
         if diff.size * 4 > new_np.size:
             # >25% changed: per-element scatter traffic approaches the
             # full array — one contiguous re-put is cheaper
-            return self._put_counted(new_np)
+            return self._put_counted(new_np, sharding)
         idx = diff.astype(np.int32)
         vals = np.ascontiguousarray(new_np.ravel()[diff])
-        return self._scatter_counted(d_arr, idx, vals)
+        return self._scatter_counted(d_arr, idx, vals, sharding)
 
     def _sync_area(self, area: str, link_state: LinkState,
                    prefix_state: PrefixState, prefixes: list) -> _AreaDev:
@@ -1787,6 +2076,36 @@ class TpuSpfSolver:
         plan = sync_plan(link_state, old_plan)
         rebuilt = plan is not old_plan
         ad.plan = plan
+        # multichip tier decision: placement is part of the mirror's
+        # identity, so a tier flip (a capacity-class crossing of the
+        # threshold in either direction) forces the full re-put branch
+        # below under the NEW placement, drops the probe handles into
+        # the old one, and — via that branch's drain-log reset marker —
+        # makes incremental seeding fall back exactly once.
+        mc_mesh = self._mc_mesh_for(plan.n_cap)
+        if mc_mesh != ad.mc_mesh:
+            ad.mc_mesh = mc_mesh
+            ad.d_deltas = None  # forces the full re-put branch
+            ad.flags = None  # matrix mirror re-ships, new placement
+            self._last_exec = None
+            self._last_exec_incr = None
+        mc_sh = None
+        if mc_mesh is not None:
+            from openr_tpu.parallel.sharding import plan_shardings
+
+            # d_cap is per-vantage: the root tables get their placement
+            # from the jit's in_shardings at dispatch, so 0 here is an
+            # unused slot
+            mc_sh = plan_shardings(
+                mc_mesh, plan.n_cap, plan.res_rows.shape[0], 0
+            )
+            counters.set_counter(
+                "decision.solver.multichip.shards", mc_mesh.size
+            )
+
+        def shp(key):
+            return None if mc_sh is None else mc_sh[key]
+
         if rebuilt or ad.d_deltas is None:
             # same-capacity rebuild (index renumbering, class reshuffle
             # without a pow2 bucket change): the resident arrays stay on
@@ -1813,33 +2132,52 @@ class TpuSpfSolver:
                     r * kr_o + c for r, c, _, _ in old_plan.dirty_res
                 ]
                 ad.d_deltas = self._diff_scatter(
-                    ad.d_deltas, old_plan.deltas, plan.deltas
+                    ad.d_deltas, old_plan.deltas, plan.deltas,
+                    sharding=shp("replicated"),
                 )
                 ad.d_shift_w = self._diff_scatter(
-                    ad.d_shift_w, old_plan.shift_w, plan.shift_w, sd
+                    ad.d_shift_w, old_plan.shift_w, plan.shift_w, sd,
+                    sharding=shp("shift_w"),
                 )
                 if old_plan.dirty_res_nbr:
                     # residual slot layout changed without tracked
                     # indices — the residual mirror re-ships whole
-                    ad.d_res_rows = self._put_counted(plan.res_rows)
-                    ad.d_res_nbr = self._put_counted(plan.res_nbr)
-                    ad.d_res_w = self._put_counted(plan.res_w)
+                    ad.d_res_rows = self._put_counted(
+                        plan.res_rows, shp("res_rows")
+                    )
+                    ad.d_res_nbr = self._put_counted(
+                        plan.res_nbr, shp("res_2d")
+                    )
+                    ad.d_res_w = self._put_counted(
+                        plan.res_w, shp("res_2d")
+                    )
                 else:
                     ad.d_res_rows = self._diff_scatter(
-                        ad.d_res_rows, old_plan.res_rows, plan.res_rows
+                        ad.d_res_rows, old_plan.res_rows, plan.res_rows,
+                        sharding=shp("res_rows"),
                     )
                     ad.d_res_nbr = self._diff_scatter(
-                        ad.d_res_nbr, old_plan.res_nbr, plan.res_nbr
+                        ad.d_res_nbr, old_plan.res_nbr, plan.res_nbr,
+                        sharding=shp("res_2d"),
                     )
                     ad.d_res_w = self._diff_scatter(
-                        ad.d_res_w, old_plan.res_w, plan.res_w, rd
+                        ad.d_res_w, old_plan.res_w, plan.res_w, rd,
+                        sharding=shp("res_2d"),
                     )
             else:
-                ad.d_deltas = self._put_counted(plan.deltas)
-                ad.d_shift_w = self._put_counted(plan.shift_w)
-                ad.d_res_rows = self._put_counted(plan.res_rows)
-                ad.d_res_nbr = self._put_counted(plan.res_nbr)
-                ad.d_res_w = self._put_counted(plan.res_w)
+                ad.d_deltas = self._put_counted(
+                    plan.deltas, shp("replicated")
+                )
+                ad.d_shift_w = self._put_counted(
+                    plan.shift_w, shp("shift_w")
+                )
+                ad.d_res_rows = self._put_counted(
+                    plan.res_rows, shp("res_rows")
+                )
+                ad.d_res_nbr = self._put_counted(
+                    plan.res_nbr, shp("res_2d")
+                )
+                ad.d_res_w = self._put_counted(plan.res_w, shp("res_2d"))
             plan.dirty_shift = []
             plan.dirty_res = []
             plan.dirty_res_nbr = False
@@ -1858,16 +2196,20 @@ class TpuSpfSolver:
              nbr_changed) = drain_dirty(plan)
             if s_idx is not None:
                 ad.d_shift_w = self._scatter_counted(
-                    ad.d_shift_w, s_idx, s_val
+                    ad.d_shift_w, s_idx, s_val, shp("shift_w")
                 )
             if r_idx is not None:
                 ad.d_res_w = self._scatter_counted(
-                    ad.d_res_w, r_idx, r_val
+                    ad.d_res_w, r_idx, r_val, shp("res_2d")
                 )
             ad.drain_epoch += 1
             if nbr_changed:
-                ad.d_res_rows = self._put_counted(plan.res_rows)
-                ad.d_res_nbr = self._put_counted(plan.res_nbr)
+                ad.d_res_rows = self._put_counted(
+                    plan.res_rows, shp("res_rows")
+                )
+                ad.d_res_nbr = self._put_counted(
+                    plan.res_nbr, shp("res_2d")
+                )
                 # residual slot layout changed: journal old values no
                 # longer name stable (row, col) edges — reset marker
                 ad.drain_log.append((ad.drain_epoch, None, None))
@@ -1923,7 +2265,7 @@ class TpuSpfSolver:
             ad.pack_over = plan.node_overloaded.copy()
             if ad.flags is None or not np.array_equal(flags, ad.flags):
                 ad.flags = flags
-                ad.d_mbuf = self._put_counted(mbuf)
+                ad.d_mbuf = self._put_counted(mbuf, shp("replicated"))
         return ad
 
     # -- the fast path ------------------------------------------------------
@@ -1963,6 +2305,22 @@ class TpuSpfSolver:
         root_idx = plan.node_index[my_node_name]
         root_nbr, root_w, links = plan.out_links(link_state, my_node_name)
         d_cap = root_nbr.shape[0]
+        mc = ad.mc_mesh
+        if mc is not None:
+            # pad the out-slot axis to the batch-axis size so the
+            # vantage rows shard evenly. Padded lanes are inert: their
+            # seeds are invalid (INF_E weight -> all-INF distance rows),
+            # via[pad] = INF_E + dist never wins the ECMP predicate, LFA
+            # sees them link-down, and the crib unpacks only
+            # len(links) next-hop bits.
+            from openr_tpu.parallel.sharding import pad_to
+
+            b = mc.shape["batch"]
+            d_pad = -(-d_cap // b) * b
+            if d_pad != d_cap:
+                root_nbr = pad_to(root_nbr, d_pad, -1)
+                root_w = pad_to(root_w, d_pad, INF_E)
+                d_cap = d_pad
         p_cap, a_cap = matrix.ann_node.shape
         r_cap, kr_cap = plan.res_nbr.shape
         has_res = plan.k_res > 0
@@ -1972,8 +2330,11 @@ class TpuSpfSolver:
         # the vantage cache key ALSO folds in the next-hop address
         # version: in-place renumbering invalidates materialized routes
         # without any shape change (the jit pipeline itself is
-        # address-free and keys on shape alone)
-        cache_key = shape_key + (link_state.nh_addr_version,)
+        # address-free and keys on shape alone) — and the multichip
+        # mesh: a tier flip reinitializes the vantage, so prev outputs
+        # and distance planes from one placement never feed the other
+        # tier's executable.
+        cache_key = shape_key + (link_state.nh_addr_version, mc)
 
         vkey = (area, my_node_name)
         if my_node_name != self.my_node_name:
@@ -2061,7 +2422,7 @@ class TpuSpfSolver:
             "fuse_key": (shape_key, lfa, block_v4),
             "vs": vs, "lfa": lfa, "block_v4": block_v4,
             "d_cap": d_cap, "p_cap": p_cap, "a_cap": a_cap,
-            "incr": incr, "root_sig": root_sig,
+            "mc": mc, "incr": incr, "root_sig": root_sig,
             "dist_epoch": ad.drain_epoch,
             "t0": t0, "t1": t1,
         }
@@ -2085,11 +2446,20 @@ class TpuSpfSolver:
         the next solve's seed."""
         emit = self.incremental_spf
         incr = pv.get("incr")
+        mc = pv.get("mc")
+        if mc is not None:
+            counters.increment("decision.solver.multichip.dispatches")
         if incr is not None:
-            kernel_name, run = _instrumented_incr(
-                *pv["shape_key"], _DELTA_BUDGET, incr["cap"],
-                pv["lfa"], pv["block_v4"], self.enable_sentinels,
-            )
+            if mc is not None:
+                kernel_name, run = _instrumented_mc_incr(
+                    mc, *pv["shape_key"], _DELTA_BUDGET, incr["cap"],
+                    pv["lfa"], pv["block_v4"], self.enable_sentinels,
+                )
+            else:
+                kernel_name, run = _instrumented_incr(
+                    *pv["shape_key"], _DELTA_BUDGET, incr["cap"],
+                    pv["lfa"], pv["block_v4"], self.enable_sentinels,
+                )
             args = self._lane_args(pv) + (
                 pv["vs"].prev_dist,
                 incr["sd_idx"], incr["sd_old"],
@@ -2108,10 +2478,16 @@ class TpuSpfSolver:
                 pv, kernel_name, delta_buf, full_buf, new_prev,
                 emit=True, incr=True,
             )
-        kernel_name, run = _instrumented_pipeline(
-            *pv["shape_key"], _DELTA_BUDGET, pv["lfa"], pv["block_v4"],
-            self.enable_sentinels, emit,
-        )
+        if mc is not None:
+            kernel_name, run = _instrumented_mc(
+                mc, *pv["shape_key"], _DELTA_BUDGET, pv["lfa"],
+                pv["block_v4"], self.enable_sentinels, emit,
+            )
+        else:
+            kernel_name, run = _instrumented_pipeline(
+                *pv["shape_key"], _DELTA_BUDGET, pv["lfa"],
+                pv["block_v4"], self.enable_sentinels, emit,
+            )
         args = self._lane_args(pv)
         delta_buf, full_buf, *new_prev = run(*args)
         counters.increment("decision.solver.full.solves")
@@ -2170,6 +2546,12 @@ class TpuSpfSolver:
         sentinels = self.enable_sentinels
         d_cap, p_cap, a_cap = pv["d_cap"], pv["p_cap"], pv["a_cap"]
         t0, t1 = pv["t0"], pv["t1"]
+        mc = pv.get("mc")
+        mc_info = None if mc is None else {
+            "shards": mc.size,
+            "batch": mc.shape["batch"],
+            "graph": mc.shape["graph"],
+        }
         was_valid = vs.valid
         incr_denom = (pv.get("incr") or {}).get("denom", 1)
         # start the device->host copy of the buffer we will consume; it
@@ -2196,6 +2578,24 @@ class TpuSpfSolver:
             crib = vs.crib
             count = None
             trips = 0
+            if mc_info is not None:
+                # per-shard kernel timing: this worker is about to
+                # block on these buffers anyway, so blocking each
+                # device's replica in sequence costs nothing extra and
+                # yields per-device completion latency since dispatch —
+                # a straggler chip shows up as one outlier entry
+                per_shard = {}
+                try:
+                    for _sh in new_prev[0].addressable_shards:
+                        _sh.data.block_until_ready()
+                        per_shard[str(getattr(_sh.device, "id", len(per_shard)))] = round(
+                            (_time.perf_counter() - t1) * 1e3, 3
+                        )
+                # lint: allow(broad-except) timing is best-effort
+                except Exception:
+                    per_shard = {}
+                if per_shard:
+                    mc_info["shard_ms"] = per_shard
             if was_valid:
                 dbuf = np.asarray(delta_buf)  # ONE pull
                 count = int(dbuf[0])
@@ -2212,6 +2612,8 @@ class TpuSpfSolver:
                 "kernel": kernel_name,
                 "fused": fused,
             }
+            if mc_info is not None:
+                stats["multichip"] = mc_info
             if full_pull:
                 fbuf = np.asarray(full_buf)
                 t2 = _time.perf_counter()

@@ -140,7 +140,13 @@ def bounded_jit_cache(max_buckets: int = 8, namespace: str = ""):
     compiling. The incremental-SSSP factories (tpu_solver
     _incr_pipeline/_instrumented_incr) likewise use namespace="incr":
     dirty-set cap churn buckets under xla_cache.incr_* and cannot
-    evict the full-solve or sweep executables. The namespace is also
+    evict the full-solve or sweep executables, and the multichip
+    capacity-tier factories (tpu_solver _mc_pipeline and friends) use
+    namespace="multichip" for the same reason — a sharded executable
+    can never evict a single-chip one or vice versa, so a fabric that
+    oscillates around the tier threshold keeps both resident. The
+    non-int mesh object in a multichip key is a within-bucket variant,
+    exactly like a bool flag. The namespace is also
     folded into the bucket signature, so two namespaces can never
     alias a capacity bucket even if they were ever pointed at a
     shared table.

@@ -257,6 +257,451 @@ class Unconverged(AssertionError):
     """The fixed trip bound was below the graph's diameter bound."""
 
 
+def plan_shardings(mesh, n_cap: int, r_cap: int, d_cap: int) -> dict:
+    """NamedSharding layout for the production multichip tier
+    (decision/tpu_solver.py): the GSPMD twin of `_sharded_fabric_fn`'s
+    shard_map specs. Weight state — the memory that scales with LSDB
+    size — shards its node/residual axes across 'graph'; the per-link
+    root tables shard across 'batch' (vantage fan-out); small planes
+    (deltas, prefix matrix, previous outputs) replicate. An axis whose
+    extent doesn't divide the mesh axis falls back to replicated for
+    that array: correctness never depends on the placement, only HBM
+    footprint does, and the caller pads the axes it wants sharded.
+
+    Returns a dict of jax.sharding.NamedSharding keyed by role:
+    ``replicated``, ``shift_w`` [S, N], ``res_rows`` [R], ``res_2d``
+    [R, K], ``root_vec`` [D], ``dist`` [D, N]."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    b = mesh.shape["batch"]
+    g = mesh.shape["graph"]
+    rep = NamedSharding(mesh, P())
+
+    def sh(spec, ok):
+        return NamedSharding(mesh, spec) if ok else rep
+
+    return {
+        "replicated": rep,
+        "shift_w": sh(P(None, "graph"), n_cap % g == 0),
+        "res_rows": sh(P("graph"), r_cap % g == 0),
+        "res_2d": sh(P("graph", None), r_cap % g == 0),
+        "root_vec": sh(P("batch"), d_cap % b == 0),
+        # the resident distance plane shards its vantage lanes over
+        # 'batch' but keeps the node axis full-width: the mc SSSP
+        # kernels roll along that axis, and a roll on a sharded axis is
+        # exactly the op the GSPMD partitioner cannot be trusted with
+        # (see make_mc_sssp) — each device owns whole lanes instead
+        "dist": sh(P("batch", None), d_cap % b == 0),
+    }
+
+
+def _shard_map():
+    """(shard_map callable, check-disable kwarg) across jax versions."""
+    try:
+        from jax import shard_map  # jax >= 0.6
+        return shard_map, {"check_vma": False}
+    except ImportError:  # older jax: experimental module, check_rep kwarg
+        from jax.experimental.shard_map import shard_map
+        return shard_map, {"check_rep": False}
+
+
+def make_mc_sssp(mesh, s_cap: int, has_res: bool, n_cap: int,
+                 d_cap: int, max_trips: int):
+    """shard_mapped twin of tpu_solver._plan_sssp for the production
+    multichip capacity tier: batched SSSP from the root's out-neighbor
+    seeds with shift_w's node columns sharded over 'graph' and the
+    vantage lanes sharded over 'batch'.
+
+    Why not plain GSPMD over the existing kernel: the relaxation's
+    `jnp.roll(dist + w, deltas[k], axis=1)` has a TRACED shift amount,
+    and XLA's partitioner miscompiles a dynamic roll along a sharded
+    axis (observed on CPU GSPMD: outputs multiplied by the orthogonal
+    mesh-axis size — an unreduced partial-sum artifact). shard_map
+    sidesteps the partitioner entirely: each device rolls a locally
+    FULL-WIDTH field seeded with only its own weight columns
+    (dynamic_update_slice into an INF plane, exactly like
+    _sharded_fabric_fn), and one lax.pmin over 'graph' per relaxation
+    is the halo exchange. The residual ELL tail is small and irregular,
+    so every 'graph' member computes it identically on replicated
+    inputs — pmin of identical candidates is a no-op, and the
+    divergence bookkeeping a row-sharded residual would need (partial
+    scatter-mins per member) never arises.
+
+    Convergence stays data-dependent (while_loop, not the fabric
+    kernel's fixed trip bound): members of one 'graph' group always
+    agree on the post-pmin plane, so they take the same trip count and
+    their collectives stay matched; 'batch' groups share no collectives
+    and may exit at different trip counts — legal, their replica groups
+    are disjoint. Requires n_cap % graph == 0 and d_cap % batch == 0
+    (the solver pads both).
+
+    Returns a callable (deltas, shift_w, res_rows, res_nbr, res_w,
+    root, root_nbr, root_w) -> (dist [D, N] sharded P('batch', None),
+    trips [batch] per-group trip counts). Compose it inside a jit —
+    it is not jitted here."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    g = mesh.shape["graph"]
+    b = mesh.shape["batch"]
+    assert n_cap % g == 0 and d_cap % b == 0, (n_cap, d_cap, mesh.shape)
+    shard_cols = n_cap // g
+
+    def local_fn(deltas, shift_w, res_rows, res_nbr, res_w, root,
+                 root_nbr, root_w):
+        my_col0 = jax.lax.axis_index("graph") * shard_cols
+        col_iota = jnp.arange(shard_cols)
+        # mask root as transit within my local source columns
+        sw = jnp.where(
+            col_iota[None, :] == (root - my_col0), INF_E, shift_w
+        )
+        if has_res:
+            rw = jnp.where(res_nbr == root, INF_E, res_w)
+            nbr_c = jnp.clip(res_nbr, 0, n_cap - 1)
+            rows_c = jnp.clip(res_rows, 0, n_cap - 1)
+        d_loc = d_cap // b
+        valid = root_w < INF_E
+        seed_idx = jnp.clip(root_nbr, 0, n_cap - 1)
+        dist0 = jnp.full((d_loc, n_cap), INF_E, jnp.int32)
+        dist0 = dist0.at[jnp.arange(d_loc), seed_idx].min(
+            jnp.where(valid, 0, INF_E).astype(jnp.int32)
+        )
+
+        def relax(dist):
+            pc = jnp.full_like(dist, INF_E)
+
+            def cls(k, pc):
+                w_full = jax.lax.dynamic_update_slice(
+                    jnp.full((n_cap,), INF_E, jnp.int32), sw[k],
+                    (my_col0,),
+                )
+                return jnp.minimum(
+                    pc,
+                    jnp.roll(dist + w_full[None, :], deltas[k], axis=1),
+                )
+
+            pc = jax.lax.fori_loop(0, s_cap, cls, pc)
+            if has_res:
+                nd = dist[:, nbr_c]
+                cand = (nd + rw[None]).min(axis=2)
+                pc = pc.at[:, rows_c].min(cand)
+            pc = jax.lax.pmin(pc, "graph")
+            return jnp.minimum(dist, pc)
+
+        def body(state):
+            dist, _, t = state
+            new = dist
+            for _ in range(_UNROLL):
+                new = relax(new)
+            return new, jnp.any(new != dist), t + 1
+
+        def cond(state):
+            return state[1] & (state[2] < max_trips)
+
+        dist, _, trips = jax.lax.while_loop(
+            cond, body, (dist0, jnp.bool_(True), jnp.int32(0))
+        )
+        return dist, trips[None]
+
+    shard_map, check_kw = _shard_map()
+    return shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(
+            P(),                 # deltas
+            P(None, "graph"),    # shift_w columns
+            P(), P(), P(),       # residual ELL replicated at use
+            P(),                 # root scalar
+            P("batch"),          # root_nbr (vantage lanes)
+            P("batch"),          # root_w
+        ),
+        out_specs=(P("batch", None), P("batch")),
+        **check_kw,
+    )
+
+
+def make_mc_incremental_sssp(mesh, s_cap: int, has_res: bool,
+                             n_cap: int, d_cap: int, max_trips: int):
+    """shard_mapped twin of ops/incremental.incremental_sssp for the
+    multichip tier. Same layout contract as make_mc_sssp (shift
+    columns over 'graph', vantage lanes over 'batch', residual
+    replicated at use), plus the warm plane prev_dist enters sharded
+    P('batch', None) — each device re-relaxes only its own lanes.
+
+    Parity notes (the invariants that make this bit-identical where it
+    must be, and deliberately looser where it may be):
+    - The distance fixpoint is unique, so dist matches the single-chip
+      incremental AND cold solves bit-for-bit regardless of anything
+      below.
+    - The parent plane is assembled from per-shard tight-edge finds
+      combined with one lax.pmax over 'graph' (largest source index
+      wins across shards) — a deterministic, group-uniform choice, but
+      not necessarily the same parent the single-chip kernel picks.
+      Any tight parent is valid for subtree invalidation; only the
+      cone SIZE can differ, and over-invalidation is safe.
+    - The dirty-slot gather (new weight at a global flat index) reads
+      the owning shard's columns and resolves with a pmin over 'graph'
+      (absent shards contribute INF) — group-uniform by construction.
+    - cone is psum'd over 'batch' so fell_back (warm vs cold seed) is
+      one GLOBAL decision, exactly like the single-chip kernel; every
+      'graph' group member then seeds identically and the relaxation
+      while_loop stays in lockstep within each group.
+
+    Returns a callable (...incremental_sssp args...) ->
+    (dist [D, N] P('batch', None), trips [batch], cone [1],
+    fell_back [1])."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    g = mesh.shape["graph"]
+    b = mesh.shape["batch"]
+    assert n_cap % g == 0 and d_cap % b == 0, (n_cap, d_cap, mesh.shape)
+    shard_cols = n_cap // g
+    d_loc = d_cap // b
+
+    def local_fn(deltas, shift_w, res_rows, res_nbr, res_w, root,
+                 root_nbr, root_w, prev_dist,
+                 s_dirty_idx, s_dirty_old, r_dirty_idx, r_dirty_old,
+                 cone_limit):
+        my_col0 = jax.lax.axis_index("graph") * shard_cols
+        col_iota = jnp.arange(shard_cols)
+        local_root = root - my_col0
+        swm_new = jnp.where(
+            col_iota[None, :] == local_root, INF_E, shift_w
+        )
+        # reconstruct the OLD local plane: dirty tuples carry GLOBAL
+        # flat indices into [S, N]; translate to this shard's columns,
+        # everything foreign drops
+        ok_s = (s_dirty_idx >= 0) & (s_dirty_idx < s_cap * n_cap)
+        sic = jnp.clip(s_dirty_idx, 0, s_cap * n_cap - 1)
+        k_j = sic // n_cap
+        u_j = sic % n_cap
+        u_loc = u_j - my_col0
+        owned = ok_s & (u_loc >= 0) & (u_loc < shard_cols)
+        lflat = jnp.where(
+            owned,
+            k_j * shard_cols + jnp.clip(u_loc, 0, shard_cols - 1),
+            s_cap * shard_cols,
+        )
+        old_local = (
+            shift_w.ravel()
+            .at[lflat].set(s_dirty_old, mode="drop")
+            .reshape(shift_w.shape)
+        )
+        swm_old = jnp.where(
+            col_iota[None, :] == local_root, INF_E, old_local
+        )
+        if has_res:
+            old_res = (
+                res_w.ravel()
+                .at[r_dirty_idx].set(r_dirty_old, mode="drop")
+                .reshape(res_w.shape)
+            )
+            rwm_new = jnp.where(res_nbr == root, INF_E, res_w)
+            rwm_old = jnp.where(res_nbr == root, INF_E, old_res)
+            nbr_c = jnp.clip(res_nbr, 0, n_cap - 1)
+            rows_c = jnp.clip(res_rows, 0, n_cap - 1)
+            rows_s = jnp.where(res_rows >= 0, res_rows, n_cap)
+
+        # --- parent plane under the OLD weights (cf. ops/incremental
+        # _parent_plane): per-shard tight-edge finds over local
+        # columns, then one pmax('graph') combine ---
+        src = jnp.arange(n_cap, dtype=jnp.int32)
+        par = jnp.full((d_loc, n_cap), -1, jnp.int32)
+
+        def pcls(k, par):
+            dk = deltas[k]
+            w_full = jax.lax.dynamic_update_slice(
+                jnp.full((n_cap,), INF_E, jnp.int32), swm_old[k],
+                (my_col0,),
+            )
+            cand = prev_dist + w_full[None, :]
+            tgt = jnp.roll(prev_dist, -dk, axis=1)
+            hit = (
+                (prev_dist < INF_E) & (w_full < INF_E)[None, :]
+                & (cand == tgt)
+            )
+            hit_v = jnp.roll(hit, dk, axis=1)
+            src_v = jnp.roll(src, dk)[None, :]
+            return jnp.where((par < 0) & hit_v, src_v, par)
+
+        par = jax.lax.fori_loop(0, s_cap, pcls, par)
+        par = jax.lax.pmax(par, "graph")
+        if has_res:
+            row_valid = res_rows >= 0
+            prev_n = prev_dist[:, nbr_c]
+            cand = prev_n + rwm_old[None]
+            tgt = prev_dist[:, rows_c][:, :, None]
+            hit = (
+                (prev_n < INF_E)
+                & (rwm_old < INF_E)[None]
+                & (cand == tgt)
+                & (res_nbr >= 0)[None]
+            )
+            has = hit.any(axis=2)
+            first = jnp.argmax(hit, axis=2)
+            nbr_b = jnp.broadcast_to(res_nbr[None], hit.shape)
+            pick = jnp.take_along_axis(
+                nbr_b, first[:, :, None], axis=2
+            )[:, :, 0]
+            cur = par[:, rows_c]
+            new = jnp.where(
+                (cur < 0) & has & row_valid[None], pick, cur
+            )
+            par = par.at[:, rows_s].set(new, mode="drop")
+
+        # --- classify increased dirty edges + seed the cone ---
+        aff = jnp.zeros((d_loc, n_cap), jnp.int32)
+        new_loc = jnp.where(
+            owned,
+            swm_new.ravel()[
+                jnp.clip(lflat, 0, s_cap * shard_cols - 1)
+            ],
+            INF_E,
+        )
+        new_m = jax.lax.pmin(new_loc, "graph")
+        old_m = jnp.where(u_j == root, INF_E, s_dirty_old)
+        inc_s = ok_s & (new_m > old_m)
+        v_j = (u_j + deltas[k_j]) % n_cap
+        pv = par[:, jnp.clip(v_j, 0, n_cap - 1)]
+        seed_s = (inc_s[None, :] & (pv == u_j[None, :])).astype(
+            jnp.int32
+        )
+        v_sc = jnp.where(ok_s, v_j, n_cap)
+        aff = aff.at[:, v_sc].max(seed_s, mode="drop")
+
+        if has_res:
+            kr = res_nbr.shape[1]
+            lim = res_rows.shape[0] * kr
+            ok_r = (r_dirty_idx >= 0) & (r_dirty_idx < lim)
+            ric = jnp.clip(r_dirty_idx, 0, lim - 1)
+            row_j = ric // kr
+            c_j = ric % kr
+            ru = res_nbr[row_j, c_j]
+            rv = res_rows[row_j]
+            new_mr = rwm_new[row_j, c_j]
+            old_mr = jnp.where(ru == root, INF_E, r_dirty_old)
+            inc_r = ok_r & (new_mr > old_mr) & (ru >= 0) & (rv >= 0)
+            pv_r = par[:, jnp.clip(rv, 0, n_cap - 1)]
+            seed_r = (inc_r[None, :] & (pv_r == ru[None, :])).astype(
+                jnp.int32
+            )
+            rv_sc = jnp.where(ok_r & (rv >= 0), rv, n_cap)
+            aff = aff.at[:, rv_sc].max(seed_r, mode="drop")
+
+        # --- propagate aff to tree descendants (par is group-uniform
+        # and the residual is replicated, so no collectives here) ---
+        nodes = jnp.arange(n_cap, dtype=jnp.int32)
+
+        def aff_step(acc):
+            def cls(k, a):
+                dk = deltas[k]
+                childpar = jnp.roll(par, -dk, axis=1)
+                is_child = childpar == nodes[None, :]
+                contrib = jnp.roll(
+                    jnp.where(is_child, a, 0), dk, axis=1
+                )
+                return jnp.maximum(a, contrib)
+
+            acc = jax.lax.fori_loop(0, s_cap, cls, acc)
+            if has_res:
+                is_child = (
+                    par[:, rows_c][:, :, None] == res_nbr[None]
+                ) & (res_nbr >= 0)[None]
+                acc_n = acc[:, nbr_c]
+                contrib = jnp.where(is_child, acc_n, 0).max(axis=2)
+                acc = acc.at[:, rows_s].max(contrib, mode="drop")
+            return acc
+
+        def aff_body(state):
+            acc, _, t = state
+            new = acc
+            for _ in range(_UNROLL):
+                new = aff_step(new)
+            return new, jnp.any(new != acc), t + 1
+
+        def aff_cond(state):
+            return state[1] & (state[2] < max_trips)
+
+        aff, _, _ = jax.lax.while_loop(
+            aff_cond, aff_body, (aff, jnp.bool_(True), jnp.int32(0))
+        )
+
+        # one global warm-vs-cold decision: sum lane-partial cones over
+        # 'batch' ('graph' members already agree)
+        cone = jax.lax.psum(aff.sum().astype(jnp.int32), "batch")
+        fell_back = cone > cone_limit
+
+        valid = root_w < INF_E
+        seed_idx = jnp.clip(root_nbr, 0, n_cap - 1)
+        pin = jnp.where(valid, 0, INF_E).astype(jnp.int32)
+        lanes = jnp.arange(d_loc)
+        warm = jnp.where(aff > 0, INF_E, prev_dist)
+        warm = warm.at[lanes, seed_idx].min(pin)
+        cold = jnp.full((d_loc, n_cap), INF_E, jnp.int32)
+        cold = cold.at[lanes, seed_idx].min(pin)
+        dist0 = jnp.where(fell_back, cold, warm)
+
+        def relax(dist):
+            pc = jnp.full_like(dist, INF_E)
+
+            def cls(k, pc):
+                w_full = jax.lax.dynamic_update_slice(
+                    jnp.full((n_cap,), INF_E, jnp.int32), swm_new[k],
+                    (my_col0,),
+                )
+                return jnp.minimum(
+                    pc,
+                    jnp.roll(dist + w_full[None, :], deltas[k], axis=1),
+                )
+
+            pc = jax.lax.fori_loop(0, s_cap, cls, pc)
+            if has_res:
+                nd = dist[:, nbr_c]
+                cand = (nd + rwm_new[None]).min(axis=2)
+                pc = pc.at[:, rows_c].min(cand)
+            pc = jax.lax.pmin(pc, "graph")
+            return jnp.minimum(dist, pc)
+
+        def body(state):
+            dist, _, t = state
+            new = dist
+            for _ in range(_UNROLL):
+                new = relax(new)
+            return new, jnp.any(new != dist), t + 1
+
+        def cond(state):
+            return state[1] & (state[2] < max_trips)
+
+        dist, _, trips = jax.lax.while_loop(
+            cond, body, (dist0, jnp.bool_(True), jnp.int32(0))
+        )
+        return dist, trips[None], cone[None], fell_back[None]
+
+    shard_map, check_kw = _shard_map()
+    return shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(
+            P(),                 # deltas
+            P(None, "graph"),    # shift_w columns
+            P(), P(), P(),       # residual ELL replicated at use
+            P(),                 # root scalar
+            P("batch"),          # root_nbr
+            P("batch"),          # root_w
+            P("batch", None),    # prev_dist (lanes stay home)
+            P(), P(), P(), P(),  # dirty tuples replicated
+            P(),                 # cone_limit
+        ),
+        out_specs=(
+            P("batch", None), P("batch"), P(), P(),
+        ),
+        **check_kw,
+    )
+
+
 def pad_to(arr: np.ndarray, size: int, fill, axis: int = 0) -> np.ndarray:
     if arr.shape[axis] == size:
         return arr
@@ -292,8 +737,14 @@ def sharded_fabric_step(mesh, plan, matrix, roots, out_nbr, out_w,
     which ColumnarRib.set_full_arrays consumes directly.
     """
     g = mesh.shape["graph"]
-    n_cap = plan.n_cap
-    assert n_cap % g == 0, (n_cap, g)
+    # pad the node axis up to the graph-axis size so arbitrary capacity
+    # classes work on any mesh factorization. Exact by construction:
+    # shift deltas are signed differences (ops/edgeplan.py), so no real
+    # edge ever wraps through the pad columns, and INF_E-filled pad
+    # columns neither emit (dist + INF_E never beats a real candidate)
+    # nor receive (real targets stay < plan.n_cap) finite distances.
+    n_cap = ((plan.n_cap + g - 1) // g) * g
+    shift_w = pad_to(plan.shift_w, n_cap, INF_E, axis=1)
     r_cap = ((plan.res_rows.shape[0] + g - 1) // g) * g
     res_rows = pad_to(plan.res_rows, r_cap, -1)
     res_nbr = pad_to(plan.res_nbr, r_cap, -1)
@@ -317,7 +768,7 @@ def sharded_fabric_step(mesh, plan, matrix, roots, out_nbr, out_w,
         p_cap, a_cap, n_trips, lfa,
     )
     dist, metric, s3, nh_mask, lfa_slot, lfa_metric, ok, converged = fn(
-        plan.deltas, plan.shift_w, res_rows, res_nbr, res_w,
+        plan.deltas, shift_w, res_rows, res_nbr, res_w,
         roots.astype(np.int32), out_nbr.astype(np.int32),
         out_w.astype(np.int32),
         matrix.ann_node, flags, matrix.path_pref, matrix.source_pref,

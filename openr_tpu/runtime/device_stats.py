@@ -129,14 +129,34 @@ def live_buffer_census(allow_import: bool = False) -> dict:
         providers = list(_pools.items())
     for name, fn in providers:
         n, b = 0, 0
+        by_dev: dict[int, int] = {}
         try:
             for a in fn():
                 n += 1
                 b += int(getattr(a, "nbytes", 0) or 0)
+                # per-device attribution: a sharded array (the
+                # multichip solver tier) charges each shard's bytes to
+                # the device that holds it, so the census shows how a
+                # pool's footprint spreads across the mesh instead of
+                # lumping it on device 0
+                try:
+                    for shard in a.addressable_shards:
+                        d = getattr(shard.device, "id", 0)
+                        sb = int(
+                            getattr(shard.data, "nbytes", 0) or 0
+                        )
+                        by_dev[d] = by_dev.get(d, 0) + sb
+                # lint: allow(broad-except) non-jax arrays have no shards
+                except Exception:
+                    pass
         # lint: allow(broad-except) torn-down pool reads as empty
         except Exception:
             pass  # a torn-down pool reads as empty, not as a crash
         pools_out[name] = {"count": n, "bytes": b}
+        if by_dev:
+            pools_out[name]["by_device"] = {
+                str(d): by_dev[d] for d in sorted(by_dev)
+            }
         attributed += b
     return {
         "count": total_n,
@@ -174,6 +194,11 @@ def export_device_gauges(allow_import: bool = False) -> dict:
         counters.set_counter(
             f"device.pool.{name}.bytes_mb", round(p["bytes"] / _BYTES_PER_MB, 3)
         )
+        for d, db in (p.get("by_device") or {}).items():
+            counters.set_counter(
+                f"device.pool.{name}.dev{d}.bytes_mb",
+                round(db / _BYTES_PER_MB, 3),
+            )
     return snap
 
 

@@ -96,6 +96,51 @@ def test_bench_incremental_lane_single_flap_counters():
     assert isinstance(res["incr_changed_rows"], int), res
 
 
+def test_bench_multichip_engages_above_threshold_only():
+    """Multichip capacity-tier go/no-go smoke: the same config engages
+    the sharded path when n_cap exceeds the threshold (counter ticks,
+    mesh + per-shard timings reported, parity asserted inside
+    bench_config) and stays single-chip when it doesn't."""
+    from bench import bench_config
+    from openr_tpu.models import topologies
+    from openr_tpu.runtime.counters import counters
+
+    e0 = int(
+        counters.get_counter("decision.solver.multichip.engaged") or 0
+    )
+    res_on, _, _ = bench_config(
+        "smoke-mc-on",
+        lambda: topologies.grid(6, node_labels=False),
+        "node-3-3",
+        runs=2,
+        flap_victims=1,
+        tpu_kw={"multichip_n_cap_threshold": 16, "multichip_batch": 4},
+    )
+    e1 = int(
+        counters.get_counter("decision.solver.multichip.engaged") or 0
+    )
+    assert res_on["multichip_engaged"] is True, res_on
+    assert res_on["multichip"]["shards"] == 8, res_on
+    assert len(res_on["multichip"]["shard_ms"]) == 8, res_on
+    assert res_on["bytes_uploaded"] >= 0, res_on
+    assert e1 > e0, (e0, e1)
+
+    res_off, _, _ = bench_config(
+        "smoke-mc-off",
+        lambda: topologies.grid(6, node_labels=False),
+        "node-3-3",
+        runs=2,
+        flap_victims=1,
+        tpu_kw={"multichip_n_cap_threshold": 1 << 20},
+    )
+    e2 = int(
+        counters.get_counter("decision.solver.multichip.engaged") or 0
+    )
+    assert res_off["multichip_engaged"] is False, res_off
+    assert "multichip" not in res_off, res_off
+    assert e2 == e1, (e1, e2)
+
+
 def test_bench_config_small_graph_delegation_still_reports():
     """The auto backend's small-graph delegation path must keep the
     result dict shape (no columnar pipeline keys, but full_ms/tpu_ms)."""

@@ -290,6 +290,107 @@ class TestIncrementalSolverFailoverDrill:
             await stop_all(nodes)
 
 
+class TestMultichipSolverFailoverDrill:
+    @run_async
+    async def test_fault_during_multichip_solve_fails_over(self):
+        """Multichip capacity-tier drill: with the tier forced on
+        (threshold below the 4-node ring's n_cap, 8 virtual devices),
+        an armed solver.exec fault lands on a sharded solve mid-churn.
+        The failover must carry the event to the CPU oracle with NO
+        stale-route window — the fib lands directly on the post-churn
+        ECMP set — and after the device heals, the probe canary must
+        re-promote the node back onto the multichip path (the tier's
+        dispatch counter advances on post-heal churn)."""
+        registry.clear()
+        counters.set_counter("decision.solver.degraded", 0)
+        names = [f"node-{i}" for i in range(4)]
+        links = [
+            ("node-0", "if-01", "node-1", "if-10"),
+            ("node-1", "if-12", "node-2", "if-21"),
+            ("node-2", "if-23", "node-3", "if-32"),
+            ("node-3", "if-30", "node-0", "if-03"),
+        ]
+        mesh, nodes = await start_mesh(
+            names,
+            links,
+            solver_backend="tpu",
+            decision_config=DecisionConfig(
+                debounce_min_ms=5,
+                debounce_max_ms=25,
+                multichip_n_cap_threshold=2,
+                solver_probe_initial_backoff_s=0.2,
+                solver_probe_max_backoff_s=0.5,
+            ),
+        )
+        try:
+            for i, n in enumerate(names):
+                nodes[n].advertise_prefix(loopback(i))
+
+            def nh_set(pfx):
+                entry = nodes["node-0"].fib_routes.get(pfx)
+                if entry is None:
+                    return set()
+                return {nh.neighbor_node_name for nh in entry.nexthops}
+
+            await wait_until(
+                lambda: nh_set(loopback(2)) == {"node-1", "node-3"},
+                timeout_s=CONVERGENCE_S,
+            )
+            # the tier must actually be live before the drill means
+            # anything: the initial convergence solves were sharded
+            assert _counter("decision.solver.multichip.engaged") > 0
+            assert _counter("decision.solver.multichip.dispatches") > 0
+
+            # topology churn away from node-0's root links
+            mesh.disconnect("node-1", "if-12", "node-2", "if-21")
+            await wait_until(
+                lambda: nh_set(loopback(2)) == {"node-3"},
+                timeout_s=CONVERGENCE_S,
+            )
+
+            # the device dies; the link comes back. The solve for this
+            # event would run through the multichip tier — the armed
+            # fault must push it to the CPU oracle, which lands the
+            # restored ECMP set directly (no window serving the stale
+            # single-path route)
+            failovers0 = _counter("decision.solver.failovers")
+            promotions0 = _counter("decision.solver.promotions")
+            registry.arm("solver.exec")
+            mesh.connect("node-1", "if-12", "node-2", "if-21")
+            await wait_until(
+                lambda: nh_set(loopback(2)) == {"node-1", "node-3"}
+                and _counter("decision.solver.degraded") == 1,
+                timeout_s=CONVERGENCE_S,
+            )
+            assert _counter("decision.solver.failovers") > failovers0
+
+            # heal: the probe canary promotes the device back and churn
+            # dispatches through the multichip tier again
+            registry.clear("solver.exec")
+            await wait_until(
+                lambda: _counter("decision.solver.degraded") == 0
+                and _counter("decision.solver.promotions") > promotions0,
+                timeout_s=CONVERGENCE_S,
+            )
+            mc_disp0 = _counter("decision.solver.multichip.dispatches")
+            mesh.disconnect("node-1", "if-12", "node-2", "if-21")
+            await wait_until(
+                lambda: nh_set(loopback(2)) == {"node-3"}
+                and _counter("decision.solver.multichip.dispatches")
+                > mc_disp0,
+                timeout_s=CONVERGENCE_S,
+            )
+            mesh.connect("node-1", "if-12", "node-2", "if-21")
+            await wait_until(
+                lambda: nh_set(loopback(2)) == {"node-1", "node-3"},
+                timeout_s=CONVERGENCE_S,
+            )
+        finally:
+            registry.clear()
+            counters.set_counter("decision.solver.degraded", 0)
+            await stop_all(nodes)
+
+
 class TestDecisionFiberCrashDrill:
     @run_async
     async def test_supervisor_restarts_crashed_ingest_fiber(self):

@@ -11,6 +11,7 @@ per-vantage CPU oracle exactly.
 """
 
 import numpy as np
+import pytest
 
 from openr_tpu.decision.spf_solver import SpfSolver
 from openr_tpu.decision.tpu_solver import TpuSpfSolver
@@ -153,3 +154,122 @@ def test_fabric_matches_single_chip_solver():
     fabric = TpuSpfSolver("node-3-3")
     dbs = fabric.build_fabric_route_dbs(["node-3-3"], states, ps)
     assert_rib_equal(single_db, dbs["node-3-3"], "single vs fabric")
+
+
+def test_fabric_non_divisible_graph_axis_pads():
+    """A graph axis of 3 does not divide grid(8)'s node capacity (64);
+    sharded_fabric_step must pad the node axis up to the mesh
+    factorization instead of asserting divisibility, and the padded
+    columns must never leak finite distances into the result."""
+    adj_dbs, prefix_dbs = topologies.grid(8)
+    states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    mesh = make_mesh(6, batch=2)
+    assert mesh.shape["graph"] == 3
+    roots = ["node-0-0", "node-3-4", "node-7-7"]
+    fabric_vs_oracle(states, ps, roots, mesh=mesh)
+
+
+# -- multichip capacity tier (production single-vantage path) ---------------
+
+
+def _churn_node(ls, victim, bump):
+    """Metric-churn one node's adjacencies through the changelog path
+    (generation bump); bump=0 restores the pristine metrics."""
+    ls.update_adjacency_database(
+        AdjacencyDatabase(
+            this_node_name=victim.this_node_name,
+            adjacencies=tuple(
+                Adjacency(**{**a.__dict__, "metric": a.metric + bump})
+                for a in victim.adjacencies
+            ),
+            area="0",
+        )
+    )
+
+
+@pytest.mark.parametrize("incr", [False, True])
+def test_multichip_production_path_parity(incr):
+    """build_route_db through the multichip capacity tier (threshold
+    forced below the graph's n_cap): RIBs bit-identical to BOTH the CPU
+    oracle and the single-chip tier — including LFA backups — across
+    cold solve, metric churn, restore, link flap, and flap restore, on
+    the full-solve and incremental solvers. Tier observability
+    (counters, stats, per-shard timings) is asserted alongside."""
+    from openr_tpu.runtime.counters import counters
+
+    adj_dbs, prefix_dbs = topologies.grid(8)
+    states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    root = adj_dbs[0].this_node_name
+    ls = states["0"]
+    cpu = SpfSolver(root, enable_lfa=True)
+    single = TpuSpfSolver(root, enable_lfa=True, incremental_spf=incr)
+    mc = TpuSpfSolver(
+        root, enable_lfa=True, incremental_spf=incr,
+        multichip_n_cap_threshold=32, multichip_batch=4,
+    )
+    eng0 = counters.get_counter("decision.solver.multichip.engaged") or 0
+    dis0 = counters.get_counter("decision.solver.multichip.dispatches") or 0
+
+    def check(ctx):
+        cpu_db = cpu.build_route_db(root, states, ps)
+        mc_db = mc.build_route_db(root, states, ps)
+        assert_rib_equal(cpu_db, mc_db, f"mc vs oracle: {ctx}")
+        assert_rib_equal(
+            single.build_route_db(root, states, ps), mc_db,
+            f"mc vs single-chip: {ctx}",
+        )
+
+    check("cold")
+    mc_info = mc.last_timing["multichip"]
+    assert mc_info["shards"] == 8
+    assert mc_info["batch"] == 4 and mc_info["graph"] == 2
+    assert len(mc_info["shard_ms"]) == 8
+    assert mc.last_device_stats["multichip"]["shards"] == 8
+
+    _churn_node(ls, adj_dbs[1], 7)
+    check("metric churn")
+    _churn_node(ls, adj_dbs[1], 0)
+    check("restore")
+    victim = adj_dbs[5]
+    ls.update_adjacency_database(
+        AdjacencyDatabase(
+            this_node_name=victim.this_node_name,
+            adjacencies=(), area="0",
+        )
+    )
+    check("flap down")
+    ls.update_adjacency_database(
+        AdjacencyDatabase(
+            this_node_name=victim.this_node_name,
+            adjacencies=tuple(
+                Adjacency(**{**a.__dict__, "metric": 3})
+                for a in victim.adjacencies
+            ),
+            area="0",
+        )
+    )
+    check("flap restore")
+    eng1 = counters.get_counter("decision.solver.multichip.engaged") or 0
+    dis1 = counters.get_counter("decision.solver.multichip.dispatches") or 0
+    assert eng1 >= eng0 + 5, (eng0, eng1)
+    assert dis1 >= dis0 + 5, (dis0, dis1)
+
+
+def test_multichip_tier_stays_off_below_threshold():
+    """The same graph under the default threshold (n_cap far below it)
+    must never touch the sharded path: no mc stats, no engage ticks."""
+    from openr_tpu.runtime.counters import counters
+
+    adj_dbs, prefix_dbs = topologies.grid(8)
+    states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    root = adj_dbs[0].this_node_name
+    eng0 = counters.get_counter("decision.solver.multichip.engaged") or 0
+    tpu = TpuSpfSolver(root)
+    cpu_db = SpfSolver(root).build_route_db(root, states, ps)
+    assert_rib_equal(
+        cpu_db, tpu.build_route_db(root, states, ps), "below threshold"
+    )
+    assert not tpu.last_timing.get("multichip")
+    assert "multichip" not in tpu.last_device_stats
+    eng1 = counters.get_counter("decision.solver.multichip.engaged") or 0
+    assert eng1 == eng0

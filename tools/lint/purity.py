@@ -46,14 +46,17 @@ CODE_IMPURE = "host-impurity"
 CODE_SYNC = "host-sync"
 CODE_LOOP = "traced-loop"
 
-# modules whose call graphs we walk (roots + callees live here)
-_TRACED_MODULE_PREFIXES = ("openr_tpu/ops/",)
+# modules whose call graphs we walk (roots + callees live here);
+# parallel/ holds the shard_mapped multichip kernels — device code
+# like any other, so host impurities there are caught the same way
+_TRACED_MODULE_PREFIXES = ("openr_tpu/ops/", "openr_tpu/parallel/")
 _TRACED_MODULE_FILES = ("openr_tpu/decision/tpu_solver.py",)
 
 # callables whose function-valued arguments execute under trace
 _TRACING_FUNCS = {
     "jit", "vmap", "pmap", "scan", "while_loop", "fori_loop", "cond",
     "switch", "checkpoint", "remat", "custom_jvp", "custom_vjp",
+    "shard_map",
 }
 
 # np.* attrs that are static-safe inside traced code: dtype
