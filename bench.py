@@ -261,6 +261,24 @@ def bench_config(name, gen, me, runs=5, flap_victims=0, cpu_baseline=True,
     res["dispatch_queue_depth"] = int(
         _counters.get_counter("decision.dispatch.depth") or 0
     )
+    # flight-recorder overhead (runtime/monitor.py FlightRecorder): the
+    # always-on cost is one raw-counter ring append per monitor tick —
+    # nothing hooks the solve path. Price a tick against the measured
+    # churn iteration: even ticking once PER SOLVE (far above the 1 Hz
+    # production cadence) must fit the ≤1% budget the smoke test pins.
+    from openr_tpu.config import MonitorConfig
+    from openr_tpu.runtime.monitor import FlightRecorder
+
+    _recorder = FlightRecorder(me, MonitorConfig())
+    _FR_TICKS = 200
+    t0 = time.perf_counter()
+    for _ in range(_FR_TICKS):
+        _recorder.record_tick()
+    fr_tick_ms = (time.perf_counter() - t0) * 1e3 / _FR_TICKS
+    res["flightrec_tick_ms"] = round(fr_tick_ms, 4)
+    res["flightrec_overhead_pct"] = round(
+        100.0 * fr_tick_ms / max(tpu_ms, 1e-6), 3
+    )
     log(f"[{name}] tpu recompute: {[f'{s:.0f}' for s in samples]} ms "
         f"(sync {res['sync_ms']} / exec {res['exec_ms']} / mat {res['mat_ms']} "
         f"/ device-only {res.get('device_ms')} "

@@ -470,13 +470,21 @@ def received_routes(ctx) -> None:
 
 
 @decision.command("convergence")
+@click.option(
+    "--fleet",
+    is_flag=True,
+    help="add the fleet view: per-origin-event convergence aggregated "
+    "from every node's conv-ack ring, with straggler attribution",
+)
 @click.pass_context
-def decision_convergence(ctx) -> None:
+def decision_convergence(ctx, fleet) -> None:
     """Per-event convergence latency: p50/p95/p99 over closed traces,
     the windowed convergence_ms stat, and the solver's incremental vs
     full dispatch split (incremental_solves / incremental_full_fallbacks
-    / full_solves plus cone-fraction and changed-row stats)."""
-    _print(_call(ctx, "ctrl.decision.convergence"))
+    / full_solves plus cone-fraction and changed-row stats). With
+    --fleet, each origin event's origin→last-FIB-ack latency across the
+    whole fleet plus the straggler node."""
+    _print(_call(ctx, "ctrl.decision.convergence", {"fleet": fleet}))
 
 
 @decision.command("rib-policy")
@@ -962,6 +970,26 @@ def monitor_fleet(ctx) -> None:
     as seen from this node's KvStore — watchdog state, worst queue
     depth, convergence p99, HBM in use, sentinel anomalies."""
     _print(_call(ctx, "ctrl.monitor.fleet"))
+
+
+@monitor.command("slo")
+@click.pass_context
+def monitor_slo(ctx) -> None:
+    """SLO burn-rate report: per-SLO state (ok/fast_burn/
+    sustained_burn), current value vs threshold, fast/slow-window
+    breach fractions, and alert counts."""
+    _print(_call(ctx, "ctrl.monitor.slo"))
+
+
+@monitor.command("dump")
+@click.option("--reason", default="manual", help="trigger attribution "
+              "recorded in the bundle")
+@click.pass_context
+def monitor_dump(ctx, reason) -> None:
+    """Freeze the flight recorder NOW: writes a post-mortem bundle
+    (bundle.json + Chrome trace.json) and prints its path. Bypasses
+    the automatic-trigger rate limit."""
+    _print(_call(ctx, "ctrl.monitor.dump", {"reason": reason}))
 
 
 @monitor.command("statistics")

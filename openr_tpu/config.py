@@ -262,6 +262,53 @@ class MonitorConfig:
     # 0 = bind an ephemeral port (tests read it back from the exporter)
     metrics_port: Optional[int] = None
     metrics_listen_addr: str = "127.0.0.1"
+    # --- SLO engine (docs/Observability.md § SLO engine) ---
+    # declarative SLO table: name -> spec dict. Spec keys: kind
+    # ("stat" | "counter_delta" | "gauge_duration"), source (counter /
+    # stat name), threshold, and optional per-SLO fast_window_s /
+    # slow_window_s / burn_threshold overrides. Each SLO runs a
+    # multi-window burn-rate state machine in the Monitor metrics loop:
+    # ok -> fast_burn when the fast window's breach fraction crosses
+    # burn_threshold, -> sustained_burn when the slow window agrees,
+    # back to ok with 2x hysteresis. Empty dict disables evaluation.
+    slos: dict = field(
+        default_factory=lambda: {
+            "fleet_convergence_p99_ms": {
+                "kind": "stat",
+                "source": "fleet_convergence_ms",
+                "threshold": 2000.0,
+            },
+            "convergence_p99_ms": {
+                "kind": "stat",
+                "source": "convergence_ms",
+                "threshold": 1000.0,
+            },
+            "divergence_events": {
+                "kind": "counter_delta",
+                "source": "kvstore.divergence.events",
+                "threshold": 0.0,
+            },
+            "solver_degraded_s": {
+                "kind": "gauge_duration",
+                "source": "decision.solver.degraded",
+                "threshold": 5.0,
+            },
+        }
+    )
+    slo_fast_window_s: float = 60.0
+    slo_slow_window_s: float = 600.0
+    # fraction of window samples in breach before the window burns
+    slo_burn_threshold: float = 0.5
+    # --- flight recorder (docs/Observability.md § Flight recorder) ---
+    # always-on bounded ring of counter snapshots + anomaly events; on
+    # trigger (SLO burn, sentinel anomaly, supervisor restart,
+    # divergence, failover, or `breeze monitor dump`) the ring freezes
+    # into a self-contained post-mortem bundle (JSON + Chrome trace)
+    enable_flight_recorder: bool = True
+    flight_recorder_dir: str = ""  # "" = <tempdir>/openr_tpu_flightrec
+    flight_recorder_ring: int = 32
+    # auto-trigger rate limit: a flapping trigger must not fill the disk
+    flight_recorder_min_interval_s: float = 30.0
 
 
 @dataclass
@@ -640,6 +687,33 @@ class Config:
             raise ConfigError(
                 f"monitor metrics_port {mc.metrics_port} not in [0, 65535]"
             )
+        if not 0.0 < mc.slo_burn_threshold <= 1.0:
+            raise ConfigError(
+                f"monitor slo_burn_threshold {mc.slo_burn_threshold} "
+                "not in (0, 1]"
+            )
+        if mc.slo_fast_window_s <= 0 or mc.slo_slow_window_s <= 0:
+            raise ConfigError("monitor SLO windows must be positive")
+        if mc.slo_fast_window_s > mc.slo_slow_window_s:
+            raise ConfigError(
+                "monitor slo_fast_window_s must not exceed slo_slow_window_s"
+            )
+        _SLO_KINDS = {"stat", "counter_delta", "gauge_duration"}
+        for name, spec in (mc.slos or {}).items():
+            if not isinstance(spec, dict):
+                raise ConfigError(f"monitor slos[{name!r}] must be a dict")
+            kind = spec.get("kind")
+            if kind not in _SLO_KINDS:
+                raise ConfigError(
+                    f"monitor slos[{name!r}].kind {kind!r} not one of "
+                    f"{sorted(_SLO_KINDS)}"
+                )
+            if not spec.get("source"):
+                raise ConfigError(f"monitor slos[{name!r}] needs a 'source'")
+            if "threshold" not in spec:
+                raise ConfigError(f"monitor slos[{name!r}] needs a 'threshold'")
+        if mc.flight_recorder_ring < 1:
+            raise ConfigError("monitor flight_recorder_ring must be >= 1")
         sr = cfg.segment_routing_config
         if sr.enable_segment_routing:
             lo, hi = sr.sr_node_label_range

@@ -85,6 +85,8 @@ class CtrlServer(Actor):
         s.register("ctrl.monitor.logs", self._event_logs)
         s.register("ctrl.monitor.fleet", self._monitor_fleet)
         s.register("ctrl.monitor.crashes", self._monitor_crashes)
+        s.register("ctrl.monitor.slo", self._monitor_slo)
+        s.register("ctrl.monitor.dump", self._monitor_dump)
         # fault-injection registry (runtime/faults.py): arm / disarm /
         # inspect chaos drills on the live daemon
         s.register("ctrl.fault.inject", self._fault_inject)
@@ -255,17 +257,20 @@ class CtrlServer(Actor):
         """Chrome trace-event JSON for chrome://tracing / Perfetto."""
         return tracer.export_chrome(trace_id=trace_id, limit=limit)
 
-    async def _decision_convergence(self) -> dict:
+    async def _decision_convergence(self, fleet: bool = False) -> dict:
         """Per-event convergence latency: percentile summary over the
         closed-trace ring, the windowed convergence_ms stat, and the
         solver's incremental/full dispatch split (decision.solver.*
         counters — incr.solves ran the seed-from-previous kernel,
         incr.full_fallbacks degraded to a full solve while incremental
-        was enabled, full.solves is every cold/full dispatch)."""
+        was enabled, full.solves is every cold/full dispatch). With
+        fleet=True (breeze decision convergence --fleet) also folds in
+        the FLEET view: every node's TTL'd conv-ack ring aggregated
+        per origin event."""
         incr_stats = counters.get_statistics(
             "decision.solver.incr"
         )
-        return {
+        out = {
             "summary": tracer.convergence_summary(),
             "stat": counters.get_statistics("convergence_ms").get(
                 "convergence_ms", {}
@@ -288,6 +293,100 @@ class CtrlServer(Actor):
                 ),
             },
         }
+        if fleet:
+            out["fleet"] = await self._fleet_convergence()
+        return out
+
+    async def _fleet_convergence(self, limit: int = 20) -> dict:
+        """Aggregate the `monitor:conv-ack:<node>` rings every node
+        floods back into KvStore (fib.py stamps fleet_convergence_ms
+        when a programmed route's trace carries a remote origin stamp).
+        Grouped per origin event: fleet_ms is the LAST FIB ack's
+        latency — origin publish → slowest node programmed — and the
+        straggler is that node. Percentiles run across events."""
+        import json as _json
+
+        from openr_tpu.kvstore.kvstore import CONV_ACK_PREFIX
+        from openr_tpu.runtime.counters import _percentile
+
+        events: dict[str, dict] = {}
+        reporting: set = set()
+        if self.kvstore is not None:
+            for area in list(getattr(self.kvstore, "areas", None) or []):
+                vals = await self.kvstore.dump_all(area, CONV_ACK_PREFIX)
+                for key, val in vals.items():
+                    if val.value is None:
+                        continue
+                    try:
+                        ring = _json.loads(val.value.decode())
+                    except (ValueError, UnicodeDecodeError):
+                        continue
+                    reporting.add(key[len(CONV_ACK_PREFIX):])
+                    for ack in ring.get("acks", []):
+                        ev = events.setdefault(
+                            ack.get("event", "?"),
+                            {
+                                "origin": ack.get("origin", ""),
+                                "acks": {},
+                                "ts_ms": 0,
+                            },
+                        )
+                        node = ack.get("node", "?")
+                        ms = float(ack.get("ms", 0.0))
+                        # one node can re-program for the same origin
+                        # event (coalesced floods) — keep its slowest ack
+                        ev["acks"][node] = max(
+                            ev["acks"].get(node, 0.0), ms
+                        )
+                        ev["ts_ms"] = max(
+                            ev["ts_ms"], int(ack.get("ts_ms", 0))
+                        )
+        rows = []
+        for event_id, ev in events.items():
+            straggler = max(ev["acks"], key=ev["acks"].get)
+            rows.append(
+                {
+                    "event": event_id,
+                    "origin": ev["origin"],
+                    "ts_ms": ev["ts_ms"],
+                    "fleet_ms": round(ev["acks"][straggler], 3),
+                    "straggler": straggler,
+                    "nodes_acked": len(ev["acks"]),
+                    "acks": {
+                        n: round(ms, 3) for n, ms in ev["acks"].items()
+                    },
+                }
+            )
+        rows.sort(key=lambda r: r["ts_ms"], reverse=True)
+        fleet_ms = sorted(r["fleet_ms"] for r in rows)
+        return {
+            "local_node": self.node_name,
+            "nodes_reporting": sorted(reporting),
+            "events": rows[: max(1, limit)],
+            "event_count": len(rows),
+            "fleet_ms": {
+                "count": len(fleet_ms),
+                "p50": round(_percentile(fleet_ms, 50.0), 3),
+                "p95": round(_percentile(fleet_ms, 95.0), 3),
+                "p99": round(_percentile(fleet_ms, 99.0), 3),
+                "max": fleet_ms[-1] if fleet_ms else 0.0,
+            },
+            "stat": counters.get_statistics("fleet_convergence_ms").get(
+                "fleet_convergence_ms", {}
+            ),
+        }
+
+    async def _monitor_slo(self) -> dict:
+        """SLO burn-rate report (monitor.slo_report)."""
+        if self.monitor is None:
+            raise RuntimeError("no monitor wired to ctrl")
+        return self.monitor.slo_report()
+
+    async def _monitor_dump(self, reason: str = "manual") -> dict:
+        """Operator-triggered flight-recorder bundle."""
+        if self.monitor is None:
+            raise RuntimeError("no monitor wired to ctrl")
+        return await self.monitor.dump_flight_recorder(reason=reason)
 
     async def _watch_initialization(self, queue: ReplicateQueue) -> None:
         reader = queue.get_reader(f"{self.name}.init")

@@ -120,6 +120,13 @@ class Fib(Actor):
         self.perf_db: collections.deque[PerfEvents] = collections.deque(
             maxlen=32
         )
+        # fleet-convergence ack backchannel: set via attach_kvstore so
+        # FIB acks for origin-stamped events flood back as TTL'd
+        # monitor:conv-ack:<node> keys (None = backchannel off)
+        self._kvstore = None
+
+    def attach_kvstore(self, kvstore) -> None:
+        self._kvstore = kvstore
 
     async def on_start(self) -> None:
         self._retry_signal = asyncio.Event()
@@ -538,6 +545,31 @@ class Fib(Actor):
                 )
         counters.increment("fib.routes_programmed")
         self._fib_updates_q.push(programmed, trace=trace)
+        # fleet-convergence ack: a trace stitched to an origin event
+        # reports (origin_event_id, this node, origin->ack latency) back
+        # through the kvstore backchannel BEFORE the trace closes (the
+        # stamp lives on the active trace's root attributes)
+        attrs = tracer.root_attributes(trace)
+        event_id = attrs.get("origin_event_id")
+        if event_id is not None and self._kvstore is not None:
+            origin_ts = attrs.get("origin_ts_ms")
+            fleet_ms = (
+                max(0.0, time.time() * 1000.0 - float(origin_ts))
+                if origin_ts is not None
+                else 0.0
+            )
+            counters.add_stat_value("fleet_convergence_ms", fleet_ms)
+            try:
+                self._kvstore.record_convergence_ack(
+                    area=str(attrs.get("area") or "0"),
+                    origin_node=str(attrs.get("origin_node") or ""),
+                    origin_event_id=str(event_id),
+                    fleet_convergence_ms=fleet_ms,
+                )
+            # lint: allow(broad-except) the ack is telemetry — it must
+            # never take down route programming
+            except Exception:
+                counters.increment("fib.conv_ack_failures")
         # programming ack published: the topology event has converged
         tracer.end_trace(
             trace,

@@ -144,6 +144,10 @@ def merge_key_values(
                 hash=value.hash
                 if value.hash is not None
                 else compute_hash(value.version, value.originator_id, value.value),
+                # the winning value's origin stamp rides the merge verbatim
+                origin_node=value.origin_node,
+                origin_event_id=value.origin_event_id,
+                origin_ts_ms=value.origin_ts_ms,
             )
             kv[key] = new_value
         else:  # update_ttl
@@ -238,6 +242,9 @@ def _strip_value(val: Value) -> Value:
         ttl_ms=val.ttl_ms,
         ttl_version=val.ttl_version,
         hash=val.hash,
+        origin_node=val.origin_node,
+        origin_event_id=val.origin_event_id,
+        origin_ts_ms=val.origin_ts_ms,
     )
 
 

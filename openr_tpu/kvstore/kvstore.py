@@ -79,6 +79,11 @@ _TTL_ERASE_MS = 256  # short ttl for unset tombstones
 MONITOR_KEY_PREFIX = "monitor:"
 LSDB_DIGEST_PREFIX = "monitor:lsdb-digest:"
 FLOOD_PROBE_PREFIX = "monitor:flood-probe:"
+CONV_ACK_PREFIX = "monitor:conv-ack:"
+# per-node FIB-ack backchannel: ring size bounds the payload, the TTL
+# ages a dead node's acks out of every store by itself
+_CONV_ACK_RING = 64
+_CONV_ACK_TTL_MS = 60_000
 # beacons a node advertised more than this many intervals ago are
 # ignored by the divergence check (also the beacon TTL multiple, so a
 # dead node's beacon ages out of the comparison set by itself)
@@ -234,6 +239,14 @@ class KvStore(Actor):
         self._digest_version = int(time.time())
         self._probe_version = int(time.time())
         self._probe_seq = 0
+        # origin-event id counter, wall-seeded so a restarted node's
+        # event ids never collide with its previous incarnation's
+        self._origin_seq = int(time.time() * 1000)
+        # fleet-convergence FIB-ack backchannel (monitor:conv-ack:<node>)
+        self._conv_acks: collections.deque = collections.deque(
+            maxlen=_CONV_ACK_RING
+        )
+        self._conv_ack_version = int(time.time())
         self._divergence: dict = {}  # last computed divergence report
 
     # -- lifecycle ---------------------------------------------------------
@@ -456,6 +469,9 @@ class KvStore(Actor):
                 ttl_ms=remaining,
                 ttl_version=v.ttl_version,
                 hash=v.hash,
+                origin_node=v.origin_node,
+                origin_event_id=v.origin_event_id,
+                origin_ts_ms=v.origin_ts_ms,
             )
 
     # -- merge + publish + flood (ref mergePublication KvStore.cpp:3394) ---
@@ -463,6 +479,20 @@ class KvStore(Actor):
     def _merge_and_flood(self, pub: Publication, sender_id: str = "") -> None:
         t0 = time.monotonic()
         st = self.areas[pub.area]
+        # fleet-convergence origin stamp: a locally-originated publication
+        # (module write, ctrl write, beacon/probe origination) is THE
+        # origin event — stamp it once here; flood merge carries the stamp
+        # unchanged so every receiver can attribute its convergence work
+        # (and its FIB ack) back to this event
+        if not sender_id:
+            self._origin_seq += 1
+            event_id = f"{self.node_name}:{self._origin_seq}"
+            ts_ms = time.time() * 1000.0
+            for val in pub.key_vals.values():
+                if val.origin_node is None and val.value is not None:
+                    val.origin_node = self.node_name
+                    val.origin_event_id = event_id
+                    val.origin_ts_ms = ts_ms
         stats = MergeStats()
         updates = merge_key_values(st.kv, pub.key_vals, stats=stats)
         counters.increment(
@@ -506,7 +536,19 @@ class KvStore(Actor):
             area=pub.area,
         )
         # trace root: one topology event enters here and carries a single
-        # trace_id through decision -> fib -> platform programming ack
+        # trace_id through decision -> fib -> platform programming ack.
+        # The origin stamp of the winning values links this node's span
+        # tree to the remote (or local) origin event — the cross-node
+        # stitch the fleet-convergence view joins on.
+        origin_attrs: dict = {}
+        for val in updates.values():
+            if val.origin_event_id is not None:
+                origin_attrs = {
+                    "origin_node": val.origin_node,
+                    "origin_event_id": val.origin_event_id,
+                    "origin_ts_ms": val.origin_ts_ms,
+                }
+                break
         ctx = tracer.start_trace(
             "convergence",
             start=t0,
@@ -515,6 +557,7 @@ class KvStore(Actor):
             origin=sender_id or "local",
             num_keys=len(updates),
             num_expired=len(pub.expired_keys),
+            **origin_attrs,
         )
         if ctx is not None:
             tracer.record_span(
@@ -1166,6 +1209,11 @@ class KvStore(Actor):
                 "mismatched": mismatched,
             }
         diverged = sorted(suspects)
+        if diverged and not self._divergence.get("diverged"):
+            # edge-triggered monotonic event count: the gauge above says
+            # "diverged NOW"; this says "how many times we ENTERED the
+            # diverged state" — the series SLO burn-rate math needs
+            counters.increment("kvstore.divergence.events")
         counters.set_counter(
             "kvstore.divergence.detected", 1.0 if diverged else 0.0
         )
@@ -1282,6 +1330,52 @@ class KvStore(Actor):
             f"kvstore.flood_rtt_ms.{val.originator_id}", delay_ms
         )
         counters.increment(f"kvstore.{self.node_name}.flood_probes_received")
+
+    # -- fleet-convergence FIB-ack backchannel -----------------------------
+
+    def record_convergence_ack(
+        self,
+        area: str,
+        origin_node: str,
+        origin_event_id: str,
+        fleet_convergence_ms: float,
+    ) -> None:
+        """Called by Fib when a programmed-routes publication closes a
+        trace carrying a remote (or local) origin stamp: append the ack
+        to this node's ring and flood it as a TTL'd
+        `monitor:conv-ack:<node>` key, so ANY node can join origin
+        events to the fleet-wide set of FIB acks and render per-event
+        fleet convergence (origin -> last ack anywhere)."""
+        self._conv_acks.append(
+            {
+                "event": origin_event_id,
+                "origin": origin_node,
+                "node": self.node_name,
+                "ms": round(float(fleet_convergence_ms), 3),
+                "ts_ms": int(time.time() * 1000),
+            }
+        )
+        counters.increment(f"kvstore.{self.node_name}.conv_acks")
+        st = self.areas.get(area) or next(iter(self.areas.values()), None)
+        if st is None:
+            return
+        self._conv_ack_version += 1
+        payload = json.dumps(
+            {"node": self.node_name, "acks": list(self._conv_acks)}
+        ).encode()
+        self._merge_and_flood(
+            Publication(
+                key_vals={
+                    f"{CONV_ACK_PREFIX}{self.node_name}": Value(
+                        version=self._conv_ack_version,
+                        originator_id=self.node_name,
+                        value=payload,
+                        ttl_ms=_CONV_ACK_TTL_MS,
+                    )
+                },
+                area=st.area,
+            )
+        )
 
     # -- TTL expiry --------------------------------------------------------
 

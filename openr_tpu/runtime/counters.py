@@ -159,6 +159,13 @@ class CounterRegistry:
         }
         return counters_snap, stats
 
+    def raw_counters(self) -> dict[str, float]:
+        """Plain-counter snapshot WITHOUT the windowed stat aggregation
+        get_counters folds in — one dict copy under the lock. The cheap
+        path for high-frequency samplers (flight-recorder ticks)."""
+        with self._lock:
+            return dict(self._counters)
+
     def get_counters(self, prefix: str = "") -> dict[str, float]:
         with self._lock:
             out = {k: v for k, v in self._counters.items() if k.startswith(prefix)}

@@ -25,7 +25,10 @@ derivable as sum/count and is not exported).
 from __future__ import annotations
 
 import asyncio
+import platform
 import re
+import sys
+import time
 from typing import Optional
 
 from openr_tpu.runtime.counters import CounterRegistry, counters
@@ -154,10 +157,55 @@ def parse_exposition(text: str) -> dict[tuple, float]:
     return out
 
 
+def _label_escape(value: str) -> str:
+    return (
+        value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def build_info_labels() -> dict[str, str]:
+    """Identity labels for the `openr_tpu_build_info` info gauge:
+    package version, jax/jaxlib fingerprint, and the active backend.
+    Passive on jax — reads versions only if something else already
+    imported it (device_stats._jax discipline), so a scrape never
+    drags the device toolchain into a control-plane-only process."""
+    import openr_tpu
+    from openr_tpu.runtime import device_stats
+
+    jax = device_stats._jax(allow_import=False)
+    jaxlib = sys.modules.get("jaxlib")
+    return {
+        "version": openr_tpu.__version__,
+        "python": platform.python_version(),
+        "jax": getattr(jax, "__version__", "absent") if jax else "absent",
+        "jaxlib": getattr(jaxlib, "__version__", "absent")
+        if jaxlib
+        else "absent",
+        "backend": device_stats.collect_device_stats()["backend"],
+    }
+
+
+def render_build_info() -> str:
+    """The prometheus info-gauge idiom: constant value 1, identity in
+    the labels — `openr_tpu_build_info{version=...,jax=...} 1`."""
+    labels = ",".join(
+        f'{k}="{_label_escape(v)}"'
+        for k, v in sorted(build_info_labels().items())
+    )
+    name = METRIC_PREFIX + "build_info"
+    return (
+        f"# HELP {name} build/runtime identity (constant 1)\n"
+        f"# TYPE {name} gauge\n"
+        f"{name}{{{labels}}} 1\n"
+    )
+
+
 def render_registry(registry: Optional[CounterRegistry] = None) -> str:
     reg = registry if registry is not None else counters
     counters_snap, stats_snap = reg.export_snapshot()
-    return render_exposition(counters_snap, stats_snap)
+    return render_build_info() + render_exposition(
+        counters_snap, stats_snap
+    )
 
 
 class MetricsExporter:
@@ -203,10 +251,15 @@ class MetricsExporter:
             if len(parts) >= 2 and parts[0] == "GET" and (
                 parts[1] == "/metrics" or parts[1].startswith("/metrics?")
             ):
+                t0 = time.perf_counter()
                 body = render_registry(self._registry).encode()
                 status = "200 OK"
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
                 counters.increment("monitor.metrics_scrapes")
+                counters.add_stat_value(
+                    "monitor.metrics_scrape_ms",
+                    (time.perf_counter() - t0) * 1000.0,
+                )
             else:
                 body = b"openr_tpu exporter: scrape /metrics\n"
                 status = "404 Not Found"

@@ -21,6 +21,7 @@ import logging
 import os
 import resource
 import sys
+import tempfile
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
@@ -77,6 +78,327 @@ class LogSample:
         )
 
 
+_SLO_STATE_LEVEL = {"ok": 0, "fast_burn": 1, "sustained_burn": 2}
+
+
+class _SloTrack:
+    """Per-SLO burn-rate state machine bookkeeping."""
+
+    __slots__ = (
+        "name",
+        "spec",
+        "state",
+        "samples",
+        "value",
+        "fast_burn",
+        "slow_burn",
+        "alerts",
+        "last_transition_ms",
+        "_gauge_since",
+        "_prev_counter",
+    )
+
+    def __init__(self, name: str, spec: dict):
+        self.name = name
+        self.spec = spec
+        self.state = "ok"
+        # (monotonic ts, breached) per evaluation tick; pruned to the
+        # slow window — the fast window is a suffix of the same deque
+        self.samples: collections.deque = collections.deque()
+        self.value = 0.0
+        self.fast_burn = 0.0
+        self.slow_burn = 0.0
+        self.alerts = 0
+        self.last_transition_ms = 0
+        self._gauge_since: Optional[float] = None
+        self._prev_counter: Optional[float] = None
+
+
+class SloEngine:
+    """Declarative SLO table → multi-window burn-rate state machines.
+
+    Each spec in MonitorConfig.slos names a counter-fabric source, a
+    kind, and a threshold. Every monitor tick measures the source,
+    records whether it breached, and tracks the breach FRACTION over a
+    fast and a slow window (the SRE-workbook multi-window burn-rate
+    pattern): a fast-window fraction ≥ burn_threshold raises the alert
+    (pages fast on hard outages), and a slow-window fraction ≥ the same
+    threshold escalates to sustained_burn (distinguishes a blip from a
+    budget-eating trend). De-assert needs the fast window to drain to
+    half the threshold AND a clean current tick — 2× hysteresis so a
+    flapping source can't strobe the alert.
+
+    Kinds:
+      stat           — windowed quantile (default p99) of a stat series
+                       vs threshold; no samples in window = no breach
+      counter_delta  — increase of a monotonic counter since the last
+                       tick > threshold (threshold 0 = any increase)
+      gauge_duration — gauge continuously nonzero for ≥ threshold
+                       seconds
+    """
+
+    def __init__(self, node_name: str, cfg: MonitorConfig):
+        self.node_name = node_name
+        self.cfg = cfg
+        self._tracks = {
+            name: _SloTrack(name, dict(spec))
+            for name, spec in (cfg.slos or {}).items()
+        }
+
+    def _windows(self, spec: dict) -> tuple:
+        fast = float(spec.get("fast_window_s", self.cfg.slo_fast_window_s))
+        slow = float(spec.get("slow_window_s", self.cfg.slo_slow_window_s))
+        return fast, max(slow, fast)
+
+    def _measure(self, track: _SloTrack, now: float) -> tuple:
+        """→ (value, breached) for one SLO at this tick."""
+        spec = track.spec
+        kind = spec.get("kind", "stat")
+        source = spec["source"]
+        threshold = float(spec["threshold"])
+        if kind == "stat":
+            fast_s, _ = self._windows(spec)
+            win = counters.get_statistics(
+                source, windows=(max(fast_s, 1.0),)
+            ).get(source, {})
+            agg = next(iter(win.values()), {})
+            value = float(agg.get(spec.get("quantile", "p99"), 0.0))
+            return value, bool(agg.get("count", 0)) and value > threshold
+        if kind == "counter_delta":
+            cur = float(counters.get_counter(source) or 0.0)
+            prev = track._prev_counter
+            track._prev_counter = cur
+            # first observation establishes the baseline — a counter
+            # that predates the engine must not fire retroactively
+            value = 0.0 if prev is None else max(0.0, cur - prev)
+            return value, value > threshold
+        # gauge_duration
+        gauge = float(counters.get_counter(source) or 0.0)
+        if gauge > 0.0:
+            if track._gauge_since is None:
+                track._gauge_since = now
+            value = now - track._gauge_since
+            return value, value >= threshold
+        track._gauge_since = None
+        # a cleared gauge never breaches — even at threshold 0, where
+        # value >= threshold would hold vacuously forever
+        return 0.0, False
+
+    def evaluate(self) -> list[dict]:
+        """One engine tick over every SLO; exports the per-SLO gauges
+        and returns ONLY newly-raised burn alerts (ok → fast_burn
+        transitions) — escalation and recovery are gauge transitions,
+        not pages."""
+        now = time.monotonic()
+        alerts = []
+        for name, track in self._tracks.items():
+            value, breached = self._measure(track, now)
+            track.value = value
+            fast_s, slow_s = self._windows(track.spec)
+            track.samples.append((now, breached))
+            while track.samples and track.samples[0][0] < now - slow_s:
+                track.samples.popleft()
+            fast_cut = now - fast_s
+            fast = [b for ts, b in track.samples if ts >= fast_cut]
+            track.fast_burn = sum(fast) / len(fast) if fast else 0.0
+            track.slow_burn = sum(b for _, b in track.samples) / len(
+                track.samples
+            )
+            burn_at = float(
+                track.spec.get("burn_threshold", self.cfg.slo_burn_threshold)
+            )
+            prev_state = track.state
+            if track.state == "ok":
+                if fast and track.fast_burn >= burn_at:
+                    track.state = "fast_burn"
+            elif track.fast_burn <= burn_at / 2.0 and not breached:
+                track.state = "ok"
+            elif track.state == "fast_burn" and track.slow_burn >= burn_at:
+                track.state = "sustained_burn"
+            if track.state != prev_state:
+                track.last_transition_ms = int(time.time() * 1000)
+                if prev_state == "ok":
+                    track.alerts += 1
+                    counters.increment(f"monitor.slo.{name}.alerts")
+                    alerts.append(
+                        {
+                            "slo": name,
+                            "state": track.state,
+                            "source": track.spec["source"],
+                            "threshold": float(track.spec["threshold"]),
+                            "value": round(value, 3),
+                            "fast_burn": round(track.fast_burn, 3),
+                            "slow_burn": round(track.slow_burn, 3),
+                        }
+                    )
+            base = f"monitor.slo.{name}"
+            counters.set_counter(
+                f"{base}.burning", float(_SLO_STATE_LEVEL[track.state])
+            )
+            counters.set_counter(f"{base}.fast_burn", round(track.fast_burn, 4))
+            counters.set_counter(f"{base}.slow_burn", round(track.slow_burn, 4))
+            counters.set_counter(f"{base}.value", round(value, 4))
+        return alerts
+
+    def report(self) -> dict:
+        """`ctrl.monitor.slo` / `breeze monitor slo` payload."""
+        return {
+            "node": self.node_name,
+            "ts_ms": int(time.time() * 1000),
+            "fast_window_s": self.cfg.slo_fast_window_s,
+            "slow_window_s": self.cfg.slo_slow_window_s,
+            "burn_threshold": self.cfg.slo_burn_threshold,
+            "slos": {
+                name: {
+                    "state": t.state,
+                    "kind": t.spec.get("kind", "stat"),
+                    "source": t.spec["source"],
+                    "threshold": float(t.spec["threshold"]),
+                    "value": round(t.value, 3),
+                    "fast_burn": round(t.fast_burn, 3),
+                    "slow_burn": round(t.slow_burn, 3),
+                    "alerts": t.alerts,
+                    "last_transition_ms": t.last_transition_ms,
+                }
+                for name, t in self._tracks.items()
+            },
+        }
+
+
+class FlightRecorder:
+    """Always-on bounded black box; freezes to a post-mortem bundle.
+
+    Pull-based by design: NOTHING hooks the hot path. The monitor tick
+    appends one raw-counter dict copy to a bounded ring (microseconds),
+    interesting LogSamples get noted into a bounded event deque, and
+    the expensive gathering — closed trace roots, windowed statistics,
+    the kernel ledger, the Chrome export — happens only at trigger
+    time. That's what keeps untriggered overhead inside the ≤1% bench
+    budget.
+
+    A trigger freezes everything into a self-contained directory
+    bundle: `bundle.json` (trigger attribution + ring + traces +
+    counters + ledger) and `trace.json` (Chrome trace-event export,
+    loadable in ui.perfetto.dev). Automatic triggers are rate-limited
+    by flight_recorder_min_interval_s; manual dumps bypass the limit.
+    """
+
+    def __init__(self, node_name: str, cfg: MonitorConfig):
+        self.node_name = node_name
+        self.cfg = cfg
+        self.dir = cfg.flight_recorder_dir or os.path.join(
+            tempfile.gettempdir(), "openr_tpu_flightrec"
+        )
+        self._ring = max(1, int(cfg.flight_recorder_ring))
+        self._counter_ring: collections.deque = collections.deque(
+            maxlen=self._ring
+        )
+        self._events: collections.deque = collections.deque(
+            maxlen=max(self._ring * 4, 128)
+        )
+        self._last_trigger = -float("inf")
+        self.bundles: collections.deque = collections.deque(maxlen=8)
+
+    def record_tick(self) -> None:
+        """Cheap periodic sample: raw counters only (one dict copy
+        under the registry lock) — no stat-window aggregation here."""
+        self._counter_ring.append(
+            {
+                "ts_ms": int(time.time() * 1000),
+                "counters": counters.raw_counters(),
+            }
+        )
+
+    def note_event(self, event: str, values: Optional[dict] = None) -> None:
+        """Record a notable event (sentinel/supervisor/slo/divergence
+        LogSamples) into the ring so the bundle shows the lead-up."""
+        self._events.append(
+            {
+                "ts_ms": int(time.time() * 1000),
+                "event": event,
+                **(values or {}),
+            }
+        )
+
+    def trigger(
+        self,
+        reason: str,
+        detail: Optional[dict] = None,
+        extra: Optional[dict] = None,
+        force: bool = False,
+    ) -> Optional[dict]:
+        """Freeze the ring and write a bundle. Returns the bundle record
+        or None (rate-limited / write failed). Runs synchronously —
+        async callers push it onto a worker thread."""
+        now = time.monotonic()
+        if (
+            not force
+            and now - self._last_trigger
+            < self.cfg.flight_recorder_min_interval_s
+        ):
+            counters.increment("monitor.flight_recorder.suppressed")
+            return None
+        self._last_trigger = now
+        bundle = self._freeze(reason, detail, extra)
+        try:
+            path = self._write(bundle)
+        except OSError:
+            counters.increment("monitor.flight_recorder.write_errors")
+            log.warning("flight recorder: bundle write failed", exc_info=True)
+            return None
+        counters.increment("monitor.flight_recorder.triggers")
+        record = {
+            "path": path,
+            "reason": reason,
+            "ts_ms": bundle["trigger"]["ts_ms"],
+        }
+        self.bundles.append(record)
+        log.warning("flight recorder: bundle %s → %s", reason, path)
+        return record
+
+    def _freeze(
+        self, reason: str, detail: Optional[dict], extra: Optional[dict]
+    ) -> dict:
+        # deferred: ops pulls in the device toolchain; the recorder must
+        # construct in processes that never touch a solver
+        from openr_tpu.ops.xla_cache import ledger
+
+        counters_snap, stats = counters.export_snapshot()
+        bundle = {
+            "schema": "openr-tpu-flight-recorder/1",
+            "node": self.node_name,
+            "trigger": {
+                "reason": reason,
+                "ts_ms": int(time.time() * 1000),
+                "detail": detail or {},
+            },
+            "traces": tracer.get_traces(limit=self._ring),
+            "counters": counters_snap,
+            "statistics": stats,
+            "kernel_ledger": ledger.snapshot(),
+            "events": list(self._events),
+            "counter_history": list(self._counter_ring),
+        }
+        if extra:
+            bundle.update(extra)
+        return bundle
+
+    def _write(self, bundle: dict) -> str:
+        reason = "".join(
+            c if c.isalnum() or c in "-_" else "-"
+            for c in bundle["trigger"]["reason"]
+        )
+        path = os.path.join(
+            self.dir, f"{self.node_name}-{bundle['trigger']['ts_ms']}-{reason}"
+        )
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "bundle.json"), "w") as f:
+            json.dump(bundle, f, indent=1, sort_keys=True, default=str)
+        with open(os.path.join(path, "trace.json"), "w") as f:
+            f.write(tracer.export_chrome_json(limit=self._ring))
+        return path
+
+
 class Monitor(Actor):
     """ref MonitorBase.h:32."""
 
@@ -110,6 +432,19 @@ class Monitor(Actor):
         # kill-switch rides on it (ISSUE: disabled tracing must cost no
         # more than a dict lookup per queue push)
         tracer.configure(enabled=config.enable_tracing)
+        self.slo_engine = (
+            SloEngine(node_name, config) if config.slos else None
+        )
+        self.flight_recorder = (
+            FlightRecorder(node_name, config)
+            if config.enable_flight_recorder
+            else None
+        )
+        # divergence-events watermark for the edge-triggered recorder
+        # trigger (distinct from the SLO, which has its own baseline)
+        self._prev_divergence_events = float(
+            counters.get_counter("kvstore.divergence.events") or 0.0
+        )
 
     def attach_fleet_sources(self, kvstore=None, watchdog=None) -> None:
         """Wire the health summary's inputs: the KvStore actor to
@@ -162,6 +497,93 @@ class Monitor(Actor):
                     counters.increment("monitor.event_logs.dropped")
                 self.event_logs.append(sample)
                 counters.increment("monitor.event_logs")
+                await self._observe_sample(sample)
+
+    # LogSample events that trip the flight recorder, keyed to the
+    # trigger-attribution reason the bundle carries
+    _TRIGGER_EVENTS = {
+        "DECISION_SENTINEL_ANOMALY": "sentinel_anomaly",
+        "SUPERVISOR_RESTART": "supervisor_restart",
+        "DECISION_SOLVER_DEGRADED": "solver_failover",
+    }
+    # LogSample categories worth keeping in the recorder's event ring
+    # even when they don't trigger (the bundle shows the lead-up)
+    _NOTE_CATEGORIES = {"sentinel", "supervisor", "slo", "spark"}
+
+    async def _observe_sample(self, sample: LogSample) -> None:
+        recorder = self.flight_recorder
+        if recorder is None:
+            return
+        if sample.values.get("category") in self._NOTE_CATEGORIES:
+            recorder.note_event(
+                sample.event, {"node": sample.node_name, **sample.values}
+            )
+        reason = self._TRIGGER_EVENTS.get(sample.event)
+        if reason is not None:
+            await self._trigger_recorder(
+                reason,
+                {
+                    "event": sample.event,
+                    "node": sample.node_name,
+                    **sample.values,
+                },
+            )
+
+    async def _trigger_recorder(
+        self, reason: str, detail: dict, force: bool = False
+    ) -> Optional[dict]:
+        recorder = self.flight_recorder
+        if recorder is None:
+            return None
+        extra = (
+            {"slo": self.slo_engine.report()}
+            if self.slo_engine is not None
+            else None
+        )
+        # the freeze walks lock-protected registries and the write hits
+        # disk — worker thread, never the control-plane event loop
+        return await asyncio.to_thread(
+            recorder.trigger, reason, detail, extra, force
+        )
+
+    async def _observability_tick(self) -> None:
+        """SLO evaluation + divergence edge detection + recorder tick —
+        one call per metrics interval."""
+        recorder = self.flight_recorder
+        div = float(
+            counters.get_counter("kvstore.divergence.events") or 0.0
+        )
+        if div > self._prev_divergence_events:
+            if recorder is not None:
+                recorder.note_event("LSDB_DIVERGENCE", {"events": div})
+            await self._trigger_recorder(
+                "divergence",
+                {
+                    "divergence_events": div,
+                    "previous": self._prev_divergence_events,
+                },
+            )
+        self._prev_divergence_events = div
+        if self.slo_engine is not None:
+            for alert in self.slo_engine.evaluate():
+                sample = LogSample(
+                    event="SLO_BURN_ALERT",
+                    node_name=self.node_name,
+                    values={"category": "slo", **alert},
+                )
+                self.event_logs.append(sample)
+                counters.increment("monitor.event_logs")
+                log.warning("SLO burn alert: %s", sample.to_json())
+                if recorder is not None:
+                    recorder.note_event(
+                        sample.event,
+                        {"node": sample.node_name, **sample.values},
+                    )
+                await self._trigger_recorder(
+                    f"slo_burn:{alert['slo']}", alert
+                )
+        if recorder is not None:
+            recorder.record_tick()
 
     async def _metrics_loop(self) -> None:
         """Process gauges (role of SystemMetrics.{h,cpp})."""
@@ -183,6 +605,11 @@ class Monitor(Actor):
                 except Exception:
                     counters.increment("monitor.device_poll_errors")
                     log.debug("device gauge export failed", exc_info=True)
+            try:
+                await self._observability_tick()
+            except Exception:
+                counters.increment("monitor.slo.tick_errors")
+                log.debug("observability tick failed", exc_info=True)
             await asyncio.sleep(self._interval_s)
 
     # -- fleet health (advertised over the flooding fabric) ----------------
@@ -319,6 +746,30 @@ class Monitor(Actor):
                 or s.values.get("category") == category
             ]
         return [s.to_json() for s in samples]
+
+    def slo_report(self) -> dict:
+        """ctrl.monitor.slo payload; enabled=False when no SLO table."""
+        if self.slo_engine is None:
+            return {
+                "node": self.node_name,
+                "enabled": False,
+                "slos": {},
+            }
+        return {"enabled": True, **self.slo_engine.report()}
+
+    async def dump_flight_recorder(
+        self, reason: str = "manual", detail: Optional[dict] = None
+    ) -> dict:
+        """ctrl.monitor.dump — operator-requested bundle; bypasses the
+        automatic-trigger rate limit."""
+        if self.flight_recorder is None:
+            return {"ok": False, "error": "flight recorder disabled"}
+        record = await self._trigger_recorder(
+            reason, detail or {}, force=True
+        )
+        if record is None:
+            return {"ok": False, "error": "bundle write failed"}
+        return {"ok": True, **record}
 
 # -- heap profiling (role of MonitorBase::dumpHeapProfile,
 # MonitorBase.h:54 — the reference hooks jemalloc; the Python runtime's

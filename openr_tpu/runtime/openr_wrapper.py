@@ -220,6 +220,9 @@ class OpenrWrapper:
             retry_initial_backoff_s=0.02,
             retry_max_backoff_s=0.2,
         )
+        # fleet-convergence backchannel: FIB acks for origin-stamped
+        # events flood back as monitor:conv-ack:<node> keys
+        self.fib.attach_kvstore(self.kvstore)
 
     def set_monitor(self, monitor) -> None:
         """Attach the Monitor actor for ctrl event-log introspection.

@@ -40,6 +40,11 @@ MAX_CLOSED_TRACES = 256
 # the platform is down) must not leak — oldest active is force-closed
 # with status "evicted" once this many are in flight
 MAX_ACTIVE_TRACES = 256
+# side-table cap: a stuck consumer (queue reader crashed between push
+# and pop) strands contexts whose items never get collected — past this
+# many, orphans (contexts of no-longer-active traces) are evicted
+# first, then the oldest entries
+MAX_TRACE_CONTEXTS = 1024
 
 
 class Span:
@@ -189,11 +194,39 @@ class Tracer:
         except TypeError:
             return False
         self._ctx_by_id[key] = ctx
+        if len(self._ctx_by_id) > MAX_TRACE_CONTEXTS:
+            self._evict_contexts()
         return True
+
+    def _evict_contexts(self) -> None:
+        """Side-table hygiene: drop contexts whose trace already closed
+        (the span tree is finished — the entry can only go stale), then
+        oldest-first down to the cap. Keeps a wedged consumer from
+        growing the table unbounded."""
+        evicted = 0
+        with self._lock:
+            if len(self._ctx_by_id) > MAX_TRACE_CONTEXTS:
+                orphans = [
+                    k for k, c in self._ctx_by_id.items()
+                    if c.trace_id not in self._active
+                ]
+                for k in orphans:
+                    self._ctx_by_id.pop(k, None)
+                evicted += len(orphans)
+            excess = len(self._ctx_by_id) - MAX_TRACE_CONTEXTS
+            if excess > 0:
+                for k in list(itertools.islice(self._ctx_by_id, excess)):
+                    self._ctx_by_id.pop(k, None)
+                evicted += excess
+        if evicted:
+            counters.increment("tracing.contexts_evicted", evicted)
 
     def context_of(self, item: Any) -> Optional[TraceContext]:
         """One dict lookup; safe on any object."""
         return self._ctx_by_id.get(id(item))
+
+    def active_context_count(self) -> int:
+        return len(self._ctx_by_id)
 
     def detach(self, item: Any) -> Optional[TraceContext]:
         return self._ctx_by_id.pop(id(item), None)
@@ -303,6 +336,18 @@ class Tracer:
             tr.spans.append(span)
             return span
 
+    def root_attributes(self, ctx: Optional[TraceContext]) -> dict:
+        """Copy of an ACTIVE trace's root-span attributes — how Fib reads
+        the origin stamp the KvStore ingress threaded onto the trace.
+        Empty dict for None/closed/unknown contexts."""
+        if ctx is None or not self.enabled:
+            return {}
+        with self._lock:
+            tr = self._active.get(ctx.trace_id)
+            if tr is None:
+                return {}
+            return dict(tr.spans[0].attributes)
+
     def annotate(self, ctx: Optional[TraceContext], **attributes) -> None:
         """Stamp attributes onto an active trace's root span without
         closing it — e.g. degraded=True when the solver failed over
@@ -394,14 +439,23 @@ class Tracer:
                 if trace_id is None or t.trace_id == trace_id
             ][-max(1, limit):]
             wall0, mono0 = self._wall_anchor, self._mono_anchor
-        pid = os.getpid()
-        tids: dict[str, int] = {}
+        # one process lane per NODE (the root span's `node` attribute):
+        # a stitched fleet trace renders each node's kvstore→decision→fib
+        # tree in its own lane; traces without a node attr (e.g.
+        # supervisor-restart one-spanners) share a process-named lane
+        fallback = f"pid:{os.getpid()}"
+        pids: dict[str, int] = {}
+        tids: dict[tuple[int, str], int] = {}
         events: list[dict] = []
         for t in picked:
+            node = str(t.spans[0].attributes.get("node") or fallback)
+            pid = pids.setdefault(node, len(pids) + 1)
             for s in t.spans:
                 if s.end is None:
                     continue
-                tid = tids.setdefault(s.thread or "main", len(tids) + 1)
+                tid = tids.setdefault(
+                    (pid, s.thread or "main"), len(tids) + 1
+                )
                 ts_us = (wall0 + (s.start - mono0)) * 1e6
                 events.append({
                     "name": s.name,
@@ -424,13 +478,24 @@ class Tracer:
                 })
         meta = [
             {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": node},
+            }
+            for node, pid in sorted(pids.items(), key=lambda kv: kv[1])
+        ] + [
+            {
                 "name": "thread_name",
                 "ph": "M",
                 "pid": pid,
                 "tid": tid,
                 "args": {"name": thread},
             }
-            for thread, tid in sorted(tids.items(), key=lambda kv: kv[1])
+            for (pid, thread), tid in sorted(
+                tids.items(), key=lambda kv: kv[1]
+            )
         ]
         return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 

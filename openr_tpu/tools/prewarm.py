@@ -15,9 +15,21 @@ production LSDB of that class hits, because capacities are pow2-rounded
 (ops/edgeplan.py). Classes whose real deployment uses KSP2 or LFA
 should prewarm those variants too — they are distinct programs.
 
+Beyond the default full-solve executables, the solver keeps three more
+jit-cache namespaces (ops/xla_cache.py bounded_jit_cache): "incr"
+(seed-from-previous incremental SSSP), "multichip" (the sharded
+capacity tier), and "whatif" (interactive sweep batches). Each is a
+distinct program set — a daemon that cold-starts straight into churn
+pays the incr compile on its first flap unless it was baked. --incr /
+--multichip / --whatif prewarm those namespaces too, and each bake
+records a `prewarm[<namespace>:<nodes>]` entry (compile_ms) in the
+kernel ledger so `breeze tpu kernels` shows what the bake paid per
+workload class.
+
 Usage:
     openr-tpu-prewarm --nodes 1024 --nodes 100000 --lfa --ksp2
     openr-tpu-prewarm --nodes 50000 --cache-dir /var/cache/openr-xla
+    openr-tpu-prewarm --nodes 4096 --incr --whatif --multichip --devices 8
 """
 
 from __future__ import annotations
@@ -35,6 +47,124 @@ def _grid_side(nodes: int) -> int:
     import math
 
     return max(2, math.isqrt(max(nodes, 1) - 1) + 1)
+
+
+def _record_prewarm(namespace: str, nodes: int, dt_s: float) -> None:
+    """One kernel-ledger entry per (namespace, class) bake: the
+    flight-recorder bundle and ctrl.tpu.kernels then attribute prewarm
+    compile cost per workload class."""
+    from openr_tpu.ops.xla_cache import ledger
+    from openr_tpu.runtime.counters import counters
+
+    ledger.record(f"prewarm[{namespace}:{nodes}]", dt_s * 1e3, {})
+    counters.add_stat_value(
+        f"xla_cache.prewarm.{namespace}.compile_ms", dt_s * 1e3
+    )
+
+
+def _grid_inputs(nodes: int):
+    from openr_tpu.models import topologies
+
+    side = _grid_side(nodes)
+    adj_dbs, prefix_dbs = topologies.grid(side, node_labels=False)
+    states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    me = adj_dbs[len(adj_dbs) // 2].this_node_name
+    return side, adj_dbs, states, ps, me
+
+
+def _flap_one(states, adj_dbs, metric: int = 55) -> None:
+    """One node's adjacencies re-advertised at a new metric through the
+    real update path — enough churn to engage the incremental lane."""
+    from openr_tpu.types import Adjacency, AdjacencyDatabase
+
+    area = next(iter(states))
+    db = adj_dbs[1]
+    states[area].update_adjacency_database(
+        AdjacencyDatabase(
+            this_node_name=db.this_node_name,
+            adjacencies=tuple(
+                Adjacency(**{**a.__dict__, "metric": metric})
+                for a in db.adjacencies
+            ),
+            node_label=db.node_label,
+            area=area,
+        )
+    )
+
+
+def prewarm_incr(nodes: int, verbose: bool = True) -> float:
+    """Bake the "incr" namespace: a cold solve seeds the resident
+    distance plane, then a metric flap re-solves through the
+    incremental pipeline — compiling the dirty-cap shape class the
+    production churn path hits first."""
+    from openr_tpu.decision.tpu_solver import TpuSpfSolver
+
+    side, adj_dbs, states, ps, me = _grid_inputs(nodes)
+    solver = TpuSpfSolver(me, incremental_spf=True)
+    t0 = time.perf_counter()
+    solver.build_route_db(me, states, ps)  # cold seed
+    _flap_one(states, adj_dbs)
+    solver.build_route_db(me, states, ps)  # incr-namespace compile
+    dt = time.perf_counter() - t0
+    _record_prewarm("incr", side * side, dt)
+    if verbose:
+        print(
+            f"[prewarm] class {side}x{side} ({side * side} nodes)"
+            f" +incr: {dt:.1f}s"
+        )
+    return dt
+
+
+def prewarm_multichip(nodes: int, verbose: bool = True) -> float:
+    """Bake the "multichip" namespace by forcing the capacity tier on
+    for this class (threshold 1). Needs ≥2 visible devices — on a
+    single-device host this is a no-op skip, not an error (use
+    --devices N to fan out virtual CPU devices for the bake)."""
+    import jax
+
+    from openr_tpu.decision.tpu_solver import TpuSpfSolver
+
+    if len(jax.devices()) < 2:
+        if verbose:
+            print(
+                "[prewarm] multichip: <2 devices visible — skipped "
+                "(--devices N forces virtual CPU devices)"
+            )
+        return 0.0
+    side, adj_dbs, states, ps, me = _grid_inputs(nodes)
+    solver = TpuSpfSolver(me, multichip_n_cap_threshold=1)
+    t0 = time.perf_counter()
+    solver.build_route_db(me, states, ps)
+    dt = time.perf_counter() - t0
+    _record_prewarm("multichip", side * side, dt)
+    if verbose:
+        print(
+            f"[prewarm] class {side}x{side} ({side * side} nodes)"
+            f" +multichip: {dt:.1f}s"
+        )
+    return dt
+
+
+def prewarm_whatif(nodes: int, verbose: bool = True) -> float:
+    """Bake the "whatif" namespace: one order-1 sweep over the class
+    compiles the batched scenario executables an operator's first
+    interactive sweep would otherwise stall on."""
+    from openr_tpu.decision.tpu_solver import TpuSpfSolver
+    from openr_tpu.decision.whatif import WhatIfEngine
+
+    side, adj_dbs, states, ps, me = _grid_inputs(nodes)
+    solver = TpuSpfSolver(me)
+    t0 = time.perf_counter()
+    solver.build_route_db(me, states, ps)
+    WhatIfEngine(solver).sweep(states, ps, order=1, max_scenarios=8)
+    dt = time.perf_counter() - t0
+    _record_prewarm("whatif", side * side, dt)
+    if verbose:
+        print(
+            f"[prewarm] class {side}x{side} ({side * side} nodes)"
+            f" +whatif: {dt:.1f}s"
+        )
+    return dt
 
 
 def prewarm_class(
@@ -76,6 +206,12 @@ def prewarm_class(
     t0 = time.perf_counter()
     solver.build_route_db(me, states, ps)
     dt = time.perf_counter() - t0
+    variant = "default"
+    if enable_lfa:
+        variant = "default+lfa"
+    elif enable_ksp2:
+        variant = "default+ksp2"
+    _record_prewarm(variant, side * side, dt)
     if verbose:
         print(
             f"[prewarm] class {side}x{side} ({side * side} nodes)"
@@ -106,7 +242,40 @@ def main(argv=None) -> int:
         "--ksp2", action="store_true",
         help="also compile the KSP2 masked-batch programs",
     )
+    p.add_argument(
+        "--incr", action="store_true",
+        help="also bake the incremental-SSSP (incr) namespace",
+    )
+    p.add_argument(
+        "--multichip", action="store_true",
+        help="also bake the sharded capacity-tier (multichip) namespace"
+        " (needs >=2 devices)",
+    )
+    p.add_argument(
+        "--whatif", action="store_true",
+        help="also bake the what-if sweep (whatif) namespace",
+    )
+    p.add_argument(
+        "--devices", type=int, default=0,
+        help="force N virtual CPU devices (XLA_FLAGS host platform "
+        "device count) — for baking the multichip namespace off-TPU; "
+        "must be set before jax first imports",
+    )
     args = p.parse_args(argv)
+
+    if args.devices > 0:
+        import os as _os
+
+        if "jax" in sys.modules:
+            print(
+                "[prewarm] --devices ignored: jax already imported",
+                file=sys.stderr,
+            )
+        else:
+            _os.environ["XLA_FLAGS"] = (
+                _os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={args.devices}"
+            ).strip()
 
     from openr_tpu.ops.xla_cache import enable_compilation_cache
 
@@ -123,6 +292,12 @@ def main(argv=None) -> int:
             total += prewarm_class(n, enable_lfa=True, enable_ksp2=False)
         if args.ksp2:
             total += prewarm_class(n, enable_lfa=False, enable_ksp2=True)
+        if args.incr:
+            total += prewarm_incr(n)
+        if args.multichip:
+            total += prewarm_multichip(n)
+        if args.whatif:
+            total += prewarm_whatif(n)
     print(f"[prewarm] done in {total:.1f}s — restarts now load from cache")
     return 0
 

@@ -172,6 +172,15 @@ class Value:
     ttl_ms: int = TTL_INFINITY
     ttl_version: int = 0
     hash: Optional[int] = None
+    # Fleet-convergence origin stamp: set once at the originating node's
+    # local write and carried unchanged through flood merge so every
+    # receiver can attribute its convergence work (and its FIB ack) to the
+    # remote origin event. Deliberately EXCLUDED from `hash` — the stamp
+    # is telemetry, never merge identity, so it can't flip a merge verdict
+    # or perturb full-sync delta detection.
+    origin_node: Optional[str] = None
+    origin_event_id: Optional[str] = None
+    origin_ts_ms: Optional[float] = None  # wall epoch ms at origination
 
     def __post_init__(self):
         if self.hash is None and self.value is not None:

@@ -64,6 +64,11 @@ def test_bench_config_smoke_device_path():
     assert "incr_tpu_ms" in res, res
     ixc = res["incr_xla_cache"]
     assert ixc["incr_executable_evictions"] == 0, ixc
+    # ISSUE 11: the untriggered flight recorder must cost ≤1% of a
+    # churn iteration even at one tick per solve (production ticks at
+    # 1 Hz, far below that)
+    assert res["flightrec_tick_ms"] >= 0, res
+    assert res["flightrec_overhead_pct"] <= 1.0, res
 
 
 def test_bench_incremental_lane_single_flap_counters():

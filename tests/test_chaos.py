@@ -171,6 +171,173 @@ class TestKillTheTpuDrill:
             await stop_all(nodes)
 
 
+class TestSloBurnFlightRecorderDrill:
+    @run_async
+    async def test_failover_trips_slo_burn_and_flight_recorder(self):
+        """ISSUE 11 drill: an armed solver.exec fault mid-convergence
+        must (1) auto-trigger a flight-recorder bundle attributed to the
+        failover (DECISION_SOLVER_DEGRADED), (2) burn the
+        solver_degraded_s SLO into an alert through the Monitor's
+        metrics loop, and (3) freeze a post-mortem bundle whose trace
+        ring holds the degraded-mode convergence roots."""
+        import json
+        import os
+        import tempfile
+
+        registry.clear()
+        counters.set_counter("decision.solver.degraded", 0)
+        rec_dir = tempfile.mkdtemp(prefix="openr-tpu-flightrec-drill-")
+        names = ["node-0", "node-1", "node-2"]
+        links = [
+            ("node-0", "if-01", "node-1", "if-10"),
+            ("node-1", "if-12", "node-2", "if-21"),
+            ("node-2", "if-20", "node-0", "if-02"),
+        ]
+        mesh, nodes = await start_mesh(
+            names,
+            links,
+            solver_backend="tpu",
+            decision_config=DecisionConfig(
+                debounce_min_ms=5,
+                debounce_max_ms=25,
+                solver_probe_initial_backoff_s=5.0,
+                solver_probe_max_backoff_s=5.0,
+            ),
+        )
+        mon = Monitor(
+            "node-0",
+            MonitorConfig(
+                # drill-scale SLO: degraded for >1s starts breaching,
+                # a half-burned 2s window alerts — so the whole state
+                # machine runs in seconds instead of operator-minutes
+                slos={
+                    "solver_degraded_s": {
+                        "kind": "gauge_duration",
+                        "source": "decision.solver.degraded",
+                        "threshold": 1.0,
+                        "fast_window_s": 2.0,
+                        "slow_window_s": 4.0,
+                    }
+                },
+                slo_fast_window_s=2.0,
+                slo_slow_window_s=4.0,
+                flight_recorder_dir=rec_dir,
+                flight_recorder_ring=64,
+                flight_recorder_min_interval_s=0.0,
+            ),
+            nodes["node-0"].log_sample_queue.get_reader("slo-drill"),
+            interval_s=0.1,
+        )
+        alerts_key = "monitor.slo.solver_degraded_s.alerts"
+        alerts0 = _counter(alerts_key)
+        await mon.start()
+        try:
+            for i, n in enumerate(names):
+                nodes[n].advertise_prefix(loopback(i))
+
+            def converged():
+                for i, n in enumerate(names):
+                    expect = {loopback(j) for j in range(3) if j != i}
+                    if set(nodes[n].fib_routes) != expect:
+                        return False
+                return True
+
+            await wait_until(converged, timeout_s=CONVERGENCE_S)
+
+            # the device dies, then the topology changes
+            registry.arm("solver.exec")
+            mesh.disconnect("node-0", "if-02", "node-2", "if-20")
+            await wait_until(
+                lambda: _counter("decision.solver.degraded") == 1
+                and loopback(2) in nodes["node-0"].fib_routes,
+                timeout_s=CONVERGENCE_S,
+            )
+
+            # (1) the failover LogSample auto-triggered a bundle that
+            # NAMES the failover in its trigger attribution
+            await wait_until(
+                lambda: any(
+                    b["reason"] == "solver_failover"
+                    for b in mon.flight_recorder.bundles
+                ),
+                timeout_s=CONVERGENCE_S,
+            )
+            fo = next(
+                b
+                for b in mon.flight_recorder.bundles
+                if b["reason"] == "solver_failover"
+            )
+            with open(os.path.join(fo["path"], "bundle.json")) as f:
+                fo_doc = json.load(f)
+            assert fo_doc["schema"] == "openr-tpu-flight-recorder/1"
+            assert fo_doc["trigger"]["reason"] == "solver_failover"
+            assert (
+                fo_doc["trigger"]["detail"]["event"]
+                == "DECISION_SOLVER_DEGRADED"
+            ), fo_doc["trigger"]
+            assert os.path.exists(os.path.join(fo["path"], "trace.json"))
+
+            # the degraded-mode reroute closes its stamped trace before
+            # the SLO window can fill
+            await wait_until(_degraded_trace_closed, timeout_s=CONVERGENCE_S)
+
+            # (2) the sustained degraded gauge burns the SLO: the engine
+            # raises the alert, logs it, and counts it
+            await wait_until(
+                lambda: _counter(alerts_key) > alerts0,
+                timeout_s=CONVERGENCE_S,
+            )
+            rep = mon.slo_report()
+            assert rep["enabled"] is True
+            state = rep["slos"]["solver_degraded_s"]["state"]
+            assert state in ("fast_burn", "sustained_burn"), rep
+            assert any(
+                s.event == "SLO_BURN_ALERT"
+                and s.values.get("slo") == "solver_degraded_s"
+                for s in mon.event_logs
+            ), [s.event for s in mon.event_logs]
+            assert _counter("monitor.slo.solver_degraded_s.burning") >= 1
+
+            # (3) the burn auto-froze a bundle whose trace ring holds
+            # the degraded convergence roots and whose SLO annex shows
+            # the burning objective
+            await wait_until(
+                lambda: any(
+                    b["reason"].startswith("slo_burn:")
+                    for b in mon.flight_recorder.bundles
+                ),
+                timeout_s=CONVERGENCE_S,
+            )
+            sb = next(
+                b
+                for b in mon.flight_recorder.bundles
+                if b["reason"].startswith("slo_burn:")
+            )
+            with open(os.path.join(sb["path"], "bundle.json")) as f:
+                sb_doc = json.load(f)
+            assert sb_doc["trigger"]["reason"] == (
+                "slo_burn:solver_degraded_s"
+            )
+            assert any(
+                t["spans"][0]["attributes"].get("degraded") is True
+                for t in sb_doc["traces"]
+            ), [t["spans"][0]["attributes"] for t in sb_doc["traces"]]
+            assert (
+                sb_doc["slo"]["slos"]["solver_degraded_s"]["state"]
+                != "ok"
+            ), sb_doc["slo"]
+            # the bundle carries the lead-up: counter history ticks and
+            # the noted anomaly events
+            assert len(sb_doc["counter_history"]) >= 1
+            assert _counter("monitor.flight_recorder.triggers") >= 2
+        finally:
+            registry.clear()
+            counters.set_counter("decision.solver.degraded", 0)
+            with contextlib.suppress(Exception):
+                await mon.stop()
+            await stop_all(nodes)
+
+
 class TestIncrementalSolverFailoverDrill:
     @run_async
     async def test_fault_during_incremental_solve_fails_over(self):

@@ -378,13 +378,34 @@ class TestBreezeCli:
 
         async def node_main():
             nonlocal stop
+            import tempfile
+
+            from openr_tpu.config import MonitorConfig
+            from openr_tpu.runtime.monitor import Monitor
+
             stop = asyncio.Event()
             mesh, a, b = await start_two_node()
+            # monitor on node-a: breeze monitor slo / monitor dump go
+            # through ctrl.monitor.* into this actor
+            mon = Monitor(
+                "node-a",
+                MonitorConfig(
+                    enable_fleet_health=False,
+                    flight_recorder_dir=tempfile.mkdtemp(
+                        prefix="orctl-flightrec-"
+                    ),
+                    flight_recorder_min_interval_s=0.0,
+                ),
+                a.log_sample_queue.get_reader("breeze-cli"),
+            )
+            a.set_monitor(mon)
+            await mon.start()
             ctrl_port["port"] = a.ctrl.port
             ctrl_port["port_b"] = b.ctrl.port
             loop_holder["loop"] = asyncio.get_running_loop()
             started.set()
             await stop.wait()
+            await mon.stop()
             await a.stop()
             await b.stop()
 
@@ -531,6 +552,31 @@ class TestBreezeCli:
             res = runner.invoke(cli, base + ["spark", "neighbors"], obj={})
             assert res.exit_code == 0, res.output
             assert "ESTABLISHED" in res.output
+
+            # ISSUE 11 surfaces: fleet convergence view, SLO report,
+            # operator flight-recorder dump
+            res = runner.invoke(
+                cli,
+                base + ["decision", "convergence", "--fleet"],
+                obj={},
+            )
+            assert res.exit_code == 0, res.output
+            assert "nodes_reporting" in res.output
+            assert "fleet_ms" in res.output
+
+            res = runner.invoke(cli, base + ["monitor", "slo"], obj={})
+            assert res.exit_code == 0, res.output
+            assert '"enabled": true' in res.output
+            assert "solver_degraded_s" in res.output
+
+            res = runner.invoke(
+                cli,
+                base + ["monitor", "dump", "--reason", "cli-drill"],
+                obj={},
+            )
+            assert res.exit_code == 0, res.output
+            assert '"ok": true' in res.output
+            assert "cli-drill" in res.output
 
             res = runner.invoke(cli, base + ["lm", "links"], obj={})
             assert res.exit_code == 0, res.output

@@ -130,6 +130,86 @@ class TestMergeKeyValues:
         updates = merge_key_values(kv, {"k": v(version=0)})
         assert not updates
 
+    # -- origin stamps (ISSUE 11: cross-node trace stitching) --------------
+    # The origin stamp (origin_node/origin_event_id/origin_ts_ms) rides
+    # the winning value verbatim and is EXCLUDED from the merge hash, so
+    # stamps can never flip a merge verdict.
+
+    def sv(self, stamp="node1:17", node="node1", ts=1111.0, **kw):
+        val = v(**kw)
+        val.origin_node = node if stamp else None
+        val.origin_event_id = stamp or None
+        val.origin_ts_ms = ts if stamp else None
+        return val
+
+    def test_stamp_rides_winning_higher_version(self):
+        kv = {"k": v(version=1, value=b"old")}
+        updates = merge_key_values(
+            kv, {"k": self.sv(version=2, value=b"new")}
+        )
+        assert set(updates) == {"k"}
+        assert kv["k"].origin_event_id == "node1:17"
+        assert kv["k"].origin_node == "node1"
+        assert kv["k"].origin_ts_ms == 1111.0
+
+    def test_losing_stamp_does_not_survive(self):
+        # the local stamped value loses to a higher-version unstamped
+        # one: the WINNER's (absent) stamp is what remains
+        kv = {"k": self.sv(version=1, value=b"old")}
+        updates = merge_key_values(kv, {"k": v(version=2, value=b"new")})
+        assert set(updates) == {"k"}
+        assert kv["k"].origin_event_id is None
+
+    def test_ttl_only_refresh_preserves_stamp(self):
+        kv = {"k": self.sv(ttl=1000)}
+        refresh = v(value=None, ttl=2000, ttl_version=3)
+        updates = merge_key_values(kv, {"k": refresh})
+        assert set(updates) == {"k"}
+        assert kv["k"].ttl_version == 3
+        assert kv["k"].origin_event_id == "node1:17"
+        assert kv["k"].origin_ts_ms == 1111.0
+
+    def test_stamp_never_flips_originator_tiebreak(self):
+        # same version: originator tiebreak decides, regardless of which
+        # side carries a stamp or what it says
+        kv = {"k": self.sv(originator="bbb", value=b"b")}
+        st = MergeStats()
+        updates = merge_key_values(
+            kv,
+            {"k": self.sv(stamp="node9:99", node="node9",
+                          originator="aaa", value=b"a")},
+            stats=st,
+        )
+        assert not updates
+        assert st.no_need_to_update == 1
+        assert kv["k"].origin_event_id == "node1:17"
+
+    def test_stamp_difference_alone_is_no_update(self):
+        # identical (version, originator, value): a differing stamp must
+        # not look like new data — stamps are hash-excluded
+        kv = {"k": self.sv()}
+        st = MergeStats()
+        updates = merge_key_values(
+            kv, {"k": self.sv(stamp="node2:5", node="node2", ts=9.0)},
+            stats=st,
+        )
+        assert not updates
+        assert st.no_need_to_update == 1
+        assert kv["k"].origin_event_id == "node1:17"
+
+    def test_stamp_excluded_from_hash(self):
+        a, b = self.sv(), self.sv(stamp="other:1", node="other", ts=5.0)
+        assert a.hash == b.hash
+
+    def test_stamp_survives_serde_roundtrip(self):
+        from openr_tpu.serde import from_plain, to_plain
+
+        val = self.sv()
+        back = from_plain(to_plain(val), Value)
+        assert back.origin_node == "node1"
+        assert back.origin_event_id == "node1:17"
+        assert back.origin_ts_ms == 1111.0
+
     def test_filters_respected(self):
         kv = {}
         filters = KvStoreFilters(key_prefixes=("adj:",))

@@ -145,6 +145,80 @@ class TestScrapeServer:
             await exporter.stop()
 
 
+class TestBuildInfo:
+    def test_build_info_gauge_in_registry_render(self):
+        import openr_tpu
+        from openr_tpu.runtime.metrics_export import build_info_labels
+
+        labels = build_info_labels()
+        assert labels["version"] == openr_tpu.__version__
+        assert labels["python"]
+        assert labels["backend"]
+        text = render_registry()
+        parsed = parse_exposition(text)
+        hits = [
+            (name, lbls)
+            for (name, lbls) in parsed
+            if name == "openr_tpu_build_info"
+        ]
+        assert len(hits) == 1, hits
+        (_, lbls) = hits[0]
+        lbl_map = dict(lbls)
+        assert lbl_map["version"] == openr_tpu.__version__
+        assert "backend" in lbl_map
+        assert parsed[hits[0]] == 1.0
+
+    def test_label_values_escaped(self):
+        from openr_tpu.runtime.metrics_export import _label_escape
+
+        assert _label_escape('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+
+
+class TestConcurrentScrapes:
+    @run_async
+    async def test_two_concurrent_scrapes_both_parse(self):
+        """ISSUE 11 regression: two scrapes racing one exporter must
+        BOTH get complete, parseable expositions (the render walks the
+        live registry while other fibers mutate it), and each scrape
+        records its latency in monitor.metrics_scrape_ms."""
+        counters.increment("metrics_export_test.concurrent_probe")
+        exporter = MetricsExporter(port=0)
+        await exporter.start()
+        try:
+            async def noisy_writer():
+                # registry churn while the scrapes render
+                for i in range(200):
+                    counters.increment("metrics_export_test.noise")
+                    counters.add_stat_value(
+                        "metrics_export_test.noise_ms", float(i)
+                    )
+                    if i % 50 == 0:
+                        await asyncio.sleep(0)
+
+            results = await asyncio.gather(
+                http_get(exporter.port, "/metrics"),
+                http_get(exporter.port, "/metrics"),
+                noisy_writer(),
+            )
+            key = normalize_metric_name(
+                "metrics_export_test.concurrent_probe"
+            )
+            for status, headers, body in results[:2]:
+                assert status == 200
+                assert int(headers["content-length"]) == len(body)
+                parsed = parse_exposition(body.decode())
+                assert parsed[(key, ())] >= 1.0
+                assert ("openr_tpu_build_info" in
+                        {name for (name, _) in parsed})
+            # scrape latency is a first-class stat
+            stats = counters.get_statistics(
+                "monitor.metrics_scrape_ms", windows=(600.0,)
+            ).get("monitor.metrics_scrape_ms", {}).get("600", {})
+            assert stats.get("count", 0) >= 2, stats
+        finally:
+            await exporter.stop()
+
+
 class TestMonitorWiring:
     @run_async
     async def test_monitor_serves_metrics_when_configured(self):

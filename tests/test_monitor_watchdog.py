@@ -169,3 +169,214 @@ def test_stat_multi_windowed_single_pass():
     out = s.multi_windowed((0.0, 3600.0))
     assert out["3600"]["truncated"] is True
     assert out["3600"]["count"] == 4096  # ring capacity, not a lie
+
+
+class TestSloEngine:
+    """Burn-rate state machines over the counter fabric (ISSUE 11)."""
+
+    @staticmethod
+    def _engine(slos, fast=0.2, slow=0.4, burn=0.5):
+        from openr_tpu.runtime.monitor import SloEngine
+
+        cfg = MonitorConfig(
+            slos=slos,
+            slo_fast_window_s=fast,
+            slo_slow_window_s=slow,
+            slo_burn_threshold=burn,
+        )
+        return SloEngine("node-slo", cfg)
+
+    def test_counter_delta_baseline_is_not_retroactive(self):
+        src = "slotest.delta.preexisting"
+        counters.set_counter(src, 100.0)
+        eng = self._engine(
+            {"d": {"kind": "counter_delta", "source": src, "threshold": 1.0}}
+        )
+        # first tick only establishes the baseline: the 100 that
+        # predate the engine must not count as a breach
+        assert eng.evaluate() == []
+        rep = eng.report()["slos"]["d"]
+        assert rep["state"] == "ok" and rep["value"] == 0.0
+        # a real jump past the threshold burns the (1-sample) window
+        counters.set_counter(src, 105.0)
+        alerts = eng.evaluate()
+        assert [a["slo"] for a in alerts] == ["d"]
+        assert alerts[0]["value"] == 5.0
+        assert eng.report()["slos"]["d"]["state"] == "fast_burn"
+        # sub-threshold drift keeps breach fraction falling, not rising
+        counters.set_counter(src, 105.5)
+        eng.evaluate()
+        assert eng.report()["slos"]["d"]["value"] == 0.5
+
+    def test_stat_quantile_breach_and_empty_window(self):
+        src = "slotest.stat.latency_ms"
+        eng = self._engine(
+            {"s": {"kind": "stat", "source": src, "threshold": 10.0,
+                   "quantile": "p50"}},
+            fast=60.0, slow=60.0,
+        )
+        # no samples at all: no breach, value 0
+        assert eng.evaluate() == []
+        assert eng.report()["slos"]["s"]["state"] == "ok"
+        for v in (50.0, 60.0, 70.0):
+            counters.add_stat_value(src, v)
+        alerts = eng.evaluate()
+        assert [a["slo"] for a in alerts] == ["s"]
+        rep = eng.report()["slos"]["s"]
+        assert rep["state"] == "fast_burn" and rep["value"] > 10.0
+
+    def test_gauge_duration_escalates_then_deasserts(self):
+        src = "slotest.gauge.degraded"
+        counters.set_counter(src, 0.0)
+        eng = self._engine(
+            {"g": {"kind": "gauge_duration", "source": src,
+                   "threshold": 0.0}}
+        )
+        assert eng.evaluate() == []  # clean tick
+        counters.set_counter(src, 1.0)
+        alerts = eng.evaluate()  # breach tick: 1/1 fast samples burn
+        assert [a["slo"] for a in alerts] == ["g"]
+        assert counters.get_counter("monitor.slo.g.alerts") >= 1
+        assert counters.get_counter("monitor.slo.g.burning") == 1.0
+        time.sleep(0.05)
+        assert eng.evaluate() == []  # escalation is NOT a new page
+        rep = eng.report()["slos"]["g"]
+        assert rep["state"] == "sustained_burn", rep
+        assert counters.get_counter("monitor.slo.g.burning") == 2.0
+        # recovery: gauge clears, the fast window drains past the 2x
+        # hysteresis, the state machine de-asserts without a page
+        counters.set_counter(src, 0.0)
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            assert eng.evaluate() == []
+            if eng.report()["slos"]["g"]["state"] == "ok":
+                break
+            time.sleep(0.05)
+        rep = eng.report()["slos"]["g"]
+        assert rep["state"] == "ok", rep
+        assert counters.get_counter("monitor.slo.g.burning") == 0.0
+        assert rep["alerts"] == 1  # the whole episode paged exactly once
+
+
+class TestFlightRecorder:
+    @staticmethod
+    def _recorder(tmp, **kw):
+        from openr_tpu.runtime.monitor import FlightRecorder
+
+        defaults = dict(
+            flight_recorder_dir=tmp,
+            flight_recorder_ring=4,
+            flight_recorder_min_interval_s=60.0,
+        )
+        defaults.update(kw)
+        return FlightRecorder("node-fr", MonitorConfig(**defaults))
+
+    def test_trigger_writes_bundle_rate_limits_and_forces(self, tmp_path):
+        import json as _json
+        import os
+
+        fr = self._recorder(str(tmp_path))
+        for _ in range(10):
+            fr.record_tick()
+        fr.note_event("SOMETHING_ODD", {"n": 1})
+        sup0 = counters.get_counter(
+            "monitor.flight_recorder.suppressed") or 0
+        r1 = fr.trigger("unit_test", detail={"why": "drill"})
+        assert r1 is not None and r1["reason"] == "unit_test"
+        doc = _json.load(open(os.path.join(r1["path"], "bundle.json")))
+        assert doc["schema"] == "openr-tpu-flight-recorder/1"
+        assert doc["node"] == "node-fr"
+        assert doc["trigger"]["detail"] == {"why": "drill"}
+        # ring bound holds even after 10 ticks
+        assert len(doc["counter_history"]) == 4
+        assert any(e["event"] == "SOMETHING_ODD" for e in doc["events"])
+        assert os.path.exists(os.path.join(r1["path"], "trace.json"))
+        # second auto trigger inside the interval is suppressed...
+        assert fr.trigger("unit_test_again") is None
+        assert (counters.get_counter("monitor.flight_recorder.suppressed")
+                > sup0)
+        # ...but a manual dump bypasses the limit
+        r3 = fr.trigger("manual", force=True)
+        assert r3 is not None
+        assert [b["reason"] for b in fr.bundles] == ["unit_test", "manual"]
+
+    def test_write_failure_is_counted_not_raised(self, tmp_path):
+        blocker = tmp_path / "not-a-dir"
+        blocker.write_text("file in the way")
+        fr = self._recorder(str(blocker / "sub"))
+        errs0 = counters.get_counter(
+            "monitor.flight_recorder.write_errors") or 0
+        assert fr.trigger("doomed", force=True) is None
+        assert (counters.get_counter("monitor.flight_recorder.write_errors")
+                == errs0 + 1)
+        assert list(fr.bundles) == []
+
+
+class TestMonitorObservability:
+    @run_async
+    async def test_trigger_events_map_to_bundles_and_manual_dump(
+        self, tmp_path
+    ):
+        q = ReplicateQueue("logSamplesObs")
+        mon = Monitor(
+            "node-obs",
+            MonitorConfig(
+                slos={},  # engine off: slo_report must say so
+                enable_fleet_health=False,
+                flight_recorder_dir=str(tmp_path),
+                flight_recorder_min_interval_s=60.0,
+            ),
+            q.get_reader(),
+            interval_s=0.05,
+        )
+        assert mon.slo_engine is None and mon.flight_recorder is not None
+        await mon.start()
+        try:
+            rep = mon.slo_report()
+            assert rep["enabled"] is False and rep["slos"] == {}
+            # an anomaly LogSample auto-triggers with attribution
+            q.push(LogSample(
+                event="DECISION_SENTINEL_ANOMALY",
+                node_name="node-obs",
+                values={"category": "sentinel", "metric": "spf_ms"},
+            ))
+            await wait_until(
+                lambda: any(
+                    b["reason"] == "sentinel_anomaly"
+                    for b in mon.flight_recorder.bundles
+                )
+            )
+            # a second trigger event inside the rate window is noted
+            # (supervisor category) but writes no second bundle
+            q.push(LogSample(
+                event="SUPERVISOR_RESTART",
+                node_name="node-obs",
+                values={"category": "supervisor", "task": "t"},
+            ))
+            await wait_until(
+                lambda: any(
+                    e["event"] == "SUPERVISOR_RESTART"
+                    for e in mon.flight_recorder._events
+                )
+            )
+            assert len(mon.flight_recorder.bundles) == 1
+            # the operator's manual dump bypasses the rate limit
+            res = await mon.dump_flight_recorder(reason="manual-drill")
+            assert res["ok"] is True and res["reason"] == "manual-drill"
+            assert len(mon.flight_recorder.bundles) == 2
+        finally:
+            await mon.stop()
+
+    @run_async
+    async def test_dump_without_recorder_reports_error(self):
+        q = ReplicateQueue("logSamplesObs2")
+        mon = Monitor(
+            "node-obs2",
+            MonitorConfig(
+                enable_flight_recorder=False, enable_fleet_health=False
+            ),
+            q.get_reader(),
+        )
+        assert mon.flight_recorder is None
+        res = await mon.dump_flight_recorder()
+        assert res["ok"] is False and "error" in res

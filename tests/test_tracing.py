@@ -6,9 +6,11 @@ real multi-node in-process daemon, and the export surfaces (Chrome
 trace-event schema, percentile math vs numpy).
 """
 
+import asyncio
 import gc
 import json
 import random
+import time
 
 from openr_tpu.messaging import ReplicateQueue
 from openr_tpu.runtime.counters import CounterRegistry, _percentile
@@ -132,6 +134,49 @@ class TestQueuePropagation:
         assert key not in tracer._ctx_by_id
         q.close()
 
+    def test_side_table_evicts_orphans_first_at_cap(self):
+        """ISSUE 11 satellite: a wedged consumer strands contexts of
+        already-closed traces; at the cap those orphans go first and the
+        still-active trace's context survives."""
+        from openr_tpu.runtime import tracing
+        from openr_tpu.runtime.counters import counters
+
+        t = Tracer()
+        ev0 = counters.get_counter("tracing.contexts_evicted") or 0
+        dead_ctx = t.start_trace("convergence", node="n0")
+        t.end_trace(dead_ctx, status="ok")
+        live_ctx = t.start_trace("convergence", node="n0")
+        # strong refs: the finalizer path must not be what empties the
+        # table in this test
+        stranded = [_Item() for _ in range(tracing.MAX_TRACE_CONTEXTS)]
+        for it in stranded:
+            assert t.attach(it, dead_ctx)
+        live_item = _Item()
+        assert t.attach(live_item, live_ctx)
+        # over-cap attach swept the orphans, kept the live context
+        assert t.active_context_count() <= tracing.MAX_TRACE_CONTEXTS
+        assert t.context_of(live_item) is live_ctx
+        assert t.context_of(stranded[0]) is None
+        ev1 = counters.get_counter("tracing.contexts_evicted") or 0
+        assert ev1 - ev0 >= tracing.MAX_TRACE_CONTEXTS
+        t.end_trace(live_ctx, status="ok")
+
+    def test_side_table_evicts_oldest_when_all_live(self):
+        from openr_tpu.runtime import tracing
+
+        t = Tracer()
+        live_ctx = t.start_trace("convergence", node="n0")
+        items = [
+            _Item() for _ in range(tracing.MAX_TRACE_CONTEXTS + 5)
+        ]
+        for it in items:
+            assert t.attach(it, live_ctx)
+        assert t.active_context_count() == tracing.MAX_TRACE_CONTEXTS
+        # oldest-first: the first attaches were sacrificed, newest kept
+        assert t.context_of(items[0]) is None
+        assert t.context_of(items[-1]) is live_ctx
+        t.end_trace(live_ctx, status="ok")
+
 
 class TestQuantileMath:
     def test_percentile_matches_numpy(self):
@@ -179,7 +224,12 @@ class TestChromeExport:
         events = doc["traceEvents"]
         metas = [e for e in events if e["ph"] == "M"]
         xs = [e for e in events if e["ph"] == "X"]
-        assert metas and all(e["name"] == "thread_name" for e in metas)
+        # one process lane per node (named after it) + thread names
+        assert metas and all(
+            e["name"] in ("process_name", "thread_name") for e in metas
+        )
+        procs = [e for e in metas if e["name"] == "process_name"]
+        assert [e["args"]["name"] for e in procs] == ["n0"]
         assert len(xs) == 3  # root + 2 children
         for e in xs:
             assert isinstance(e["ts"], float) and e["ts"] > 0
@@ -369,6 +419,190 @@ class TestSystemConvergenceTrace:
                 assert listed and listed[0]["trace_id"] == tr["trace_id"]
             finally:
                 await client.close()
+        finally:
+            for w in nodes.values():
+                await w.stop()
+
+
+class TestFleetConvergenceStitching:
+    """ISSUE 11 acceptance (system): a link-metric change at node A
+    produces a STITCHED fleet trace — every node's convergence trace
+    carries node A's origin stamp, each Fib ack reports
+    fleet_convergence_ms back through the monitor:conv-ack: fabric, the
+    ctrl fleet view aggregates origin→last-FIB-ack across all three
+    nodes with the straggler attributed, and the Chrome export renders
+    one process lane per node."""
+
+    @run_async
+    async def test_fleet_trace_stitching_three_nodes(self):
+        from openr_tpu.kvstore.wrapper import wait_until
+        from openr_tpu.runtime.openr_wrapper import OpenrWrapper
+        from openr_tpu.runtime.rpc import RpcClient
+        from openr_tpu.spark import MockIoMesh
+
+        mesh = MockIoMesh()
+        kv_ports: dict[str, int] = {}
+        names = ["node-0", "node-1", "node-2"]
+        nodes = {
+            n: OpenrWrapper(
+                n, mesh.provider(n), kv_ports,
+                enable_ctrl=(n == "node-0"),
+            )
+            for n in names
+        }
+        links = [
+            ("node-0", "if-01", "node-1", "if-10"),
+            ("node-1", "if-12", "node-2", "if-21"),
+            ("node-2", "if-20", "node-0", "if-02"),
+        ]
+        for x, ifx, y, ify in links:
+            mesh.connect(x, ifx, y, ify)
+        ifaces = {n: [] for n in names}
+        for x, ifx, y, ify in links:
+            ifaces[x].append(ifx)
+            ifaces[y].append(ify)
+        for n, w in nodes.items():
+            await w.start(*ifaces[n])
+        try:
+            for i, n in enumerate(names):
+                nodes[n].advertise_prefix(f"10.0.0.{i + 1}/32")
+            await wait_until(
+                lambda: all(
+                    f"10.0.0.{j + 1}/32" in nodes[n].fib_routes
+                    for n in names
+                    for j in range(3)
+                    if names[j] != n
+                ),
+                timeout_s=20,
+            )
+            # quiesce, then ONE topology event at node-0
+            tracer.clear()
+            t_before_ms = time.time() * 1000.0
+            await nodes["node-0"].link_monitor.set_link_metric("if-01", 100)
+
+            def rerouted():
+                e = nodes["node-0"].fib_routes.get("10.0.0.2/32")
+                return e is not None and {
+                    nh.neighbor_node_name for nh in e.nexthops
+                } == {"node-2"}
+
+            await wait_until(rerouted, timeout_s=20)
+
+            # every node's convergence trace carries node-0's origin
+            # stamp on its root span — the stitched fleet trace. The
+            # origin node reroutes ("ok"); the receivers correctly
+            # conclude "no_change" (their directed out-edges are
+            # untouched) but are STILL stitched to the same event.
+            def stamped_nodes():
+                out = {}
+                for tr in tracer.get_traces(limit=200):
+                    if tr["status"] not in ("ok", "no_change"):
+                        continue
+                    attrs = tr["spans"][0]["attributes"]
+                    if attrs.get("origin_node") == "node-0":
+                        out.setdefault(attrs.get("node"), attrs)
+                return out
+
+            await wait_until(
+                lambda: set(stamped_nodes()) == set(names),
+                timeout_s=20,
+            )
+            stamped = stamped_nodes()
+            event_ids = {a["origin_event_id"] for a in stamped.values()}
+            assert len(event_ids) == 1, stamped  # ONE origin event
+            (event_id,) = event_ids
+            assert event_id.startswith("node-0:"), event_id
+            for attrs in stamped.values():
+                assert attrs["origin_ts_ms"] >= t_before_ms - 60_000
+
+            # second origin event: a NEW prefix from node-0 forces BOTH
+            # receivers to program a route, so its fleet row carries two
+            # acks and a meaningful straggler
+            t_prefix_ms = time.time() * 1000.0
+            nodes["node-0"].advertise_prefix("10.0.99.1/32")
+            await wait_until(
+                lambda: all(
+                    "10.0.99.1/32" in nodes[n].fib_routes
+                    for n in ("node-1", "node-2")
+                ),
+                timeout_s=20,
+            )
+
+            # fleet view from node-0's ctrl port: the event aggregated
+            # across all three conv-ack rings, straggler attributed
+            client = RpcClient("127.0.0.1", nodes["node-0"].ctrl.port)
+            try:
+                def prefix_row(conv):
+                    # pick the first post-advertise node-0 event both
+                    # receivers acked (rows carry the origin ts)
+                    return next(
+                        (
+                            r
+                            for r in conv["fleet"]["events"]
+                            if r["origin"] == "node-0"
+                            and r["ts_ms"] >= t_prefix_ms - 1.0
+                            and {"node-1", "node-2"} <= set(r["acks"])
+                        ),
+                        None,
+                    )
+
+                conv = None
+                row = None
+                for _ in range(80):
+                    conv = await client.request(
+                        "ctrl.decision.convergence", {"fleet": True}
+                    )
+                    row = prefix_row(conv)
+                    if row is not None:
+                        break
+                    await asyncio.sleep(0.25)
+                fleet = conv["fleet"]
+                assert row is not None, fleet["events"]
+                assert row["nodes_acked"] >= 2, row
+                # origin→last-FIB-ack: the fleet number IS the slowest
+                # node's ack, and the straggler is that node
+                assert row["fleet_ms"] == max(row["acks"].values()), row
+                assert row["straggler"] == max(
+                    row["acks"], key=row["acks"].get
+                ), row
+                assert row["fleet_ms"] >= 0
+                # the metric-change event is in the fleet view too, with
+                # the origin node's own reprogram ack
+                mrow = next(
+                    (
+                        r
+                        for r in fleet["events"]
+                        if r["event"] == event_id
+                    ),
+                    None,
+                )
+                assert mrow is not None, fleet["events"]
+                assert "node-0" in mrow["acks"], mrow
+                # all three nodes contribute conv-ack rings
+                assert set(fleet["nodes_reporting"]) == set(names), fleet
+                assert fleet["fleet_ms"]["count"] >= 1
+                assert (
+                    fleet["fleet_ms"]["max"] >= fleet["fleet_ms"]["p50"]
+                )
+            finally:
+                await client.close()
+
+            # Chrome export: one process lane per NODE, named after it
+            doc = json.loads(tracer.export_chrome_json(limit=200))
+            lanes = {
+                e["args"]["name"]: e["pid"]
+                for e in doc["traceEvents"]
+                if e["ph"] == "M" and e["name"] == "process_name"
+            }
+            assert set(names) <= set(lanes), lanes
+            assert len({lanes[n] for n in names}) == 3, lanes
+            # every X event rides one of the node lanes
+            pids = set(lanes.values())
+            assert all(
+                e["pid"] in pids
+                for e in doc["traceEvents"]
+                if e["ph"] == "X"
+            )
         finally:
             for w in nodes.values():
                 await w.stop()
