@@ -145,6 +145,36 @@ def bench_config(name, gen, me, runs=5, flap_victims=0, cpu_baseline=True,
         k: round(v, 1) for k, v in tm.items()
         if isinstance(v, (int, float))
     }
+    # zero-copy program lane: device columns -> packed RouteColumnBatch
+    # -> columnar dataplane sync, measured BEFORE anything forces lazy
+    # entries. The decision.rib.entries_built counter standing still
+    # across this lane is the proof that no per-route objects were
+    # constructed on the program path (the columnar-spine headline)
+    from openr_tpu.decision.column_delta import build_column_batch
+    from openr_tpu.decision.columnar_rib import LazyUnicastRoutes
+    from openr_tpu.runtime.counters import counters as _counters
+
+    if isinstance(cold_db.unicast_routes, LazyUnicastRoutes):
+        import asyncio as _asyncio
+
+        from openr_tpu.platform.fib_handler import MemoryDataplane
+
+        eb0 = int(_counters.get_counter("decision.rib.entries_built") or 0)
+        t0 = time.perf_counter()
+        batch = build_column_batch(cold_db.unicast_routes)
+        if batch is not None:
+            dp = MemoryDataplane()
+            _asyncio.run(dp.sync_unicast_columns(batch))
+            res["cold_program_ms"] = round(
+                (time.perf_counter() - t0) * 1e3, 1
+            )
+            res["cold_program_routes"] = len(dp.unicast)
+            # 0 == the whole program path stayed in packed-array land
+            res["cold_program_entries_built"] = (
+                int(_counters.get_counter("decision.rib.entries_built") or 0)
+                - eb0
+            )
+            del dp, batch
     # consumption boundary: force every lazy entry in one bulk pass —
     # what Fib's first full sync pays on top of full_ms. The columnar
     # rebuild moved eager per-entry construction out of full_ms into
@@ -162,7 +192,11 @@ def bench_config(name, gen, me, runs=5, flap_victims=0, cpu_baseline=True,
     if wall and stages:
         res["overlap_efficiency"] = round(stages / wall, 2)
     log(f"[{name}] tpu cold full rebuild (warm jit): {res['full_ms']:.0f} ms "
-        f"{res['full_breakdown']} consume({n_cold} routes): "
+        f"{res['full_breakdown']} "
+        f"program({res.get('cold_program_routes')} routes): "
+        f"{res.get('cold_program_ms')} ms "
+        f"entries_built {res.get('cold_program_entries_built')} "
+        f"consume({n_cold} routes): "
         f"{res['cold_consume_ms']:.0f} ms "
         f"overlap: {res.get('overlap_efficiency')}")
     del tpu2, cold_db
@@ -570,6 +604,27 @@ def main() -> None:
         ),
         "multichip_engaged_1m": configs.get("lsdb1m", {}).get(
             "multichip_engaged"
+        ),
+        # columnar-spine headline: cold host materialization + the
+        # zero-copy program/consume lanes at 100k and 1M (program must
+        # report entries_built == 0 — no per-route objects on the path)
+        "cold_mat_ms_100k": configs.get("lsdb100k", {}).get(
+            "full_breakdown", {}
+        ).get("mat_ms"),
+        "cold_program_ms_100k": configs.get("lsdb100k", {}).get(
+            "cold_program_ms"
+        ),
+        "cold_mat_ms_1m": configs.get("lsdb1m", {}).get(
+            "full_breakdown", {}
+        ).get("mat_ms"),
+        "cold_program_ms_1m": configs.get("lsdb1m", {}).get(
+            "cold_program_ms"
+        ),
+        "cold_consume_ms_1m": configs.get("lsdb1m", {}).get(
+            "cold_consume_ms"
+        ),
+        "cold_program_entries_built_1m": configs.get("lsdb1m", {}).get(
+            "cold_program_entries_built"
         ),
         # The e2e value above includes one mandatory device->host result
         # round trip; on this tunneled rig that RTT (rig_rtt_ms, measured

@@ -224,6 +224,16 @@ class FibConfig:
 
 
 @dataclass
+class PlatformConfig:
+    """Knobs for the platform agent's kernel-facing dataplane."""
+
+    # batches at least this large go through the C++ bulk programmer
+    # (native/netlink_bulk.cpp); smaller ones stay on the asyncio
+    # netlink client, which interleaves with other platform work
+    bulk_threshold: int = 64
+
+
+@dataclass
 class WatchdogConfig:
     """ref OpenrConfig.thrift WatchdogConfig:260."""
 
@@ -495,6 +505,7 @@ class OpenrConfig:
     decision_config: DecisionConfig = field(default_factory=DecisionConfig)
     link_monitor_config: LinkMonitorConfig = field(default_factory=LinkMonitorConfig)
     fib_config: FibConfig = field(default_factory=FibConfig)
+    platform_config: PlatformConfig = field(default_factory=PlatformConfig)
     watchdog_config: WatchdogConfig = field(default_factory=WatchdogConfig)
     monitor_config: MonitorConfig = field(default_factory=MonitorConfig)
     runtime_config: RuntimeConfig = field(default_factory=RuntimeConfig)
@@ -654,6 +665,9 @@ class Config:
             )
         if dc.multichip_batch < 0:
             raise ConfigError("decision multichip_batch must be >= 0")
+        pc = cfg.platform_config
+        if pc.bulk_threshold < 1:
+            raise ConfigError("platform bulk_threshold must be >= 1")
         wc = cfg.watchdog_config
         if wc.supervisor_crash_budget < 0:
             raise ConfigError("supervisor_crash_budget must be >= 0")

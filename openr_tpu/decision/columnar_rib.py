@@ -178,6 +178,7 @@ def build_entries(
     # Sliced by row — the delta path calls this for a handful of
     # rows and must not pay an O(P) conversion.
     v4_rows_l = matrix.is_v4[rows].tolist()
+    built = 0
     for i, p in enumerate(rows_l):
         vi = vi_l[i]
         row = s3_l[vi]
@@ -249,6 +250,12 @@ def build_entries(
         d["lfa_nexthops"] = lfa_nexthops
         entry.__dict__.update(d)
         routes[prefix] = entry
+        built += 1
+    if built:
+        # the zero-objects gate for the columnar spine: any hot path
+        # that claims to stay in packed-array land is asserted against
+        # this counter standing still
+        counters.increment("decision.rib.entries_built", built)
 
 
 class _Cols:
@@ -634,6 +641,24 @@ class LazyUnicastRoutes(MutableMapping):
         if self._merged is not None:
             return len(self._merged)
         return len(self._key_set())
+
+    def snapshot(self) -> "LazyUnicastRoutes":
+        """Detached copy sharing the column bundles: fresh RibViews pin
+        the current generation (copy-on-write protects them from future
+        solver patches) while host layers are shallow-copied. O(1) in
+        routes — this is how the Fib actor swaps a 100k-route desired
+        state without re-keying a dict."""
+        segs = []
+        for s in self.segments:
+            v = RibView(s.crib)
+            if v.cols is not s.cols:  # pin s's generation, not the tip
+                v.cols = s.cols
+                v.epoch = s.epoch
+            segs.append(v)
+        lz = LazyUnicastRoutes(self.base, segs)
+        lz.overrides = dict(self.overrides)
+        lz.deleted = set(self.deleted)
+        return lz
 
     def materialized(self) -> dict:
         """Force: one bulk build per segment, then a flat snapshot."""

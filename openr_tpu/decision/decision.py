@@ -35,6 +35,7 @@ from openr_tpu.decision.prefix_state import PrefixState
 from openr_tpu.decision.rib import (
     DecisionRouteDb,
     DecisionRouteUpdate,
+    ProvenanceLedger,
     RouteProvenance,
     RouteUpdateType,
 )
@@ -150,6 +151,10 @@ def make_solver(
 class Decision(Actor):
     """ref Decision.h:130."""
 
+    # deltas at/above this many routes provenance-stamp as one ledger
+    # layer instead of one RouteProvenance per prefix (columnar spine)
+    _BULK_STAMP_MIN = 4096
+
     def __init__(
         self,
         node_name: str,
@@ -233,7 +238,7 @@ class Decision(Actor):
         # _ingest_tags remembers each prefix's last originating kv event
         # across builds (topology-driven full rebuilds change routes
         # whose own advertisement is long past)
-        self._provenance: dict[str, RouteProvenance] = {}
+        self._provenance = ProvenanceLedger()
         self._ingest_tags: dict[str, tuple] = {}
         self._solve_epoch = 0
 
@@ -640,21 +645,42 @@ class Decision(Actor):
         for prefix in update.unicast_routes_to_delete:
             self._provenance.pop(prefix, None)
             self._ingest_tags.pop(prefix, None)
-        for prefix in update.unicast_routes_to_update:
-            tag = (
-                pending.provenance_tags.get(prefix)
-                or topo
-                or self._ingest_tags.get(prefix)
-                or ("", "", "")
+        upd_map = update.unicast_routes_to_update
+        if (
+            update.columns is not None
+            and len(upd_map) >= self._BULK_STAMP_MIN
+        ):
+            # columnar spine: one ledger LAYER for the whole delta —
+            # the tags ride the columns' membership map and the actual
+            # RouteProvenance records are built per-prefix on explain,
+            # never in bulk on the hot path. Fallback inputs are
+            # snapshotted so later ingest-tag mutation can't rewrite
+            # history.
+            ingest = (
+                dict(self._ingest_tags)
+                if topo is None and self._ingest_tags
+                else None
             )
-            self._provenance[prefix] = RouteProvenance(
-                kv_key=tag[0],
-                originator=tag[1],
-                area=tag[2],
-                solve_epoch=self._solve_epoch,
-                solver_kind=kind,
-                ts_ms=now_ms,
+            self._provenance.stamp_layer(
+                upd_map, dict(pending.provenance_tags), topo, ingest,
+                self._solve_epoch, kind, now_ms,
             )
+        else:
+            for prefix in upd_map:
+                tag = (
+                    pending.provenance_tags.get(prefix)
+                    or topo
+                    or self._ingest_tags.get(prefix)
+                    or ("", "", "")
+                )
+                self._provenance[prefix] = RouteProvenance(
+                    kv_key=tag[0],
+                    originator=tag[1],
+                    area=tag[2],
+                    solve_epoch=self._solve_epoch,
+                    solver_kind=kind,
+                    ts_ms=now_ms,
+                )
         # remember each prefix's own advertisement for future builds
         # (after stamping: a delete+re-advertise in one batch must tag
         # with the new event, not the popped one)

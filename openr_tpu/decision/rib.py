@@ -139,6 +139,93 @@ class RouteProvenance:
     ts_ms: int = 0  # wall clock at stamping
 
 
+class ProvenanceLedger:
+    """Drop-in for Decision's per-prefix provenance dict with a bulk
+    column lane: a large build (cold rebuild, mass churn) stamps ONE
+    layer recording (membership map, per-prefix event tags, topology
+    fallback, ingest-tag snapshot, solve meta) instead of constructing
+    one RouteProvenance per route — at 100k..1M routes that object loop
+    was the last O(routes) allocation left on the columnar spine. The
+    record object is built only when `breeze decision explain` actually
+    asks for a prefix.
+
+    get / pop / __setitem__ match dict semantics exactly (the only
+    operations Decision performs); newest stamp wins via a global
+    sequence, so an explicit re-stamp or delete always shadows an older
+    layer and a newer layer shadows older explicit stamps. Layers are
+    capped: the oldest folds into explicit records (preserving its
+    original sequence) once more than _LAYER_MAX bulk builds coexist."""
+
+    _LAYER_MAX = 4
+
+    __slots__ = ("_explicit", "_layers", "_seq")
+
+    def __init__(self):
+        # prefix -> (seq, RouteProvenance | None); None = tombstone
+        self._explicit: dict = {}
+        # (seq, members, tags, topo, ingest, epoch, kind, ts_ms), seq
+        # ascending; `members` is any Mapping with cheap iter/contains
+        self._layers: list = []
+        self._seq = 0
+
+    def _next(self) -> int:
+        self._seq += 1
+        return self._seq
+
+    @staticmethod
+    def _build(layer, prefix: str) -> RouteProvenance:
+        _, _, tags, topo, ingest, epoch, kind, ts_ms = layer
+        tag = (
+            tags.get(prefix)
+            or topo
+            or (ingest.get(prefix) if ingest else None)
+            or ("", "", "")
+        )
+        return RouteProvenance(
+            kv_key=tag[0], originator=tag[1], area=tag[2],
+            solve_epoch=epoch, solver_kind=kind, ts_ms=ts_ms,
+        )
+
+    def __setitem__(self, prefix: str, prov: RouteProvenance) -> None:
+        self._explicit[prefix] = (self._next(), prov)
+
+    def pop(self, prefix: str, default=None):
+        out = self.get(prefix, default)
+        if self._layers:
+            self._explicit[prefix] = (self._next(), None)
+        else:
+            self._explicit.pop(prefix, None)
+        return out
+
+    def get(self, prefix: str, default=None):
+        seq, prov = self._explicit.get(prefix, (0, None))
+        for layer in reversed(self._layers):
+            if layer[0] <= seq:
+                break
+            if prefix in layer[1]:
+                return self._build(layer, prefix)
+        return prov if prov is not None else default
+
+    def stamp_layer(self, members, tags, topo, ingest, epoch, kind,
+                    ts_ms) -> None:
+        self._layers.append(
+            (self._next(), members, tags, topo, ingest, epoch, kind, ts_ms)
+        )
+        if len(self._layers) > self._LAYER_MAX:
+            self._fold_oldest()
+
+    def _fold_oldest(self) -> None:
+        layer = self._layers.pop(0)
+        seq = layer[0]
+        for prefix in layer[1]:
+            es, _ = self._explicit.get(prefix, (0, None))
+            if es > seq:
+                continue
+            if any(prefix in nl[1] for nl in self._layers):
+                continue  # a newer layer answers for it anyway
+            self._explicit[prefix] = (seq, self._build(layer, prefix))
+
+
 class RouteUpdateType(enum.IntEnum):
     """ref RouteUpdate.h:34."""
 
@@ -157,6 +244,13 @@ class DecisionRouteUpdate:
     mpls_routes_to_delete: list[int] = field(default_factory=list)
     perf_events: Optional[PerfEvents] = None
     prefix_type: Optional[int] = None  # set for static-route updates
+    # columnar spine: when the diff stayed in packed-array land this is
+    # the ColumnDelta behind unicast_routes_to_update (which is then a
+    # lazy ColumnUpdateMap, not a dict) — Fib and the platform consume
+    # the arrays, object consumers force the Mapping. None on the
+    # legacy/object path; excluded from serde (dataclass field order
+    # keeps wire compat because serde emits by name).
+    columns: Optional[object] = None
 
     def empty(self) -> bool:
         return not (
@@ -183,25 +277,41 @@ class DecisionRouteDb:
     def calculate_update(self, new_db: "DecisionRouteDb") -> DecisionRouteUpdate:
         """Delta from self -> new_db (ref DecisionRouteDb::calculateUpdate)."""
         upd = DecisionRouteUpdate()
-        # columnar fast path: when both RIBs are lazy views over the same
-        # column stores, the device's changed-row journal bounds the
-        # entry-level compare to O(changed) instead of O(P) — the diff
-        # never materializes the unchanged bulk of either side
+        # columnar spine (ISSUE 12): when the new RIB is a live lazy view
+        # over the column stores, the diff itself stays in packed-array
+        # land — cold rebuilds ship every ok row with zero compares and
+        # zero entry builds, warm rebuilds column-compare only the
+        # journaled rows. unicast_routes_to_update becomes a lazy
+        # ColumnUpdateMap; Fib/platform consume upd.columns directly.
+        from openr_tpu.decision.column_delta import fast_unicast_column_diff
         from openr_tpu.decision.columnar_rib import fast_unicast_diff
 
-        res = fast_unicast_diff(self.unicast_routes, new_db.unicast_routes)
-        if res is not None:
-            upd.unicast_routes_to_update, dels = res
-            upd.unicast_routes_to_delete = dels
-            upd.fast_diff = True  # observability (not a dataclass field)
+        delta = fast_unicast_column_diff(
+            self.unicast_routes, new_db.unicast_routes
+        )
+        if delta is not None:
+            upd.columns = delta
+            upd.unicast_routes_to_update = delta.lazy_map()
+            upd.unicast_routes_to_delete = delta.deletes
+            upd.fast_diff = not delta.full  # observability (not a field)
         else:
-            for prefix, entry in new_db.unicast_routes.items():
-                old = self.unicast_routes.get(prefix)
-                if old is None or old != entry:
-                    upd.unicast_routes_to_update[prefix] = entry
-            for prefix in self.unicast_routes:
-                if prefix not in new_db.unicast_routes:
-                    upd.unicast_routes_to_delete.append(prefix)
+            # legacy entry-level journal diff (kept as the parity oracle
+            # for the columnar path), then the full O(P) compare
+            res = fast_unicast_diff(
+                self.unicast_routes, new_db.unicast_routes
+            )
+            if res is not None:
+                upd.unicast_routes_to_update, dels = res
+                upd.unicast_routes_to_delete = dels
+                upd.fast_diff = True  # observability (not a field)
+            else:
+                for prefix, entry in new_db.unicast_routes.items():
+                    old = self.unicast_routes.get(prefix)
+                    if old is None or old != entry:
+                        upd.unicast_routes_to_update[prefix] = entry
+                for prefix in self.unicast_routes:
+                    if prefix not in new_db.unicast_routes:
+                        upd.unicast_routes_to_delete.append(prefix)
         for label, entry in new_db.mpls_routes.items():
             old = self.mpls_routes.get(label)
             if old is None or old != entry:

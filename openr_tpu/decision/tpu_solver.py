@@ -2684,6 +2684,11 @@ class TpuSpfSolver:
                     "saturated_rows": int(sbuf[off - 1]),
                 }
             stats["trips"] = trips
+            # prime the ok-row index off the actor thread: the columnar
+            # diff downstream starts from key_rows(), and computing it
+            # here (still on the materialization worker) keeps the
+            # Decision loop's first touch O(1)
+            stats["ok_rows"] = int(len(crib.cols.key_rows()))
             t3 = _time.perf_counter()
             return {
                 "view": crib.view(),

@@ -28,6 +28,10 @@ import time
 from typing import Optional
 
 from openr_tpu.config import FibConfig
+from openr_tpu.decision.columnar_rib import (
+    LazyUnicastRoutes,
+    _lookup as _lazy_lookup,
+)
 from openr_tpu.decision.rib import (
     DecisionRouteUpdate,
     RibMplsEntry,
@@ -72,14 +76,40 @@ class RouteState:
         self.state = FibState.AWAITING_UPDATE
 
     def update(self, upd: DecisionRouteUpdate) -> None:
-        for prefix, entry in upd.unicast_routes_to_update.items():
-            self.unicast_routes[prefix] = entry
-        for prefix in upd.unicast_routes_to_delete:
-            self.unicast_routes.pop(prefix, None)
+        cols = upd.columns
+        if cols is not None and cols.new_mapping is not None:
+            # columnar spine: Decision is the sole producer on this
+            # queue and delivers in order, so our desired state equals
+            # its previous table — swap in the new table's detached
+            # lazy snapshot instead of re-keying O(routes) dict slots
+            # (and, on the legacy path, forcing the lazy update map)
+            self.unicast_routes = cols.new_mapping
+        else:
+            for prefix, entry in upd.unicast_routes_to_update.items():
+                self.unicast_routes[prefix] = entry
+            for prefix in upd.unicast_routes_to_delete:
+                self.unicast_routes.pop(prefix, None)
         for label, entry in upd.mpls_routes_to_update.items():
             self.mpls_routes[label] = entry
         for label in upd.mpls_routes_to_delete:
             self.mpls_routes.pop(label, None)
+
+    def unicast_route_of(self, prefix: str):
+        """Single-route read WITHOUT bulk-forcing a columnar table (the
+        dirty-programming path touches O(changed) routes; a plain
+        [] would materialize every row of the backing column store)."""
+        ur = self.unicast_routes
+        if isinstance(ur, LazyUnicastRoutes):
+            return _lazy_lookup(ur, prefix)
+        return ur.get(prefix)
+
+    def unicast_snapshot(self):
+        """Publishable snapshot of the desired unicast table: O(1) for
+        a columnar table (detached lazy clone), dict copy otherwise."""
+        ur = self.unicast_routes
+        if isinstance(ur, LazyUnicastRoutes):
+            return ur.snapshot()
+        return dict(ur)
 
 
 class Fib(Actor):
@@ -229,9 +259,23 @@ class Fib(Actor):
             # chaos seam: a programming failure here must land in the
             # existing retry-with-backoff machinery below
             maybe_fail("fib.program", span=sp)
-            await self.service.sync_fib(
-                CLIENT_ID_OPENR, list(rs.unicast_routes.values())
-            )
+            batch = None
+            if getattr(self.service, "supports_columns", False):
+                from openr_tpu.decision.column_delta import (
+                    build_column_batch,
+                )
+
+                batch = build_column_batch(rs.unicast_routes)
+            if batch is not None:
+                # columnar spine: the desired table ships as packed
+                # arrays — no per-route objects between here and the
+                # dataplane's bulk transaction
+                counters.increment("fib.column_syncs")
+                await self.service.sync_fib_columns(CLIENT_ID_OPENR, batch)
+            else:
+                await self.service.sync_fib(
+                    CLIENT_ID_OPENR, list(rs.unicast_routes.values())
+                )
         except FibUpdateError as e:
             failed_p.update(e.failed_prefixes)
             failed_l.update(e.failed_labels)
@@ -308,7 +352,7 @@ class Fib(Actor):
         self._retry_backoff.report_success()
         self._finish_sync(
             perf,
-            unicast=dict(rs.unicast_routes),
+            unicast=rs.unicast_snapshot(),
             mpls=dict(rs.mpls_routes),
             trace=trace,
         )
@@ -331,7 +375,7 @@ class Fib(Actor):
     def _finish_sync(
         self,
         perf: Optional[PerfEvents],
-        unicast: dict[str, RibUnicastEntry],
+        unicast,  # dict or LazyUnicastRoutes snapshot (columnar spine)
         mpls: dict[int, RibMplsEntry],
         trace: Optional[TraceContext] = None,
     ) -> None:
@@ -428,17 +472,21 @@ class Fib(Actor):
             if add_prefixes:
                 await self.service.add_unicast_routes(
                     CLIENT_ID_OPENR,
-                    [rs.unicast_routes[p] for p in add_prefixes],
+                    [rs.unicast_route_of(p) for p in add_prefixes],
                 )
             for p in add_prefixes:
                 rs.dirty_prefixes.pop(p, None)
-                programmed.unicast_routes_to_update[p] = rs.unicast_routes[p]
+                programmed.unicast_routes_to_update[p] = (
+                    rs.unicast_route_of(p)
+                )
         except FibUpdateError as e:
             ok = False
             for p in add_prefixes:
                 if p not in e.failed_prefixes:
                     rs.dirty_prefixes.pop(p, None)
-                    programmed.unicast_routes_to_update[p] = rs.unicast_routes[p]
+                    programmed.unicast_routes_to_update[p] = (
+                        rs.unicast_route_of(p)
+                    )
         except Exception as e:
             counters.increment("fib.program_error")
             log.warning("%s: add_unicast failed: %s", self.name, e)

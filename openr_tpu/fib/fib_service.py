@@ -40,6 +40,19 @@ class FibUpdateError(RuntimeError):
 class FibServiceBase:
     """Interface the Fib actor programs against (ref Platform.thrift)."""
 
+    # columnar spine capability gate: a service that accepts packed
+    # RouteColumnBatch syncs sets this True and implements
+    # sync_fib_columns; the Fib actor otherwise materializes entries
+    # and calls sync_fib (MockFibService stays object-only on purpose —
+    # it is the parity oracle for the columnar path)
+    supports_columns = False
+
+    async def sync_fib_columns(self, client_id: int, batch) -> None:
+        """Full table sync from a decision.column_delta.RouteColumnBatch
+        (packed arrays + next-hop group table, no route objects).
+        Same failure contract as sync_fib (FibUpdateError subsets)."""
+        raise NotImplementedError
+
     async def add_unicast_routes(
         self, client_id: int, routes: list[RibUnicastEntry]
     ) -> None:

@@ -32,6 +32,59 @@ from openr_tpu.runtime.rpc import RpcClient, RpcServer
 from openr_tpu.serde import to_plain
 
 
+from collections.abc import MutableMapping as _MutableMapping
+
+
+class _ColumnTable(_MutableMapping):
+    """MemoryDataplane's unicast table after a columnar sync: the packed
+    RouteColumnBatch IS the table, and per-route dicts exist only once
+    something actually reads route values (introspection dump, a later
+    per-route mutation). len/iter stay array-backed so holding a
+    million-route table costs arrays, not a million dict objects."""
+
+    __slots__ = ("batch", "_skip", "_d")
+
+    def __init__(self, batch, skip=()):
+        self.batch = batch
+        self._skip = frozenset(skip)
+        self._d: Optional[dict] = None
+        if self._skip:  # failure injection is a test path — just force
+            self._force()
+
+    def _force(self) -> dict:
+        if self._d is None:
+            self._d = {
+                p: r
+                for p, r in self.batch.iter_route_dicts()
+                if p not in self._skip
+            }
+        return self._d
+
+    def __getitem__(self, k):
+        return self._force()[k]
+
+    def __setitem__(self, k, v):
+        self._force()[k] = v
+
+    def __delitem__(self, k):
+        del self._force()[k]
+
+    def __contains__(self, k):
+        if self._d is not None:
+            return k in self._d
+        return k in self.batch.prefix_set()
+
+    def __iter__(self):
+        if self._d is not None:
+            return iter(self._d)
+        return iter(self.batch.prefix_set())
+
+    def __len__(self):
+        if self._d is not None:
+            return len(self._d)
+        return self.batch.route_count()
+
+
 class MemoryDataplane:
     """In-memory route tables behind the same seam as the kernel-facing
     backend; supports per-prefix/label failure injection so the Fib
@@ -50,6 +103,18 @@ class MemoryDataplane:
             if p not in failed:
                 self.unicast[p] = r
         return failed
+
+    async def sync_unicast_columns(self, batch) -> list[str]:
+        """Columnar full sync: adopt the packed batch as the table
+        without building any per-route dicts (they materialize lazily
+        on first read — see _ColumnTable)."""
+        failed: list[str] = []
+        if self.fail_prefixes:
+            failed = [
+                p for p in batch.prefixes if p in self.fail_prefixes
+            ] + [p for p in batch.extra if p in self.fail_prefixes]
+        self.unicast = _ColumnTable(batch, failed)
+        return sorted(failed)
 
     async def delete_unicast(self, prefixes: list[str]) -> list[str]:
         for p in prefixes:
@@ -79,7 +144,28 @@ class MemoryDataplane:
         return failed
 
     async def dump_unicast(self) -> dict:
+        # introspection crosses the RPC boundary as JSON — a lazily
+        # columnar table must materialize here (and only here)
+        if not isinstance(self.unicast, dict):
+            self.unicast = dict(self.unicast)
         return self.unicast
+
+
+def _count_bulk_fallback(e: Exception) -> None:
+    """Classify WHY a packed-bulk encode bailed to the per-route walk
+    (satellite counter: platform.fib.bulk_fallbacks[.<reason>]). The
+    counter surface has no labels, so the reason rides a name suffix."""
+    msg = str(e)
+    if "MPLS" in msg:
+        reason = "mpls_encap"
+    elif "family" in msg:
+        reason = "family_mismatch"
+    elif "nexthops exceed" in msg:
+        reason = "nexthop_overflow"
+    else:
+        reason = "encode_error"
+    counters.increment("platform.fib.bulk_fallbacks")
+    counters.increment(f"platform.fib.bulk_fallbacks.{reason}")
 
 
 class NetlinkDataplane:
@@ -91,13 +177,17 @@ class NetlinkDataplane:
     NetlinkRouteMessage.cpp:618-769); without it they fall back to the
     in-memory shadow so the Fib pipeline still round-trips."""
 
-    def __init__(self, table: int = 254):
+    def __init__(
+        self, table: int = 254, bulk_threshold: Optional[int] = None
+    ):
         from openr_tpu.platform.netlink import (
             NetlinkRouteSocket,
             mpls_supported,
         )
 
         self.table = table
+        if bulk_threshold is not None:
+            self.bulk_threshold = int(bulk_threshold)
         self.nl = NetlinkRouteSocket()
         self._opened = False
         self.mpls: dict[int, dict] = {}
@@ -210,12 +300,15 @@ class NetlinkDataplane:
     # when built (native/netlink_bulk.cpp); smaller ones stay on the
     # asyncio client, which interleaves with other platform work
     BULK_THRESHOLD = 64
+    # effective knob (platform_config.bulk_threshold); class-level so
+    # instances built without __init__ (test fixtures) still resolve it
+    bulk_threshold = BULK_THRESHOLD
 
     async def _bulk(self, op: int, nl_routes) -> Optional[tuple[int, int]]:
         from openr_tpu.platform import netlink as nlmod
 
         if (
-            len(nl_routes) < self.BULK_THRESHOLD
+            len(nl_routes) < self.bulk_threshold
             or not nlmod.native_bulk_available()
         ):
             return None
@@ -225,10 +318,11 @@ class NetlinkDataplane:
 
         try:
             packed = nlmod.pack_bulk_routes(nl_routes)
-        except (ValueError, _struct.error):
+        except (ValueError, _struct.error) as e:
             # family-mismatched gateway, >255 nexthops, out-of-range
             # metric — anything the packed format can't encode goes
             # through the per-route path, which reports failures properly
+            _count_bulk_fallback(e)
             return None
         import openr_tpu_native
 
@@ -415,6 +509,155 @@ class NetlinkDataplane:
             failed += sorted(leftover - set(failed))
         return failed
 
+    @staticmethod
+    def _ifindex_of(name: str) -> int:
+        import socket as _socket
+
+        if not name:
+            return 0
+        try:
+            return _socket.if_nametoindex(name)
+        except OSError:
+            return 0
+
+    async def add_unicast_columns(self, batch) -> list[str]:
+        """Columnar add: program a RouteColumnBatch without building
+        per-route dicts. The packed arrays encode straight into the
+        C++ bulk wire format (pack_bulk_columns); route objects appear
+        only on the error-classification fallback, which must learn
+        WHICH prefixes failed. Make-before-break semantics are identical
+        to add_unicast — same _metric/_stale ledgers, same break phase."""
+        self._ensure_open()
+        failed: list[str] = []
+        # non-columnar leftovers (static/originated overrides) ride the
+        # object path — they are few by construction
+        if batch.extra:
+            failed += await self.add_unicast(dict(batch.extra))
+        # columnar rows only (route_count() also counts extras, which
+        # the object path above already handled)
+        n = len(batch.prefixes)
+        if n == 0:
+            return sorted(set(failed))
+        prefixes = batch.prefixes
+        metrics = batch.metric.tolist()
+        # make-before-break bookkeeping: only scan when a previous life
+        # actually recorded metrics (a cold first sync skips this walk)
+        pending_old: dict[str, set[int]] = {}
+        if self._metric or self._stale:
+            for p, new_m in zip(prefixes, metrics):
+                stale = set(self._stale.get(p, ()))
+                old = self._metric.get(p)
+                if old is not None and old != new_m:
+                    stale.add(old)
+                stale.discard(new_m)
+                if stale:
+                    pending_old[p] = stale
+        added_all = False
+        from openr_tpu.platform import netlink as nlmod
+
+        if n >= self.bulk_threshold and nlmod.native_bulk_available():
+            from openr_tpu.platform.netlink import PROTO_OPENR
+
+            packed = None
+            try:
+                packed = nlmod.pack_bulk_columns(batch, self._ifindex_of)
+            except ValueError as e:
+                # same contract as _bulk: anything the packed format
+                # cannot encode falls to the per-route walk
+                _count_bulk_fallback(e)
+            if packed is not None:
+                import openr_tpu_native
+
+                # lint: allow(executor-escape) C function; no actor state
+                ok, err = await asyncio.get_running_loop().run_in_executor(
+                    None,
+                    openr_tpu_native.bulk_route_op,
+                    0, self.table, PROTO_OPENR, packed,
+                )
+                if err == 0 and ok == n:
+                    self._metric.update(zip(prefixes, metrics))
+                    added_all = True
+        if not added_all:
+            # error-classification fallback: per-route walk to learn
+            # which prefixes failed (the bulk path returns counts only)
+            for i, p in enumerate(prefixes):
+                r = self._to_nl(p, batch.route_dict(i))
+                try:
+                    await self.nl.add_route(r)
+                    self._metric[p] = r.metric
+                except OSError:
+                    failed.append(p)
+        # break: clear old-metric entries only for prefixes whose new
+        # route landed (same tail as add_unicast)
+        failed_set = set(failed)
+        old_nl = [
+            self._to_nl(p, {"igp_cost": m})
+            for p, old_metrics in pending_old.items()
+            if p not in failed_set
+            for m in sorted(old_metrics)
+        ]
+        if old_nl:
+            leftover: dict[str, set[int]] = {}
+            for r in await self._delete_exact(old_nl):
+                leftover.setdefault(r.prefix, set()).add(r.metric)
+            for p in pending_old:
+                if p in failed_set:
+                    continue
+                if p in leftover:
+                    self._stale[p] = leftover[p]
+                    failed.append(p)
+                else:
+                    self._stale.pop(p, None)
+        return sorted(set(failed))
+
+    async def sync_unicast_columns(self, batch) -> list[str]:
+        """Columnar full sync: kernel dump + columnar add + stale sweep.
+        Mirrors sync_unicast exactly; the desired set is the batch's
+        prefix columns plus its object-path extras."""
+        import socket as _socket
+
+        from openr_tpu.platform.netlink import NlRoute, PROTO_OPENR
+
+        self._ensure_open()
+        have: dict[str, set[int]] = {}
+        for family in (_socket.AF_INET, _socket.AF_INET6):
+            for r in await self.nl.get_routes(
+                family, table=self.table, protocol=PROTO_OPENR
+            ):
+                have.setdefault(r.prefix, set()).add(r.metric)
+        failed = await self.add_unicast_columns(batch)
+        # prefix_set() covers columnar rows AND extras — the full
+        # desired table
+        stale = set(have) - batch.prefix_set()
+        stale_nl = [
+            NlRoute(prefix=p, metric=m, table=self.table)
+            for p in sorted(stale)
+            for m in sorted(have[p])
+        ]
+        if have:
+            # desired prefixes whose kernel copy also sits at an old
+            # metric (agent restart lost the metric record)
+            met_map = dict(zip(batch.prefixes, batch.metric.tolist()))
+            for p, r in batch.extra.items():
+                met_map[p] = r.get("igp_cost") or 0
+            failed_set = set(failed)
+            stale_nl += [
+                NlRoute(prefix=p, metric=m, table=self.table)
+                for p, want_m in met_map.items()
+                for m in have.get(p, ())
+                if p not in failed_set and m != want_m
+            ]
+        if stale_nl:
+            failed_nl = await self._delete_exact(stale_nl)
+            leftover = {r.prefix for r in failed_nl}
+            for p in stale:
+                if p not in leftover:
+                    self._metric.pop(p, None)
+            for p in {r.prefix for r in stale_nl} - leftover:
+                self._stale.pop(p, None)
+            failed += sorted(leftover - set(failed))
+        return failed
+
     async def add_mpls(self, routes: dict[int, dict]) -> list[int]:
         failed: list[int] = []
         if self.mpls_kernel:
@@ -514,6 +757,7 @@ class FibPlatformServer:
         r("platform.fib.add_unicast_routes", self._add_unicast)
         r("platform.fib.delete_unicast_routes", self._del_unicast)
         r("platform.fib.sync_fib", self._sync_fib)
+        r("platform.fib.sync_fib_columns", self._sync_fib_columns)
         r("platform.fib.add_mpls_routes", self._add_mpls)
         r("platform.fib.delete_mpls_routes", self._del_mpls)
         r("platform.fib.sync_mpls_fib", self._sync_mpls)
@@ -560,6 +804,23 @@ class FibPlatformServer:
         )
         return {"failed_prefixes": failed}
 
+    async def _sync_fib_columns(self, client_id: int, batch) -> dict:
+        from openr_tpu.decision.column_delta import RouteColumnBatch
+
+        t0 = time.monotonic()
+        b = RouteColumnBatch.from_wire(batch)
+        dp = self.dataplane
+        if hasattr(dp, "sync_unicast_columns"):
+            failed = await dp.sync_unicast_columns(b)
+        else:
+            # dataplane predates the columnar seam — decode to dicts
+            failed = await dp.sync_unicast(b.as_route_dicts())
+        counters.add_stat_value(
+            "platform.fib.sync_ms", (time.monotonic() - t0) * 1e3
+        )
+        counters.increment("platform.fib.column_syncs")
+        return {"failed_prefixes": failed}
+
     async def _add_mpls(self, client_id: int, routes: dict) -> dict:
         failed = await self.dataplane.add_mpls(
             {int(k): v for k, v in routes.items()}
@@ -593,6 +854,10 @@ class RemoteFibService(FibServiceBase):
     Partial failures come back as failed-set payloads and re-raise as
     FibUpdateError so the actor's dirty-route retry path is identical in
     and out of process."""
+
+    # packed column syncs cross the RPC boundary as base64 arrays —
+    # the Fib actor never materializes route objects for this service
+    supports_columns = True
 
     def __init__(self, host: str = "127.0.0.1", port: int = 60100):
         self.client = RpcClient(host, port, name="fib-service")
@@ -648,6 +913,13 @@ class RemoteFibService(FibServiceBase):
         res = await self.client.request(
             "platform.fib.sync_fib",
             {"client_id": client_id, "routes": self._unicast_payload(routes)},
+        )
+        self._raise_failed(res)
+
+    async def sync_fib_columns(self, client_id, batch) -> None:
+        res = await self.client.request(
+            "platform.fib.sync_fib_columns",
+            {"client_id": client_id, "batch": batch.to_wire()},
         )
         self._raise_failed(res)
 

@@ -38,6 +38,14 @@ def parse_args(argv=None) -> argparse.Namespace:
         default=254,
         help="kernel route table for the netlink backend",
     )
+    p.add_argument(
+        "--bulk-threshold",
+        type=int,
+        default=None,
+        help="batch size at which the netlink backend switches to the "
+        "C++ bulk programmer (platform_config.bulk_threshold; default "
+        f"{NetlinkDataplane.BULK_THRESHOLD})",
+    )
     p.add_argument("--verbose", action="store_true")
     return p.parse_args(argv)
 
@@ -48,7 +56,7 @@ async def run(args) -> None:
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
     dataplane = (
-        NetlinkDataplane(table=args.table)
+        NetlinkDataplane(table=args.table, bulk_threshold=args.bulk_threshold)
         if args.backend == "netlink"
         else MemoryDataplane()
     )

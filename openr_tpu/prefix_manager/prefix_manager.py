@@ -294,19 +294,24 @@ class PrefixManager(Actor):
         if len(self.areas) > 1:
             self._redistribute_across_areas(upd)
         changed = False
-        for prefix, entry in upd.unicast_routes_to_update.items():
-            if self._track_nexthops:
-                nhs = frozenset(
-                    nh.address for nh in entry.nexthops if nh.address
-                )
-                if self._route_nexthops.get(prefix) != nhs:
-                    self._route_nexthops[prefix] = nhs
-                    changed = True  # next-hop group may move the label
-            for ostate in self.originated.values():
-                if self._supports(prefix, ostate.conf.prefix):
-                    if prefix not in ostate.supporting:
-                        ostate.supporting.add(prefix)
-                        changed = True
+        # the per-entry walk forces route values out of the update map —
+        # a FIB-ACK carrying a lazy columnar table materializes entries
+        # here. Skip it outright when nothing consumes them (no segment
+        # labels to track, no originated prefixes to support)
+        if self._track_nexthops or self.originated:
+            for prefix, entry in upd.unicast_routes_to_update.items():
+                if self._track_nexthops:
+                    nhs = frozenset(
+                        nh.address for nh in entry.nexthops if nh.address
+                    )
+                    if self._route_nexthops.get(prefix) != nhs:
+                        self._route_nexthops[prefix] = nhs
+                        changed = True  # next-hop group may move the label
+                for ostate in self.originated.values():
+                    if self._supports(prefix, ostate.conf.prefix):
+                        if prefix not in ostate.supporting:
+                            ostate.supporting.add(prefix)
+                            changed = True
         for prefix in upd.unicast_routes_to_delete:
             self._route_nexthops.pop(prefix, None)
             for ostate in self.originated.values():

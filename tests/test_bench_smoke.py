@@ -24,6 +24,11 @@ def test_bench_config_smoke_device_path():
     # pipeline stages + the forced consumption pass
     assert res["full_ms"] > 0
     assert "cold_consume_ms" in res
+    # ISSUE 12: the zero-copy program lane reports its timing and the
+    # entries_built standstill (0 = no per-route objects constructed)
+    assert res["cold_program_ms"] >= 0, res
+    assert res["cold_program_routes"] > 0, res
+    assert res["cold_program_entries_built"] == 0, res
     bd = res["full_breakdown"]
     for k in ("sync_ms", "exec_ms", "mat_ms",
               "pipeline_wall_ms", "pipeline_stages_ms"):
@@ -144,6 +149,98 @@ def test_bench_multichip_engages_above_threshold_only():
     assert res_off["multichip_engaged"] is False, res_off
     assert "multichip" not in res_off, res_off
     assert e2 == e1, (e1, e2)
+
+
+def test_columnar_program_path_builds_zero_route_objects():
+    """ISSUE 12 tier-1 gate: the cold program+consume lane — device
+    columns -> RouteColumnBatch -> columnar dataplane sync — must not
+    build a single per-route object. The decision.rib.entries_built
+    counter (incremented by every columnar entry materialization) must
+    stand still across the lane, and advance once something actually
+    forces the table, proving the gate measures what it claims."""
+    import asyncio
+
+    from openr_tpu.decision.column_delta import build_column_batch
+    from openr_tpu.decision.columnar_rib import LazyUnicastRoutes
+    from openr_tpu.decision.tpu_solver import TpuSpfSolver
+    from openr_tpu.models import topologies
+    from openr_tpu.platform.fib_handler import MemoryDataplane
+    from openr_tpu.runtime.counters import counters
+
+    adj_dbs, prefix_dbs = topologies.grid(6, node_labels=False)
+    states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    db = TpuSpfSolver("node-3-3").build_route_db("node-3-3", states, ps)
+    assert isinstance(db.unicast_routes, LazyUnicastRoutes)
+    eb0 = int(counters.get_counter("decision.rib.entries_built") or 0)
+    batch = build_column_batch(db.unicast_routes)
+    assert batch is not None
+    dp = MemoryDataplane()
+    asyncio.run(dp.sync_unicast_columns(batch))
+    n_programmed = len(dp.unicast)
+    eb1 = int(counters.get_counter("decision.rib.entries_built") or 0)
+    assert eb1 == eb0, "program path materialized per-route objects"
+    # sanity: the counter DOES fire when the table is forced
+    mat = dict(db.unicast_routes)
+    eb2 = int(counters.get_counter("decision.rib.entries_built") or 0)
+    assert eb2 - eb1 == len(mat) > 0, (eb1, eb2, len(mat))
+    assert n_programmed == len(mat)
+
+
+def test_columnar_program_per_route_beats_recorded_mat_baseline():
+    """ISSUE 12 perf gate vs the recorded r05 baseline: BENCH_r05.json
+    pins the eager cold materialization at 933.4 ms for ~100k routes
+    (9.33 us/route). The packed program path — netlink wire-format
+    encode + columnar table sync — must land well under half that
+    per-route on a synthetic 20k-row batch (the full bench pins the
+    >=5x headline at real scale; half keeps this smoke flake-proof on
+    shared CI boxes)."""
+    import asyncio
+    import json
+    import socket
+    import time
+
+    import numpy as np
+
+    from openr_tpu.decision.column_delta import RouteColumnBatch
+    from openr_tpu.platform.fib_handler import MemoryDataplane
+    from openr_tpu.platform.netlink import pack_bulk_columns
+
+    with open("BENCH_r05.json") as fh:
+        r05 = json.load(fh)
+    base = r05["parsed"]["configs"]["lsdb100k"]
+    base_us_per_route = (
+        base["full_breakdown"]["mat_ms"] * 1e3 / base["prefixes"]
+    )
+    assert base_us_per_route > 0
+
+    n = 20_000
+    prefixes = [f"10.{(i >> 8) & 255}.{i & 255}.0/24" for i in range(n)]
+    family = np.full(n, socket.AF_INET, np.uint8)
+    plen = np.full(n, 24, np.uint8)
+    addr = np.zeros((n, 16), np.uint8)
+    addr[:, 0] = 10
+    addr[:, 1] = (np.arange(n) >> 8) & 255
+    addr[:, 2] = np.arange(n) & 255
+    metric = (np.arange(n, dtype=np.int32) % 97) + 1
+    nh_gid = np.arange(n, dtype=np.int32) % 4
+    nh_groups = [
+        [{"address": f"169.254.0.{g + 1}", "if_name": "", "weight": 0}]
+        for g in range(4)
+    ]
+    batch = RouteColumnBatch(
+        prefixes, family, plen, addr, metric, nh_gid, nh_groups
+    )
+    t0 = time.perf_counter()
+    packed = pack_bulk_columns(batch, lambda name: 0)
+    dp = MemoryDataplane()
+    asyncio.run(dp.sync_unicast_columns(batch))
+    us_per_route = (time.perf_counter() - t0) * 1e6 / n
+    assert len(packed) == n * (24 + 24), len(packed)
+    assert len(dp.unicast) == n
+    assert us_per_route < base_us_per_route / 2, (
+        f"{us_per_route:.2f} us/route vs r05 baseline "
+        f"{base_us_per_route:.2f} us/route"
+    )
 
 
 def test_bench_config_small_graph_delegation_still_reports():
