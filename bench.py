@@ -371,6 +371,60 @@ def bench_config(name, gen, me, runs=5, flap_victims=0, cpu_baseline=True,
             f"/ changed {res['incr_changed_rows']} "
             f"/ xla {res['incr_xla_cache']})")
         del tpu_i
+
+    # kernel A/B lane: sync vs bucketed Δ-stepping (ops/relax.py) over
+    # the SAME flap sequence (round indices match, so each lane sees
+    # identical per-run graphs). Records device-only time, executed
+    # relaxation rounds, bucket epochs, and the multichip halo-exchange
+    # count — the round/halo delta is the bucketed kernel's whole claim.
+    if res.get("device_ms") is not None:
+        res["kernel_ab"] = {}
+        for kern in ("sync", "bucketed"):
+            tpu_k = TpuSpfSolver(
+                me, small_graph_nodes=small_graph_nodes,
+                spf_kernel=kern, **tpu_kw, **solver_kw,
+            )
+            tpu_k.build_route_db(me, states, ps)  # warm jit
+            k_samples, k_rounds, k_epochs, k_halo, k_engaged = (
+                [], [], [], [], 0
+            )
+            for i in range(runs):
+                _flap(states, adj_dbs, victims, 2 * runs + i, area)
+                t0 = time.perf_counter()
+                tpu_k.build_route_db(me, states, ps)
+                k_samples.append((time.perf_counter() - t0) * 1e3)
+                tm_k = getattr(tpu_k, "last_timing", {})
+                k_rounds.append(int(tm_k.get("rounds") or 0))
+                k_epochs.append(int(tm_k.get("bucket_epochs") or 0))
+                k_halo.append(int(tm_k.get("halo_exchanges") or 0))
+                if tm_k.get("spf_kernel") == "bucketed":
+                    k_engaged += 1
+            lane = {
+                "tpu_ms": round(statistics.median(k_samples), 1),
+                "rounds": max(k_rounds) if k_rounds else 0,
+                "bucket_epochs": max(k_epochs) if k_epochs else 0,
+                "halo_exchanges": max(k_halo) if k_halo else 0,
+                "engaged": k_engaged,
+            }
+            k_dev = tpu_k.device_compute_ms()
+            if k_dev is not None:
+                lane["device_ms"] = round(k_dev, 2)
+            res["kernel_ab"][kern] = lane
+            log(f"[{name}] kernel={kern}: device-only "
+                f"{lane.get('device_ms')} ms / rounds {lane['rounds']} "
+                f"/ epochs {lane['bucket_epochs']} "
+                f"/ halo {lane['halo_exchanges']} "
+                f"/ engaged {k_engaged}/{runs}")
+            del tpu_k
+        ab = res["kernel_ab"]
+        ab["rounds_decreased"] = (
+            0 < ab["bucketed"]["rounds"] < ab["sync"]["rounds"]
+        )
+        if ab["sync"]["halo_exchanges"]:
+            ab["halo_decreased"] = (
+                ab["bucketed"]["halo_exchanges"]
+                < ab["sync"]["halo_exchanges"]
+            )
     return res, tpu_ms, cpu_ms
 
 
@@ -592,6 +646,22 @@ def main() -> None:
         "incr_device_ms_100k": configs.get("lsdb100k", {}).get(
             "incr_device_ms"
         ),
+        # bucketed Δ-stepping headlines: single-chip device-only time at
+        # 100k under each kernel, and the 1M multichip halo-exchange
+        # count (one pmin per bucket EPOCH under bucketed vs one per
+        # relaxation round under sync)
+        "device_ms_100k_bucketed": configs.get("lsdb100k", {}).get(
+            "kernel_ab", {}
+        ).get("bucketed", {}).get("device_ms"),
+        "device_ms_100k_sync": configs.get("lsdb100k", {}).get(
+            "kernel_ab", {}
+        ).get("sync", {}).get("device_ms"),
+        "mc_halo_exchanges_1m": configs.get("lsdb1m", {}).get(
+            "kernel_ab", {}
+        ).get("bucketed", {}).get("halo_exchanges"),
+        "mc_halo_exchanges_1m_sync": configs.get("lsdb1m", {}).get(
+            "kernel_ab", {}
+        ).get("sync", {}).get("halo_exchanges"),
         # the 100k single-chip vs multichip side-by-side: the capacity
         # tier must beat the single-chip device_ms at this scale to be
         # worth its pmin halo exchange

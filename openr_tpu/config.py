@@ -195,6 +195,14 @@ class DecisionConfig:
     # visible devices. 0 = auto (parallel/sharding.make_mesh — wide
     # batch, graph=2 from 4 devices up).
     multichip_batch: int = 0
+    # SSSP relaxation kernel (ops/relax.py): "bucketed" settles light
+    # edges with a Δ-stepping ladder per bucket epoch (one halo
+    # exchange per EPOCH in the multichip tier) and falls back to
+    # "sync" automatically on plans with no usable Δ; "sync" forces the
+    # classic synchronous rounds everywhere — the first bisection step
+    # when a device-solve result is under suspicion. Both kernels reach
+    # the identical int32 fixpoint.
+    spf_kernel: str = "bucketed"
 
 
 @dataclass
@@ -665,6 +673,8 @@ class Config:
             )
         if dc.multichip_batch < 0:
             raise ConfigError("decision multichip_batch must be >= 0")
+        if dc.spf_kernel not in ("sync", "bucketed"):
+            raise ConfigError(f"unknown spf_kernel {dc.spf_kernel!r}")
         pc = cfg.platform_config
         if pc.bulk_threshold < 1:
             raise ConfigError("platform bulk_threshold must be >= 1")

@@ -270,6 +270,7 @@ class CtrlServer(Actor):
         incr_stats = counters.get_statistics(
             "decision.solver.incr"
         )
+        device_stats = counters.get_statistics("decision.device")
         out = {
             "summary": tracer.convergence_summary(),
             "stat": counters.get_statistics("convergence_ms").get(
@@ -290,6 +291,19 @@ class CtrlServer(Actor):
                 ),
                 "changed_rows": incr_stats.get(
                     "decision.solver.incr.changed_rows", {}
+                ),
+                # executed relaxation work per solve (ops/relax.py
+                # ledger): rounds everywhere, bucket_epochs when the
+                # bucketed Δ-stepping kernel engaged, halo_exchanges in
+                # the multichip tier (one per epoch under bucketed)
+                "device_rounds": device_stats.get(
+                    "decision.device.rounds", {}
+                ),
+                "device_bucket_epochs": device_stats.get(
+                    "decision.device.bucket_epochs", {}
+                ),
+                "device_halo_exchanges": device_stats.get(
+                    "decision.device.halo_exchanges", {}
                 ),
             },
         }

@@ -123,6 +123,7 @@ def make_solver(
         kwargs.pop("incremental_cone_frac", None)
         kwargs.pop("multichip_n_cap_threshold", None)
         kwargs.pop("multichip_batch", None)
+        kwargs.pop("spf_kernel", None)
         return SpfSolver(node_name, **kwargs)
     if backend in ("tpu", "auto"):
         try:
@@ -144,6 +145,7 @@ def make_solver(
             kwargs.pop("incremental_cone_frac", None)
             kwargs.pop("multichip_n_cap_threshold", None)
             kwargs.pop("multichip_batch", None)
+            kwargs.pop("spf_kernel", None)
             return SpfSolver(node_name, **kwargs)
     raise ValueError(f"unknown solver backend {backend!r}")
 
@@ -204,6 +206,7 @@ class Decision(Actor):
                 config.multichip_n_cap_threshold,
             )
             skw.setdefault("multichip_batch", config.multichip_batch)
+            skw.setdefault("spf_kernel", config.spf_kernel)
         self.solver = make_solver(
             node_name,
             backend,
@@ -932,6 +935,14 @@ class Decision(Actor):
             # at least one area solved through the multichip capacity
             # tier (NamedSharding over the ('batch','graph') mesh)
             spf_sp.attributes["multichip"] = True
+        # executed relaxation work (ops/relax.py round ledger): rounds
+        # on every device solve; bucket epochs / halo exchanges when the
+        # bucketed kernel or the multichip tier engaged
+        for key in ("spf_kernel", "rounds", "bucket_epochs",
+                    "halo_exchanges"):
+            v = tm.get(key)
+            if v:
+                spf_sp.attributes[key] = v
         areas = tm.get("areas") or {"": tm}
         cursor = spf_sp.end
         for area, stages in sorted(areas.items(), reverse=True):

@@ -86,6 +86,7 @@ from openr_tpu.ops.edgeplan import (
     drain_dirty,
     sync_plan,
 )
+from openr_tpu.ops import relax as relax_ops
 from openr_tpu.ops.xla_cache import bounded_jit_cache
 from openr_tpu.types import (
     PrefixForwardingAlgorithm,
@@ -101,8 +102,8 @@ _NEG = -(2**31)
 _DELTA_BUDGET = 4096
 
 # relaxation steps fused per while_loop trip (steps past the fixpoint are
-# no-ops; fusing amortizes per-trip dispatch)
-_UNROLL = 8
+# no-ops; fusing amortizes per-trip dispatch) — owned by ops/relax.py
+_UNROLL = relax_ops.UNROLL
 
 # numerical-health sentinel threshold: finite metrics past 2^28 sit one
 # metric-add away from the 2^29 INF_E encoding — saturation territory
@@ -186,14 +187,7 @@ def _sssp_kernel(in_nbr, in_w, in_up, node_over, root):
         ).min(axis=1)
         return jnp.minimum(dist, cand)
 
-    def body(state):
-        dist, _ = state
-        new = dist
-        for _ in range(_UNROLL):
-            new = relax(new)
-        return new, jnp.any(new != dist)
-
-    dist, _ = jax.lax.while_loop(lambda s: s[1], body, (dist0, jnp.bool_(True)))
+    dist, _, _ = relax_ops.run_sync(relax, dist0, relax_ops.max_trips(n))
     return dist
 
 
@@ -221,14 +215,7 @@ def _next_hop_kernel(in_nbr, in_w, in_up, node_over, root, dist, root_nbr, root_
         prop = jnp.any(ok_parent[:, :, None] & nh[in_nbr], axis=1)
         return seed | prop
 
-    def body(state):
-        nh, _ = state
-        new = nh
-        for _ in range(_UNROLL):
-            new = step(new)
-        return new, jnp.any(new != nh)
-
-    nh, _ = jax.lax.while_loop(lambda s: s[1], body, (seed, jnp.bool_(True)))
+    nh, _, _ = relax_ops.run_sync(step, seed, relax_ops.max_trips(n))
     return nh
 
 
@@ -336,20 +323,25 @@ def _pack_words(bits):
 def _plan_sssp(deltas, shift_w, res_rows, res_nbr, res_w, root,
                seeds_nbr, seeds_w,
                s_cap: int, has_res: bool, n_cap: int, d_cap: int,
-               max_trips: int):
+               max_trips: int, kernel: str = "sync",
+               delta_exp: int = 0):
     """Batched SSSP [D, N] from seed nodes in G-minus-root over the
-    shift-decomposed mirror. INF discipline: INF32E = 2^29, weights
-    <= 2^28, so `dist + w` is overflow-free and needs no masks. The
-    residual gather is row-compact: it touches only destinations with
-    irregular in-edges and scatter-mins them back."""
-    import jax
+    shift-decomposed mirror (relaxation bodies live in ops/relax.py —
+    `kernel` selects sync rounds or the bucketed Δ-stepping epochs).
+    INF discipline: INF32E = 2^29, weights <= 2^28, so `dist + w` is
+    overflow-free and needs no masks. The residual gather is
+    row-compact: it touches only destinations with irregular in-edges
+    and scatter-mins them back."""
     import jax.numpy as jnp
 
     sw = shift_w.at[:, root].set(INF_E)
+    residual = None
     if has_res:
         rw = jnp.where(res_nbr == root, INF_E, res_w)
         nbr_c = jnp.clip(res_nbr, 0, n_cap - 1)
         rows_c = jnp.clip(res_rows, 0, n_cap - 1)
+        # pad rows (res_rows == -1) carry all-INF weights -> no-ops
+        residual = (rows_c, nbr_c, rw)
     valid = seeds_w < INF_E
     seed_idx = jnp.clip(seeds_nbr, 0, n_cap - 1)
     dist0 = jnp.full((d_cap, n_cap), INF_E, jnp.int32)
@@ -357,33 +349,16 @@ def _plan_sssp(deltas, shift_w, res_rows, res_nbr, res_w, root,
         jnp.where(valid, 0, INF_E).astype(jnp.int32)
     )
 
-    def relax(dist):
-        def cls(k, acc):
-            return jnp.minimum(
-                acc, jnp.roll(dist + sw[k][None, :], deltas[k], axis=1)
-            )
-        acc = jax.lax.fori_loop(0, s_cap, cls, dist)
-        if has_res:
-            nd = dist[:, nbr_c]  # [D, R, K] gather (R = residual rows)
-            cand = (nd + rw[None]).min(axis=2)  # [D, R]
-            # pad rows (res_rows == -1) carry all-INF weights -> no-ops
-            acc = acc.at[:, rows_c].min(cand)
-        return jnp.minimum(acc, dist)
-
-    def body(state):
-        dist, _, t = state
-        new = dist
-        for _ in range(_UNROLL):
-            new = relax(new)
-        return new, jnp.any(new != dist), t + 1
-
-    def cond(state):
-        return state[1] & (state[2] < max_trips)
-
-    dist, _, trips = jax.lax.while_loop(
-        cond, body, (dist0, jnp.bool_(True), jnp.int32(0))
+    relax = relax_ops.make_relax(
+        deltas, s_cap, lambda k: sw[k], residual=residual
     )
-    return dist, trips
+    if kernel == "bucketed":
+        return relax_ops.run_bucketed(
+            relax, dist0, deltas, sw, lambda k: sw[k],
+            n_cap, s_cap, delta_exp,
+        )
+    dist, trips, rounds = relax_ops.run_sync(relax, dist0, max_trips)
+    return dist, trips, rounds
 
 
 def _make_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
@@ -391,7 +366,8 @@ def _make_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
                    d_cap: int, p_cap: int, a_cap: int, budget: int,
                    lfa: bool = False, block_v4: bool = False,
                    sentinels: bool = True, emit_dist: bool = False,
-                   incr: bool = False, mesh=None):
+                   incr: bool = False, mesh=None,
+                   kernel: str = "sync", delta_exp: int = 0):
     """The fused production pipeline (raw closure — _plan_pipeline jits
     it for the single-area path, _fused_pipeline vmaps it over a group
     of same-shape areas). Outputs:
@@ -435,7 +411,7 @@ def _make_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
     wa = -(-a_cap // 16)
     wd = -(-d_cap // 16)
     pa = p_cap * a_cap
-    max_trips = max(2, -(-n_cap // _UNROLL) + 2)
+    max_trips = relax_ops.max_trips(n_cap)
 
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec
@@ -446,11 +422,13 @@ def _make_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
         mc_rep = NamedSharding(mesh, PartitionSpec())
         if incr:
             mc_sssp_incr = make_mc_incremental_sssp(
-                mesh, s_cap, has_res, n_cap, d_cap, max_trips
+                mesh, s_cap, has_res, n_cap, d_cap, max_trips,
+                kernel, delta_exp,
             )
         else:
             mc_sssp = make_mc_sssp(
-                mesh, s_cap, has_res, n_cap, d_cap, max_trips
+                mesh, s_cap, has_res, n_cap, d_cap, max_trips,
+                kernel, delta_exp,
             )
 
     def pipeline(deltas, shift_w, res_rows, res_nbr, res_w, mbuf,
@@ -477,34 +455,38 @@ def _make_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
             (prev_dist, s_dirty_idx, s_dirty_old,
              r_dirty_idx, r_dirty_old, cone_limit) = incr_args
             if mesh is not None:
-                dist_d, trips_v, cone_v, fell_v = mc_sssp_incr(
+                dist_d, trips_v, cone_v, fell_v, rounds_v = mc_sssp_incr(
                     deltas, shift_w, res_rows, res_nbr, res_w, root,
                     root_nbr, root_w, prev_dist,
                     s_dirty_idx, s_dirty_old, r_dirty_idx, r_dirty_old,
                     cone_limit,
                 )
                 trips = trips_v.max()
+                rounds = rounds_v.max()
                 cone, fell_back = cone_v[0], fell_v[0]
             else:
-                dist_d, trips, cone, fell_back = incremental_sssp(
+                dist_d, trips, cone, fell_back, rounds = incremental_sssp(
                     deltas, shift_w, res_rows, res_nbr, res_w, root,
                     root_nbr, root_w, prev_dist,
                     s_dirty_idx, s_dirty_old, r_dirty_idx, r_dirty_old,
                     cone_limit,
                     s_cap, has_res, n_cap, d_cap, max_trips,
+                    kernel, delta_exp,
                 )  # [D, N]
         else:
             if mesh is not None:
-                dist_d, trips_v = mc_sssp(
+                dist_d, trips_v, rounds_v = mc_sssp(
                     deltas, shift_w, res_rows, res_nbr, res_w, root,
                     root_nbr, root_w,
                 )
                 trips = trips_v.max()
+                rounds = rounds_v.max()
             else:
-                dist_d, trips = _plan_sssp(
+                dist_d, trips, rounds = _plan_sssp(
                     deltas, shift_w, res_rows, res_nbr, res_w, root,
                     root_nbr, root_w,
                     s_cap, has_res, n_cap, d_cap, max_trips,
+                    kernel, delta_exp,
                 )  # [D, N]
         if mesh is not None:
             # the resident copy stays lane-sharded (out_shardings pins
@@ -632,14 +614,28 @@ def _make_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
             delta_parts += [unreach[None], saturated[None]]
             full_parts += [unreach[None], saturated[None]]
         if incr:
-            # cone + in-kernel-fallback flag ride last (the host parses
-            # the tail back to front: [-2]=cone, [-1]=fell_back, with
-            # the sentinels at [-4]/[-3] when enabled)
+            # cone + in-kernel-fallback flag (the host parses the tail
+            # back to front: [-3]=cone, [-2]=fell_back, with the
+            # sentinels at [-5]/[-4] when enabled, rounds always at [-1])
             tail = [cone[None], fell_back.astype(jnp.int32)[None]]
             delta_parts += tail
             full_parts += tail
+        # executed-relaxation work metric rides LAST unconditionally:
+        # sync rounds = trips * UNROLL; bucketed rounds = ladder passes
+        # + one handoff relaxation per bucket epoch (trips = epochs)
+        delta_parts += [rounds[None].astype(jnp.int32)]
+        full_parts += [rounds[None].astype(jnp.int32)]
         delta_buf = jnp.concatenate(delta_parts)
         full_buf = jnp.concatenate(full_parts)
+        if mesh is not None:
+            # pin BOTH pull buffers replicated: on small shape classes
+            # GSPMD re-partitions the short concatenate and emits an
+            # unreduced partial-sum over 'graph' (every element times
+            # the axis size — same artifact family as the dynamic-roll
+            # miscompile make_mc_sssp documents). The out_shardings pin
+            # alone does not reach back through the concatenate.
+            delta_buf = jax.lax.with_sharding_constraint(delta_buf, mc_rep)
+            full_buf = jax.lax.with_sharding_constraint(full_buf, mc_rep)
         outs = (delta_buf, full_buf, metric, s3w, nhw, lfa_slot,
                 lfa_metric)
         if emit_dist:
@@ -654,12 +650,14 @@ def _plan_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
                    has_res: bool,
                    d_cap: int, p_cap: int, a_cap: int, budget: int,
                    lfa: bool = False, block_v4: bool = False,
-                   sentinels: bool = True, emit_dist: bool = False):
+                   sentinels: bool = True, emit_dist: bool = False,
+                   kernel: str = "sync", delta_exp: int = 0):
     import jax
 
     return jax.jit(_make_pipeline(
         n_cap, s_cap, r_cap, kr_cap, has_res, d_cap, p_cap, a_cap,
         budget, lfa, block_v4, sentinels, emit_dist,
+        kernel=kernel, delta_exp=delta_exp,
     ))
 
 
@@ -668,7 +666,8 @@ def _incr_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
                    has_res: bool,
                    d_cap: int, p_cap: int, a_cap: int, budget: int,
                    dirty_cap: int, lfa: bool = False,
-                   block_v4: bool = False, sentinels: bool = True):
+                   block_v4: bool = False, sentinels: bool = True,
+                   kernel: str = "sync", delta_exp: int = 0):
     """Incremental-solve executable. `dirty_cap` is the quantized pad
     size of BOTH dirty buffers — part of the capacity signature so
     dirty-set shape churn buckets under the `incr` namespace and can
@@ -679,6 +678,7 @@ def _incr_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
     return jax.jit(_make_pipeline(
         n_cap, s_cap, r_cap, kr_cap, has_res, d_cap, p_cap, a_cap,
         budget, lfa, block_v4, sentinels, emit_dist=True, incr=True,
+        kernel=kernel, delta_exp=delta_exp,
     ))
 
 
@@ -686,7 +686,8 @@ def _incr_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
 def _fused_pipeline(g: int, n_cap: int, s_cap: int, r_cap: int,
                     kr_cap: int, has_res: bool,
                     d_cap: int, p_cap: int, a_cap: int, budget: int,
-                    lfa: bool, block_v4: bool, sentinels: bool):
+                    lfa: bool, block_v4: bool, sentinels: bool,
+                    kernel: str = "sync", delta_exp: int = 0):
     """`g` same-shape areas in ONE device dispatch: each of the 14
     pipeline inputs arrives as a g-tuple of per-area arrays (a pytree —
     still one dispatch), stacks inside the jit, and vmaps through the
@@ -701,6 +702,7 @@ def _fused_pipeline(g: int, n_cap: int, s_cap: int, r_cap: int,
     raw = _make_pipeline(
         n_cap, s_cap, r_cap, kr_cap, has_res, d_cap, p_cap, a_cap,
         budget, lfa, block_v4, sentinels,
+        kernel=kernel, delta_exp=delta_exp,
     )
 
     def fused(*area_args):
@@ -716,6 +718,7 @@ def _instrumented_fused(
     g: int, n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
     has_res: bool, d_cap: int, p_cap: int, a_cap: int, budget: int,
     lfa: bool, block_v4: bool, sentinels: bool,
+    kernel: str = "sync", delta_exp: int = 0,
 ) -> tuple:
     """(kernel name, instrumented callable) for a fused group shape —
     the fused analogue of _instrumented_pipeline."""
@@ -726,11 +729,12 @@ def _instrumented_fused(
         f"p={p_cap},a={a_cap}"
         + (",res" if has_res else "")
         + (",lfa" if lfa else "")
+        + (f",bk{delta_exp}" if kernel == "bucketed" else "")
         + "]"
     )
     jitted = _fused_pipeline(
         g, n_cap, s_cap, r_cap, kr_cap, has_res, d_cap, p_cap, a_cap,
-        budget, lfa, block_v4, sentinels,
+        budget, lfa, block_v4, sentinels, kernel, delta_exp,
     )
     return name, instrument_jit(name, jitted)
 
@@ -741,6 +745,7 @@ def _instrumented_pipeline(
     d_cap: int, p_cap: int, a_cap: int, budget: int,
     lfa: bool, block_v4: bool, sentinels: bool,
     emit_dist: bool = False,
+    kernel: str = "sync", delta_exp: int = 0,
 ) -> tuple:
     """(kernel name, instrumented callable) for a pipeline shape class.
     The wrapper AOT-compiles on first call, recording compile time +
@@ -754,11 +759,13 @@ def _instrumented_pipeline(
         f"pipeline[n={n_cap},s={s_cap},d={d_cap},p={p_cap},a={a_cap}"
         + (",res" if has_res else "")
         + (",lfa" if lfa else "")
+        + (f",bk{delta_exp}" if kernel == "bucketed" else "")
         + "]"
     )
     jitted = _plan_pipeline(
         n_cap, s_cap, r_cap, kr_cap, has_res, d_cap, p_cap, a_cap,
         budget, lfa, block_v4, sentinels, emit_dist,
+        kernel, delta_exp,
     )
     return name, instrument_jit(name, jitted)
 
@@ -768,6 +775,7 @@ def _instrumented_incr(
     n_cap: int, s_cap: int, r_cap: int, kr_cap: int, has_res: bool,
     d_cap: int, p_cap: int, a_cap: int, budget: int, dirty_cap: int,
     lfa: bool, block_v4: bool, sentinels: bool,
+    kernel: str = "sync", delta_exp: int = 0,
 ) -> tuple:
     """(kernel name, instrumented callable) for an incremental-solve
     shape class — the incr-namespace analogue of
@@ -779,11 +787,13 @@ def _instrumented_incr(
         f"a={a_cap},dd={dirty_cap}"
         + (",res" if has_res else "")
         + (",lfa" if lfa else "")
+        + (f",bk{delta_exp}" if kernel == "bucketed" else "")
         + "]"
     )
     jitted = _incr_pipeline(
         n_cap, s_cap, r_cap, kr_cap, has_res, d_cap, p_cap, a_cap,
         budget, dirty_cap, lfa, block_v4, sentinels,
+        kernel, delta_exp,
     )
     return name, instrument_jit(name, jitted)
 
@@ -824,7 +834,8 @@ def _mc_pipeline(mesh, n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
                  has_res: bool,
                  d_cap: int, p_cap: int, a_cap: int, budget: int,
                  lfa: bool = False, block_v4: bool = False,
-                 sentinels: bool = True, emit_dist: bool = False):
+                 sentinels: bool = True, emit_dist: bool = False,
+                 kernel: str = "sync", delta_exp: int = 0):
     """The multichip capacity tier's full-solve executable: the SAME
     pipeline closure as _plan_pipeline, jitted with NamedSharding
     annotations over the ('batch','graph') mesh so GSPMD partitions the
@@ -841,6 +852,7 @@ def _mc_pipeline(mesh, n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
         _make_pipeline(
             n_cap, s_cap, r_cap, kr_cap, has_res, d_cap, p_cap, a_cap,
             budget, lfa, block_v4, sentinels, emit_dist, mesh=mesh,
+            kernel=kernel, delta_exp=delta_exp,
         ),
         in_shardings=in_sh, out_shardings=out_sh,
     )
@@ -851,7 +863,8 @@ def _mc_incr_pipeline(mesh, n_cap: int, s_cap: int, r_cap: int,
                       kr_cap: int, has_res: bool,
                       d_cap: int, p_cap: int, a_cap: int, budget: int,
                       dirty_cap: int, lfa: bool = False,
-                      block_v4: bool = False, sentinels: bool = True):
+                      block_v4: bool = False, sentinels: bool = True,
+                      kernel: str = "sync", delta_exp: int = 0):
     """Incremental-solve executable under the multichip tier: the warm
     seed plane stays device-resident in its sharded layout (in AND out
     pinned to the same spec, so chaining solves never reshards)."""
@@ -866,7 +879,7 @@ def _mc_incr_pipeline(mesh, n_cap: int, s_cap: int, r_cap: int,
         _make_pipeline(
             n_cap, s_cap, r_cap, kr_cap, has_res, d_cap, p_cap, a_cap,
             budget, lfa, block_v4, sentinels, emit_dist=True, incr=True,
-            mesh=mesh,
+            mesh=mesh, kernel=kernel, delta_exp=delta_exp,
         ),
         in_shardings=in_sh, out_shardings=out_sh,
     )
@@ -882,6 +895,7 @@ def _instrumented_mc(
     has_res: bool, d_cap: int, p_cap: int, a_cap: int, budget: int,
     lfa: bool, block_v4: bool, sentinels: bool,
     emit_dist: bool = False,
+    kernel: str = "sync", delta_exp: int = 0,
 ) -> tuple:
     """(kernel name, instrumented callable) for a multichip shape
     class — the multichip-namespace analogue of
@@ -893,11 +907,13 @@ def _instrumented_mc(
         f"a={a_cap},mesh={_mesh_tag(mesh)}"
         + (",res" if has_res else "")
         + (",lfa" if lfa else "")
+        + (f",bk{delta_exp}" if kernel == "bucketed" else "")
         + "]"
     )
     jitted = _mc_pipeline(
         mesh, n_cap, s_cap, r_cap, kr_cap, has_res, d_cap, p_cap,
         a_cap, budget, lfa, block_v4, sentinels, emit_dist,
+        kernel, delta_exp,
     )
     return name, instrument_jit(name, jitted)
 
@@ -907,6 +923,7 @@ def _instrumented_mc_incr(
     mesh, n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
     has_res: bool, d_cap: int, p_cap: int, a_cap: int, budget: int,
     dirty_cap: int, lfa: bool, block_v4: bool, sentinels: bool,
+    kernel: str = "sync", delta_exp: int = 0,
 ) -> tuple:
     """(kernel name, instrumented callable) for a multichip
     incremental-solve shape class."""
@@ -917,11 +934,13 @@ def _instrumented_mc_incr(
         f"a={a_cap},dd={dirty_cap},mesh={_mesh_tag(mesh)}"
         + (",res" if has_res else "")
         + (",lfa" if lfa else "")
+        + (f",bk{delta_exp}" if kernel == "bucketed" else "")
         + "]"
     )
     jitted = _mc_incr_pipeline(
         mesh, n_cap, s_cap, r_cap, kr_cap, has_res, d_cap, p_cap,
         a_cap, budget, dirty_cap, lfa, block_v4, sentinels,
+        kernel, delta_exp,
     )
     return name, instrument_jit(name, jitted)
 
@@ -1292,7 +1311,8 @@ class TpuSpfSolver:
         incremental_spf: bool = False,
         incremental_cone_frac: float = 0.25,
         multichip_n_cap_threshold: int = 131072,
-        multichip_batch: int = 0, **solver_kwargs
+        multichip_batch: int = 0,
+        spf_kernel: str = "bucketed", **solver_kwargs
     ):
         # a restarting daemon must not pay the ~80s 100k-node compile
         # again — load executables from the persistent cache
@@ -1333,6 +1353,15 @@ class TpuSpfSolver:
         # 0 disables the tier.
         self.multichip_n_cap_threshold = int(multichip_n_cap_threshold)
         self.multichip_batch = int(multichip_batch)
+        # SSSP round-loop implementation (ops/relax.py): "bucketed"
+        # selects the Δ-stepping kernel wherever the plan is eligible
+        # (plan.delta_exp > 0, i.e. it has usable shift classes) and
+        # falls back to the synchronous rounds otherwise; "sync" forces
+        # the classic rounds everywhere (the bisection first step —
+        # docs/Operations.md)
+        if spf_kernel not in ("sync", "bucketed"):
+            raise ValueError(f"unknown spf_kernel {spf_kernel!r}")
+        self.spf_kernel = spf_kernel
         # memoized tier mesh: built once per process (device topology is
         # static within a solver's lifetime; device LOSS surfaces as a
         # dispatch failure -> CPU-oracle failover, not a mesh rebuild)
@@ -1670,10 +1699,21 @@ class TpuSpfSolver:
         area_timing: dict[str, dict] = {}
         incremental = False
         multichip: dict | bool = False
+        rounds_total = 0
+        bucket_epochs_total = 0
+        halo_total = 0
+        bucketed_engaged = False
         for area, fut in pending.futures:
             res = fut.result()
             views.append(res["view"])
             stats = res["stats"]
+            # relaxation-work ledger (ISSUE 13): per-solve totals feed
+            # decision.device.* stats + last_timing for bench/convergence
+            rounds_total += int(stats.get("rounds") or 0)
+            bucket_epochs_total += int(stats.get("bucket_epochs") or 0)
+            halo_total += int(stats.get("halo_exchanges") or 0)
+            if stats.get("spf_kernel") == "bucketed":
+                bucketed_engaged = True
             if stats.get("incremental"):
                 # a warm re-relax converges in a trip or two — not a
                 # diameter bound the sharded fabric path may reuse
@@ -1712,6 +1752,14 @@ class TpuSpfSolver:
             # once per SOLVE (dispatches count per area): the signal an
             # operator alerts on is "the tier is live", not its fan-out
             counters.increment("decision.solver.multichip.engaged")
+        counters.add_stat_value("decision.device.rounds", rounds_total)
+        counters.add_stat_value(
+            "decision.device.bucket_epochs", bucket_epochs_total
+        )
+        if halo_total:
+            counters.add_stat_value(
+                "decision.device.halo_exchanges", halo_total
+            )
         wall = (_time.perf_counter() - pending.t_pipe0) * 1e3
         self.last_timing = {
             **stages,
@@ -1721,6 +1769,10 @@ class TpuSpfSolver:
             "bytes_uploaded": float(pending.bytes_uploaded),
             "incremental": incremental,
             "multichip": multichip,
+            "rounds": rounds_total,
+            "bucket_epochs": bucket_epochs_total,
+            "halo_exchanges": halo_total,
+            "spf_kernel": "bucketed" if bucketed_engaged else "sync",
             **pending.ksp2_timing,
         }
         return route_db
@@ -1918,7 +1970,7 @@ class TpuSpfSolver:
             # one vantage's measured eccentricity bound; another root's
             # can be ~2x it, so seed with 2x + 1 slack
             n_trips = max(2, 2 * self.last_trips + 1)
-            cap_trips = max(4, -(-plan.n_cap // _UNROLL) + 2)
+            cap_trips = max(4, relax_ops.max_trips(plan.n_cap))
             while True:
                 try:
                     (_dist, metric, s3, nh_mask, lfa_slot, lfa_metric,
@@ -2345,6 +2397,17 @@ class TpuSpfSolver:
         links_tuple = tuple(links)
         lfa = self.cpu.enable_lfa
         block_v4 = not (self.cpu.enable_v4 or self.cpu.v4_over_v6_nexthop)
+        # round-loop selection (ops/relax.py): the bucketed Δ-stepping
+        # kernel engages only when the plan derived a usable Δ
+        # (delta_exp > 0 — it has nonzero shift classes with finite
+        # weights); ineligible plans fall back to the sync rounds
+        # silently. delta_exp joins the executable's capacity signature,
+        # kernel the fuse key (sync and bucketed lanes never vmap
+        # together).
+        if self.spf_kernel == "bucketed" and plan.delta_exp > 0:
+            spf_kernel, delta_exp = "bucketed", plan.delta_exp
+        else:
+            spf_kernel, delta_exp = "sync", 0
         if (
             vs.shape_key != cache_key
             or vs.matrix_version != ad.matrix_version
@@ -2419,8 +2482,9 @@ class TpuSpfSolver:
             "area": area, "ad": ad, "plan": plan, "matrix": matrix,
             "root_idx": root_idx, "root_nbr": root_nbr, "root_w": root_w,
             "shape_key": shape_key,
-            "fuse_key": (shape_key, lfa, block_v4),
+            "fuse_key": (shape_key, lfa, block_v4, spf_kernel, delta_exp),
             "vs": vs, "lfa": lfa, "block_v4": block_v4,
+            "kernel": spf_kernel, "delta_exp": delta_exp,
             "d_cap": d_cap, "p_cap": p_cap, "a_cap": a_cap,
             "mc": mc, "incr": incr, "root_sig": root_sig,
             "dist_epoch": ad.drain_epoch,
@@ -2454,11 +2518,13 @@ class TpuSpfSolver:
                 kernel_name, run = _instrumented_mc_incr(
                     mc, *pv["shape_key"], _DELTA_BUDGET, incr["cap"],
                     pv["lfa"], pv["block_v4"], self.enable_sentinels,
+                    pv["kernel"], pv["delta_exp"],
                 )
             else:
                 kernel_name, run = _instrumented_incr(
                     *pv["shape_key"], _DELTA_BUDGET, incr["cap"],
                     pv["lfa"], pv["block_v4"], self.enable_sentinels,
+                    pv["kernel"], pv["delta_exp"],
                 )
             args = self._lane_args(pv) + (
                 pv["vs"].prev_dist,
@@ -2482,11 +2548,13 @@ class TpuSpfSolver:
             kernel_name, run = _instrumented_mc(
                 mc, *pv["shape_key"], _DELTA_BUDGET, pv["lfa"],
                 pv["block_v4"], self.enable_sentinels, emit,
+                pv["kernel"], pv["delta_exp"],
             )
         else:
             kernel_name, run = _instrumented_pipeline(
                 *pv["shape_key"], _DELTA_BUDGET, pv["lfa"],
                 pv["block_v4"], self.enable_sentinels, emit,
+                pv["kernel"], pv["delta_exp"],
             )
         args = self._lane_args(pv)
         delta_buf, full_buf, *new_prev = run(*args)
@@ -2514,6 +2582,7 @@ class TpuSpfSolver:
         kernel_name, run = _instrumented_fused(
             g, *pv0["shape_key"], _DELTA_BUDGET, pv0["lfa"],
             pv0["block_v4"], self.enable_sentinels,
+            pv0["kernel"], pv0["delta_exp"],
         )
         lanes = [self._lane_args(pv) for pv in group]
         area_args = tuple(
@@ -2546,6 +2615,7 @@ class TpuSpfSolver:
         sentinels = self.enable_sentinels
         d_cap, p_cap, a_cap = pv["d_cap"], pv["p_cap"], pv["a_cap"]
         t0, t1 = pv["t0"], pv["t1"]
+        spf_kernel = pv.get("kernel", "sync")
         mc = pv.get("mc")
         mc_info = None if mc is None else {
             "shards": mc.size,
@@ -2651,16 +2721,15 @@ class TpuSpfSolver:
                     None if lfa_slot is None else lfa_slot[live][:count],
                     None if lfa_metric is None else lfa_metric[live][:count],
                 )
-            if sentinels or incr:
-                # the sentinel scalars ride the tail of whichever
-                # buffer this solve pulled (appended last in
-                # _plan_pipeline, after the lfa columns); the
-                # incremental kernel appends [cone, fell_back] after
-                # them, shifting the sentinels to [-4]/[-3]
-                sbuf = fbuf if full_pull else dbuf
+            # tail layout, back to front: [-1] is always the executed-
+            # relaxation rounds scalar; the incremental kernel's
+            # [cone, fell_back] sit at [-3]/[-2]; the sentinel scalars
+            # precede whichever of those are present
+            sbuf = fbuf if full_pull else dbuf
+            rounds = int(sbuf[-1])
             if incr:
-                cone = int(sbuf[-2])
-                fell_back = bool(sbuf[-1])
+                cone = int(sbuf[-3])
+                fell_back = bool(sbuf[-2])
                 stats["incremental"] = True
                 stats["cone"] = cone
                 stats["fell_back"] = fell_back
@@ -2678,12 +2747,24 @@ class TpuSpfSolver:
                     "decision.solver.incr.changed_rows", count or 0
                 )
             if sentinels:
-                off = -2 if incr else 0
+                off = -3 if incr else -1
                 stats["sentinels"] = {
                     "unreachable_rows": int(sbuf[off - 2]),
                     "saturated_rows": int(sbuf[off - 1]),
                 }
             stats["trips"] = trips
+            # executed-relaxation work accounting (ISSUE 13): rounds is
+            # the device-counted relaxation passes; under the bucketed
+            # kernel trips counts bucket epochs, and in the multichip
+            # tier each sync relaxation (= round) costs one pmin halo
+            # exchange while bucketed pays one per EPOCH
+            stats["rounds"] = rounds
+            stats["spf_kernel"] = spf_kernel
+            stats["bucket_epochs"] = trips if spf_kernel == "bucketed" else 0
+            if mc_info is not None:
+                stats["halo_exchanges"] = (
+                    trips if spf_kernel == "bucketed" else rounds
+                )
             # prime the ok-row index off the actor thread: the columnar
             # diff downstream starts from key_rows(), and computing it
             # here (still on the materialization worker) keeps the

@@ -125,9 +125,20 @@ class _Chunk:
             for j, (flat, val) in enumerate(r_pairs):
                 rs_idx[i + 1, j] = flat
                 rs_val[i + 1, j] = val
+        # kernel eligibility mirrors the live solver's _prep_vantage
+        # ladder: bucketed iff the knob asks for it AND the plan derived
+        # a usable Δ. The TE baseline forces sync — its measured trips
+        # bound the float surrogate's scan length, and only synchronous
+        # rounds measure the diameter.
+        spf_kernel = getattr(job.engine.solver, "spf_kernel", "sync")
+        delta_exp = int(getattr(plan, "delta_exp", 0))
+        if (spf_kernel != "bucketed" or delta_exp <= 0
+                or getattr(job, "force_sync", False)):
+            spf_kernel, delta_exp = "sync", 0
         name, run = sweep_batch(
             b_pad, len(job.roots), es, er, n_cap, s_cap, r_cap, kr_cap,
             has_res, sweep_max_trips(n_cap), job.return_dist,
+            spf_kernel, delta_exp,
         )
         with tracer.span(
             job.ctx, "whatif.dispatch", kernel=name,
@@ -150,6 +161,9 @@ class _Chunk:
         if self.job.return_dist:
             self.job.dist_planes.append(np.asarray(self._out[4]))
         self.job.trips = max(self.job.trips, int(trips))
+        self.job.rounds = max(
+            self.job.rounds, int(np.asarray(self._out[-1]))
+        )
         self._out = None
         rows = []
         for i, scen in enumerate(self.scenarios, start=1):
@@ -186,6 +200,8 @@ class SweepJob:
         self.chunks: list[_Chunk] = []
         self.dist_planes: list[np.ndarray] = []
         self.trips = 0
+        self.rounds = 0
+        self.force_sync = False
         self._t0 = time.perf_counter()
 
     def result(self, rows: list[dict]) -> dict:
@@ -564,6 +580,7 @@ class WhatIfEngine:
             import jax
 
             base_job.roots_dev = jax.device_put(base_job.roots)
+            base_job.force_sync = True
             base_chunk = _Chunk(base_job, [], [])
             base_job.chunks.append(base_chunk)
             base_chunk.dispatch()

@@ -133,6 +133,12 @@ class EdgePlan:
     has_zero_w: bool = False
     # bumped when node index mapping changes (matrix cache key)
     index_version: int = 0
+    # pow2 Δ-quantization exponent for the bucketed stepping kernel
+    # (ops/relax.derive_delta_exp), computed once per mirror build and
+    # STICKY across rebuilds of the same area so churn never flips the
+    # (kernel, delta_exp) jit-cache class. 0 = no usable shift classes:
+    # the solver's eligibility ladder falls back to the sync kernel.
+    delta_exp: int = 0
 
     # -- host-side out-edge view (per-vantage, cheap) ----------------------
 
@@ -345,6 +351,17 @@ def build_plan(
             else prev.index_version + 1
         )
 
+    # sticky Δ: keep the previous build's exponent while it is usable so
+    # metric churn can't thrash the (kernel, delta_exp) jit-cache class;
+    # local import keeps ops/relax out of this module's import graph for
+    # host-only consumers
+    if prev is not None and prev.delta_exp > 0:
+        delta_exp = prev.delta_exp
+    else:
+        from openr_tpu.ops.relax import derive_delta_exp
+
+        delta_exp = derive_delta_exp(deltas, shift_w)
+
     return EdgePlan(
         n_nodes=n,
         n_cap=n_cap,
@@ -370,6 +387,7 @@ def build_plan(
         _res_nrows=n_rows,
         synced_generation=link_state.generation,
         index_version=index_version,
+        delta_exp=delta_exp,
     )
 
 

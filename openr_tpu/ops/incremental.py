@@ -42,8 +42,10 @@ validity masks on gathers.
 
 from __future__ import annotations
 
+from openr_tpu.ops import relax as relax_ops
+
 INF_E = 1 << 29  # matches edgeplan.INF32E / tpu_solver.INF_E
-_UNROLL = 8  # relax/propagate steps per while_loop trip
+_UNROLL = relax_ops.UNROLL  # relax/propagate steps per while_loop trip
 
 
 def _old_planes(shift_w, res_w, s_dirty_idx, s_dirty_old,
@@ -128,15 +130,18 @@ def incremental_sssp(deltas, shift_w, res_rows, res_nbr, res_w, root,
                      s_dirty_idx, s_dirty_old,
                      r_dirty_idx, r_dirty_old, cone_limit,
                      s_cap: int, has_res: bool, n_cap: int, d_cap: int,
-                     max_trips: int):
+                     max_trips: int, kernel: str = "sync",
+                     delta_exp: int = 0):
     """Incremental counterpart of tpu_solver._plan_sssp. Same resident
     inputs plus: prev_dist [D, N] (the last solve's per-slot plane),
     consolidated dirty tuples (flat index into the raveled shift /
     residual weight planes + each slot's PRE-drain value; pads are
     out-of-range indices), and cone_limit (dynamic int32 scalar —
-    affected-cone budget in node-lanes). Returns
-    (dist [D, N], trips, cone, fell_back) with `dist` bit-identical to
-    the cold solve's fixpoint."""
+    affected-cone budget in node-lanes). `kernel` selects the final
+    re-relaxation's implementation (ops/relax.py sync rounds or
+    bucketed Δ-stepping) — either way the fixpoint is unique, so the
+    output stays bit-identical to the cold solve. Returns
+    (dist [D, N], trips, cone, fell_back, rounds)."""
     import jax
     import jax.numpy as jnp
 
@@ -248,40 +253,26 @@ def incremental_sssp(deltas, shift_w, res_rows, res_nbr, res_w, root,
     cold = cold.at[lanes, seed_idx].min(pin)
     dist0 = jnp.where(fell_back, cold, warm)
 
-    # --- relax to fixpoint under the NEW weights (same loop shape as
-    # the cold solve; fixpoint uniqueness gives bit-identical output)
-    def relax(dist):
-        def cls(k, acc):
-            return jnp.minimum(
-                acc,
-                jnp.roll(dist + swm_new[k][None, :], deltas[k], axis=1),
-            )
-
-        acc = jax.lax.fori_loop(0, s_cap, cls, dist)
-        if has_res:
-            nd = dist[:, nbr_c]
-            cand = (nd + rwm_new[None]).min(axis=2)
-            acc = acc.at[:, rows_c].min(cand)
-        return jnp.minimum(acc, dist)
-
-    def body(state):
-        dist, _, t = state
-        new = dist
-        for _ in range(_UNROLL):
-            new = relax(new)
-        return new, jnp.any(new != dist), t + 1
-
-    def cond(state):
-        return state[1] & (state[2] < max_trips)
-
-    dist, _, trips = jax.lax.while_loop(
-        cond, body, (dist0, jnp.bool_(True), jnp.int32(0))
+    # --- relax to fixpoint under the NEW weights (the shared kernel
+    # bodies in ops/relax.py; fixpoint uniqueness gives bit-identical
+    # output whichever implementation runs)
+    residual = (rows_c, nbr_c, rwm_new) if has_res else None
+    relax = relax_ops.make_relax(
+        deltas, s_cap, lambda k: swm_new[k], residual=residual
     )
-    return dist, trips, cone, fell_back
+    if kernel == "bucketed":
+        dist, trips, rounds = relax_ops.run_bucketed(
+            relax, dist0, deltas, swm_new, lambda k: swm_new[k],
+            n_cap, s_cap, delta_exp,
+        )
+    else:
+        dist, trips, rounds = relax_ops.run_sync(relax, dist0, max_trips)
+    return dist, trips, cone, fell_back, rounds
 
 
 def jit_incremental_sssp(s_cap: int, has_res: bool, n_cap: int,
-                         d_cap: int, max_trips: int):
+                         d_cap: int, max_trips: int,
+                         kernel: str = "sync", delta_exp: int = 0):
     """Standalone jitted wrapper for unit tests; production composes
     incremental_sssp into the solver pipeline tail instead."""
     import jax
@@ -290,5 +281,5 @@ def jit_incremental_sssp(s_cap: int, has_res: bool, n_cap: int,
     return jax.jit(partial(
         incremental_sssp,
         s_cap=s_cap, has_res=has_res, n_cap=n_cap, d_cap=d_cap,
-        max_trips=max_trips,
+        max_trips=max_trips, kernel=kernel, delta_exp=delta_exp,
     ))

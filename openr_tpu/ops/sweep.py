@@ -33,15 +33,15 @@ from __future__ import annotations
 
 import numpy as np
 
+from openr_tpu.ops import relax as relax_ops
 from openr_tpu.ops.edgeplan import INF32E
 from openr_tpu.ops.xla_cache import bounded_jit_cache, instrument_jit
 
 INF_E = int(INF32E)
 
-# fused relaxations per while_loop trip — mirrors the live pipeline's
-# unroll (decision/tpu_solver.py _UNROLL) so sweep trip counts are
-# comparable with the solver's last_trips
-_UNROLL = 8
+# fused relaxations per while_loop trip — owned by ops/relax.py so sweep
+# trip counts stay comparable with the solver's last_trips
+_UNROLL = relax_ops.UNROLL
 
 # "unreachable" in the float TE surrogate: finite so logsumexp grads
 # never see inf-inf (which poisons reverse-mode with NaNs), huge enough
@@ -53,11 +53,12 @@ def sweep_max_trips(n_cap: int) -> int:
     """Worst-case while_loop trips for a sweep SSSP — same bound as the
     live pipeline (a failure can only lengthen paths, never beyond the
     n-node chain the pipeline already bounds)."""
-    return max(2, -(-n_cap // _UNROLL) + 2)
+    return relax_ops.max_trips(n_cap)
 
 
 def _make_sweep(b, r, es_cap, er_cap, n_cap, s_cap, r_cap, kr_cap,
-                has_res, max_trips, return_dist):
+                has_res, max_trips, return_dist, kernel="sync",
+                delta_exp=0):
     import jax
     import jax.numpy as jnp
 
@@ -85,40 +86,30 @@ def _make_sweep(b, r, es_cap, er_cap, n_cap, s_cap, r_cap, kr_cap,
                     .reshape(r_cap, kr_cap)
                 )
 
-            def relax(dist):
-                def cls(k, acc):
-                    return jnp.minimum(
-                        acc,
-                        jnp.roll(dist + sw[k][None, :], deltas[k], axis=1),
-                    )
-                acc = jax.lax.fori_loop(0, s_cap, cls, dist)
-                if has_res:
-                    nd = dist[:, nbr_c]  # [R, rows, K]
-                    cand = (nd + rw[None]).min(axis=2)
-                    acc = acc.at[:, rows_c].min(cand)
-                return jnp.minimum(acc, dist)
+            residual = (rows_c, nbr_c, rw) if has_res else None
+            relax = relax_ops.make_relax(
+                deltas, s_cap, lambda k: sw[k], residual=residual
+            )
 
             dist0 = jnp.full((r, n_cap), INF_E, jnp.int32)
             dist0 = dist0.at[
                 jnp.arange(r), jnp.clip(roots, 0, n_cap - 1)
             ].set(0)
 
-            def body(state):
-                dist, _, t = state
-                new = dist
-                for _ in range(_UNROLL):
-                    new = relax(new)
-                return new, jnp.any(new != dist), t + 1
+            if kernel == "bucketed":
+                dist, trips, rounds = relax_ops.run_bucketed(
+                    relax, dist0, deltas, sw, lambda k: sw[k],
+                    n_cap, s_cap, delta_exp,
+                )
+            else:
+                dist, trips, rounds = relax_ops.run_sync(
+                    relax, dist0, max_trips
+                )
+            return dist, trips, rounds
 
-            def cond(state):
-                return state[1] & (state[2] < max_trips)
-
-            dist, _, trips = jax.lax.while_loop(
-                cond, body, (dist0, jnp.bool_(True), jnp.int32(0))
-            )
-            return dist, trips
-
-        dist_all, trips_all = jax.vmap(one)(sh_idx, sh_val, rs_idx, rs_val)
+        dist_all, trips_all, rounds_all = jax.vmap(one)(
+            sh_idx, sh_val, rs_idx, rs_val
+        )
         # lane 0 is the identity overlay: the baseline every other lane
         # is judged against. `valid` masks pad columns and nodes the
         # baseline itself cannot reach — a failure is only charged for
@@ -129,16 +120,21 @@ def _make_sweep(b, r, es_cap, er_cap, n_cap, s_cap, r_cap, kr_cap,
         reach = valid[None] & (dist_all < INF_E)
         stretch = jnp.where(reach, dist_all - base[None], 0).max(axis=(1, 2))
         changed = (valid[None] & (dist_all != base[None])).sum(axis=(1, 2))
+        # rounds rides LAST so whatif.collect's fixed [:4] / [4] parses
+        # stay valid whether or not the dist plane is pulled
         if return_dist:
-            return unreachable, stretch, changed, trips_all.max(), dist_all
-        return unreachable, stretch, changed, trips_all.max()
+            return (unreachable, stretch, changed, trips_all.max(),
+                    dist_all, rounds_all.max())
+        return (unreachable, stretch, changed, trips_all.max(),
+                rounds_all.max())
 
     return kernel
 
 
 @bounded_jit_cache(namespace="whatif")
 def sweep_batch(b, r, es_cap, er_cap, n_cap, s_cap, r_cap, kr_cap,
-                has_res, max_trips, return_dist):
+                has_res, max_trips, return_dist, kernel="sync",
+                delta_exp=0):
     """-> (kernel name, instrumented executable) for a sweep of `b`
     scenario lanes x `r` vantage roots over an [n_cap] mirror. Each lane
     carries es_cap shift-slot and er_cap residual-slot overrides (flat
@@ -147,12 +143,13 @@ def sweep_batch(b, r, es_cap, er_cap, n_cap, s_cap, r_cap, kr_cap,
 
     kern = _make_sweep(
         b, r, es_cap, er_cap, n_cap, s_cap, r_cap, kr_cap,
-        has_res, max_trips, return_dist,
+        has_res, max_trips, return_dist, kernel, delta_exp,
     )
     name = (
         f"sweep[b={b},r={r},n={n_cap},s={s_cap}"
         + (",res" if has_res else "")
         + (",dist" if return_dist else "")
+        + (f",bk{delta_exp}" if kernel == "bucketed" else "")
         + "]"
     )
     return name, instrument_jit(name, jax.jit(kern))

@@ -42,8 +42,10 @@ from __future__ import annotations
 
 import numpy as np
 
+from openr_tpu.ops import relax as relax_ops
 from openr_tpu.ops.edgeplan import INF32E, MAX_METRIC, natural_key
 from openr_tpu.ops.xla_cache import bounded_jit_cache
+from openr_tpu.runtime.counters import counters
 
 INF_E = int(INF32E)
 
@@ -93,19 +95,19 @@ def _ucmp_fn(e_cap: int, n_cap: int, use_prefix_weight: bool):
         # membership predicate in both directions) terminates instead of
         # oscillating forever — the non-convergence then surfaces as
         # overflow=True and the caller falls back to the exact host walk
-        bound = jnp.int32(n_cap + 2)
+        bound = jnp.int32(relax_ops.fixpoint_bound(n_cap))
 
         def cond(state):
             return state[0] & (state[4] < bound)
 
-        changed, reach, w, wf, _ = jax.lax.while_loop(
+        changed, reach, w, wf, rounds = jax.lax.while_loop(
             cond, body, (jnp.bool_(True), leaf_mask, w0, wf0, jnp.int32(0))
         )
         # float shadow saturates instead of wrapping: any node beyond
         # 2^30 means the int32 field may have overflowed. `changed` still
         # True at exit means the bound fired before the fixpoint.
         overflow = jnp.any(wf > jnp.float32(1 << 30)) | changed
-        return reach, w, overflow
+        return reach, w, overflow, rounds
 
     return jax.jit(f)
 
@@ -206,8 +208,12 @@ def propagate(edges: UcmpEdges, d_dist, leaf_weights: dict[str, int],
             leaf_mask[i] = True
             leaf_w[i] = weight
     fn = _ucmp_fn(edges.e_cap, edges.n_cap, bool(use_prefix_weight))
-    reach, w, overflow = fn(
+    reach, w, overflow, rounds = fn(
         edges.d_src, edges.d_dst, edges.d_w_eff, edges.d_adj_w,
         d_dist, jax.device_put(leaf_mask), jax.device_put(leaf_w),
     )
+    # same round ledger as every other device fixpoint: executed DAG
+    # propagation rounds feed decision.device.rounds alongside the SSSP
+    # relaxations
+    counters.add_stat_value("decision.device.rounds", int(rounds))
     return np.asarray(reach), np.asarray(w), bool(overflow)

@@ -32,10 +32,11 @@ from typing import Optional
 
 import numpy as np
 
+from openr_tpu.ops import relax as relax_ops
 from openr_tpu.ops.edgeplan import INF32E
 
 INF_E = int(INF32E)
-_UNROLL = 8
+_UNROLL = relax_ops.UNROLL
 
 
 def make_mesh(n_devices: Optional[int] = None, batch: Optional[int] = None):
@@ -116,26 +117,20 @@ def _sharded_fabric_fn(mesh, n_cap: int, s_cap: int, r_cap: int,
             nbr_c = jnp.clip(res_nbr, 0, n_cap - 1)
             rows_c = jnp.clip(res_rows, 0, n_cap - 1)
 
-            def relax(dist):
-                # local sources' contribution over the full-width field
-                pc = jnp.full_like(dist, INF_E)
-                def cls(k, pc):
-                    w_full = jax.lax.dynamic_update_slice(
-                        jnp.full((n_cap,), INF_E, jnp.int32),
-                        sw[k],
-                        (my_col0,),
-                    )
-                    return jnp.minimum(
-                        pc, jnp.roll(dist + w_full[None, :], deltas[k], axis=1)
-                    )
-                pc = jax.lax.fori_loop(0, s_cap, cls, pc)
-                if has_res:
-                    nd = dist[:, nbr_c]
-                    cand = (nd + rw[None]).min(axis=2)
-                    pc = pc.at[:, rows_c].min(cand)
-                # halo exchange: combine shards' candidates
-                pc = jax.lax.pmin(pc, "graph")
-                return jnp.minimum(dist, pc)
+            # local sources' contribution over the full-width field
+            # (ops/relax.py owns the relaxation body); the pmin combine
+            # is the per-relaxation halo exchange
+            def w_of(k):
+                return jax.lax.dynamic_update_slice(
+                    jnp.full((n_cap,), INF_E, jnp.int32), sw[k],
+                    (my_col0,),
+                )
+
+            relax = relax_ops.make_relax(
+                deltas, s_cap, w_of,
+                residual=(rows_c, nbr_c, rw) if has_res else None,
+                combine=lambda pc: jax.lax.pmin(pc, "graph"),
+            )
 
             def body(i, dist):
                 for _ in range(_UNROLL):
@@ -306,7 +301,8 @@ def _shard_map():
 
 
 def make_mc_sssp(mesh, s_cap: int, has_res: bool, n_cap: int,
-                 d_cap: int, max_trips: int):
+                 d_cap: int, max_trips: int,
+                 kernel: str = "sync", delta_exp: int = 0):
     """shard_mapped twin of tpu_solver._plan_sssp for the production
     multichip capacity tier: batched SSSP from the root's out-neighbor
     seeds with shift_w's node columns sharded over 'graph' and the
@@ -335,10 +331,22 @@ def make_mc_sssp(mesh, s_cap: int, has_res: bool, n_cap: int,
     are disjoint. Requires n_cap % graph == 0 and d_cap % batch == 0
     (the solver pads both).
 
+    With ``kernel="bucketed"`` the round loop swaps for ops/relax.py's
+    Δ-stepping epochs: each shard ladders its own most-light-populous
+    LOCAL classes collective-free (shards may pick different classes —
+    local acceleration only), then the epoch handoff relaxation's FULL
+    combined plane takes ONE lax.pmin over 'graph'. The halo exchange
+    moves from per-relaxation to per-EPOCH — the round-proportional
+    1M-scale traffic reduction. Epoch exit still certifies the global
+    fixpoint: the post-pmin plane equalling the (group-uniform) epoch
+    input forces every shard's partial candidates to be dominated, so
+    the union — the full relaxation — is too.
+
     Returns a callable (deltas, shift_w, res_rows, res_nbr, res_w,
     root, root_nbr, root_w) -> (dist [D, N] sharded P('batch', None),
-    trips [batch] per-group trip counts). Compose it inside a jit —
-    it is not jitted here."""
+    trips [batch] per-group trip counts (bucket epochs under the
+    bucketed kernel), rounds [batch] executed relaxation passes).
+    Compose it inside a jit — it is not jitted here."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -368,41 +376,33 @@ def make_mc_sssp(mesh, s_cap: int, has_res: bool, n_cap: int,
             jnp.where(valid, 0, INF_E).astype(jnp.int32)
         )
 
-        def relax(dist):
-            pc = jnp.full_like(dist, INF_E)
+        def w_of(k):
+            return jax.lax.dynamic_update_slice(
+                jnp.full((n_cap,), INF_E, jnp.int32), sw[k],
+                (my_col0,),
+            )
 
-            def cls(k, pc):
-                w_full = jax.lax.dynamic_update_slice(
-                    jnp.full((n_cap,), INF_E, jnp.int32), sw[k],
-                    (my_col0,),
-                )
-                return jnp.minimum(
-                    pc,
-                    jnp.roll(dist + w_full[None, :], deltas[k], axis=1),
-                )
-
-            pc = jax.lax.fori_loop(0, s_cap, cls, pc)
-            if has_res:
-                nd = dist[:, nbr_c]
-                cand = (nd + rw[None]).min(axis=2)
-                pc = pc.at[:, rows_c].min(cand)
-            pc = jax.lax.pmin(pc, "graph")
-            return jnp.minimum(dist, pc)
-
-        def body(state):
-            dist, _, t = state
-            new = dist
-            for _ in range(_UNROLL):
-                new = relax(new)
-            return new, jnp.any(new != dist), t + 1
-
-        def cond(state):
-            return state[1] & (state[2] < max_trips)
-
-        dist, _, trips = jax.lax.while_loop(
-            cond, body, (dist0, jnp.bool_(True), jnp.int32(0))
-        )
-        return dist, trips[None]
+        residual = (rows_c, nbr_c, rw) if has_res else None
+        if kernel == "bucketed":
+            # collective-free ladder per shard; ONE pmin per bucket
+            # epoch on the full combined plane re-unifies the group
+            relax_local = relax_ops.make_relax(
+                deltas, s_cap, w_of, residual=residual
+            )
+            dist, trips, rounds = relax_ops.run_bucketed(
+                relax_local, dist0, deltas, sw, w_of,
+                n_cap, s_cap, delta_exp,
+                plane_combine=lambda d: jax.lax.pmin(d, "graph"),
+            )
+        else:
+            relax = relax_ops.make_relax(
+                deltas, s_cap, w_of, residual=residual,
+                combine=lambda pc: jax.lax.pmin(pc, "graph"),
+            )
+            dist, trips, rounds = relax_ops.run_sync(
+                relax, dist0, max_trips
+            )
+        return dist, trips[None], rounds[None]
 
     shard_map, check_kw = _shard_map()
     return shard_map(
@@ -416,13 +416,14 @@ def make_mc_sssp(mesh, s_cap: int, has_res: bool, n_cap: int,
             P("batch"),          # root_nbr (vantage lanes)
             P("batch"),          # root_w
         ),
-        out_specs=(P("batch", None), P("batch")),
+        out_specs=(P("batch", None), P("batch"), P("batch")),
         **check_kw,
     )
 
 
 def make_mc_incremental_sssp(mesh, s_cap: int, has_res: bool,
-                             n_cap: int, d_cap: int, max_trips: int):
+                             n_cap: int, d_cap: int, max_trips: int,
+                             kernel: str = "sync", delta_exp: int = 0):
     """shard_mapped twin of ops/incremental.incremental_sssp for the
     multichip tier. Same layout contract as make_mc_sssp (shift
     columns over 'graph', vantage lanes over 'batch', residual
@@ -450,7 +451,9 @@ def make_mc_incremental_sssp(mesh, s_cap: int, has_res: bool,
 
     Returns a callable (...incremental_sssp args...) ->
     (dist [D, N] P('batch', None), trips [batch], cone [1],
-    fell_back [1])."""
+    fell_back [1], rounds [batch]). The final re-relaxation consumes
+    ops/relax.py like make_mc_sssp — under the bucketed kernel its
+    halo exchange likewise drops to one pmin per bucket epoch."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -644,41 +647,31 @@ def make_mc_incremental_sssp(mesh, s_cap: int, has_res: bool,
         cold = cold.at[lanes, seed_idx].min(pin)
         dist0 = jnp.where(fell_back, cold, warm)
 
-        def relax(dist):
-            pc = jnp.full_like(dist, INF_E)
+        def w_of(k):
+            return jax.lax.dynamic_update_slice(
+                jnp.full((n_cap,), INF_E, jnp.int32), swm_new[k],
+                (my_col0,),
+            )
 
-            def cls(k, pc):
-                w_full = jax.lax.dynamic_update_slice(
-                    jnp.full((n_cap,), INF_E, jnp.int32), swm_new[k],
-                    (my_col0,),
-                )
-                return jnp.minimum(
-                    pc,
-                    jnp.roll(dist + w_full[None, :], deltas[k], axis=1),
-                )
-
-            pc = jax.lax.fori_loop(0, s_cap, cls, pc)
-            if has_res:
-                nd = dist[:, nbr_c]
-                cand = (nd + rwm_new[None]).min(axis=2)
-                pc = pc.at[:, rows_c].min(cand)
-            pc = jax.lax.pmin(pc, "graph")
-            return jnp.minimum(dist, pc)
-
-        def body(state):
-            dist, _, t = state
-            new = dist
-            for _ in range(_UNROLL):
-                new = relax(new)
-            return new, jnp.any(new != dist), t + 1
-
-        def cond(state):
-            return state[1] & (state[2] < max_trips)
-
-        dist, _, trips = jax.lax.while_loop(
-            cond, body, (dist0, jnp.bool_(True), jnp.int32(0))
-        )
-        return dist, trips[None], cone[None], fell_back[None]
+        residual = (rows_c, nbr_c, rwm_new) if has_res else None
+        if kernel == "bucketed":
+            relax_local = relax_ops.make_relax(
+                deltas, s_cap, w_of, residual=residual
+            )
+            dist, trips, rounds = relax_ops.run_bucketed(
+                relax_local, dist0, deltas, swm_new, w_of,
+                n_cap, s_cap, delta_exp,
+                plane_combine=lambda d: jax.lax.pmin(d, "graph"),
+            )
+        else:
+            relax = relax_ops.make_relax(
+                deltas, s_cap, w_of, residual=residual,
+                combine=lambda pc: jax.lax.pmin(pc, "graph"),
+            )
+            dist, trips, rounds = relax_ops.run_sync(
+                relax, dist0, max_trips
+            )
+        return dist, trips[None], cone[None], fell_back[None], rounds[None]
 
     shard_map, check_kw = _shard_map()
     return shard_map(
@@ -696,7 +689,7 @@ def make_mc_incremental_sssp(mesh, s_cap: int, has_res: bool,
             P(),                 # cone_limit
         ),
         out_specs=(
-            P("batch", None), P("batch"), P(), P(),
+            P("batch", None), P("batch"), P(), P(), P("batch"),
         ),
         **check_kw,
     )

@@ -26,6 +26,13 @@ records a `prewarm[<namespace>:<nodes>]` entry (compile_ms) in the
 kernel ledger so `breeze tpu kernels` shows what the bake paid per
 workload class.
 
+Every bake compiles BOTH round-loop kernels (ops/relax.py): the
+default bucketed Δ-stepping executables (the synthetic grid derives
+the same pow2-quantized delta_exp capacity signature a production
+grid of the class does) and the spf_kernel=sync variant, so the
+restart an operator's first bisection step forces (docs/Operations.md)
+loads from cache instead of paying a fresh compile.
+
 Usage:
     openr-tpu-prewarm --nodes 1024 --nodes 100000 --lfa --ksp2
     openr-tpu-prewarm --nodes 50000 --cache-dir /var/cache/openr-xla
@@ -100,11 +107,12 @@ def prewarm_incr(nodes: int, verbose: bool = True) -> float:
     from openr_tpu.decision.tpu_solver import TpuSpfSolver
 
     side, adj_dbs, states, ps, me = _grid_inputs(nodes)
-    solver = TpuSpfSolver(me, incremental_spf=True)
     t0 = time.perf_counter()
-    solver.build_route_db(me, states, ps)  # cold seed
-    _flap_one(states, adj_dbs)
-    solver.build_route_db(me, states, ps)  # incr-namespace compile
+    for kern, metric in (("bucketed", 55), ("sync", 56)):
+        solver = TpuSpfSolver(me, incremental_spf=True, spf_kernel=kern)
+        solver.build_route_db(me, states, ps)  # cold seed
+        _flap_one(states, adj_dbs, metric=metric)
+        solver.build_route_db(me, states, ps)  # incr-namespace compile
     dt = time.perf_counter() - t0
     _record_prewarm("incr", side * side, dt)
     if verbose:
@@ -132,9 +140,12 @@ def prewarm_multichip(nodes: int, verbose: bool = True) -> float:
             )
         return 0.0
     side, adj_dbs, states, ps, me = _grid_inputs(nodes)
-    solver = TpuSpfSolver(me, multichip_n_cap_threshold=1)
     t0 = time.perf_counter()
-    solver.build_route_db(me, states, ps)
+    for kern in ("bucketed", "sync"):
+        solver = TpuSpfSolver(
+            me, multichip_n_cap_threshold=1, spf_kernel=kern
+        )
+        solver.build_route_db(me, states, ps)
     dt = time.perf_counter() - t0
     _record_prewarm("multichip", side * side, dt)
     if verbose:
@@ -153,10 +164,11 @@ def prewarm_whatif(nodes: int, verbose: bool = True) -> float:
     from openr_tpu.decision.whatif import WhatIfEngine
 
     side, adj_dbs, states, ps, me = _grid_inputs(nodes)
-    solver = TpuSpfSolver(me)
     t0 = time.perf_counter()
-    solver.build_route_db(me, states, ps)
-    WhatIfEngine(solver).sweep(states, ps, order=1, max_scenarios=8)
+    for kern in ("bucketed", "sync"):
+        solver = TpuSpfSolver(me, spf_kernel=kern)
+        solver.build_route_db(me, states, ps)
+        WhatIfEngine(solver).sweep(states, ps, order=1, max_scenarios=8)
     dt = time.perf_counter() - t0
     _record_prewarm("whatif", side * side, dt)
     if verbose:
@@ -202,9 +214,10 @@ def prewarm_class(
         ]
     states, ps = topologies.build_states(adj_dbs, prefix_dbs)
     me = adj_dbs[len(adj_dbs) // 2].this_node_name
-    solver = TpuSpfSolver(me, enable_lfa=enable_lfa)
     t0 = time.perf_counter()
-    solver.build_route_db(me, states, ps)
+    for kern in ("bucketed", "sync"):
+        solver = TpuSpfSolver(me, enable_lfa=enable_lfa, spf_kernel=kern)
+        solver.build_route_db(me, states, ps)
     dt = time.perf_counter() - t0
     variant = "default"
     if enable_lfa:

@@ -76,6 +76,31 @@ def test_bench_config_smoke_device_path():
     assert res["flightrec_overhead_pct"] <= 1.0, res
 
 
+def test_bench_kernel_ab_lane_bucketed_engages_and_rounds_decrease():
+    """ISSUE 13 tier-1 gate: the kernel A/B lane must show the bucketed
+    Δ-stepping kernel (ops/relax.py) actually engaging (every churn
+    solve reports spf_kernel=bucketed) and doing strictly fewer
+    relaxation rounds than the synchronous kernel on the same flap
+    sequence — the round reduction is the whole perf claim."""
+    from bench import bench_config
+    from openr_tpu.models import topologies
+
+    res, _, _ = bench_config(
+        "smoke-ab",
+        lambda: topologies.grid(6, node_labels=False),
+        "node-3-3",
+        runs=2,
+        flap_victims=2,
+    )
+    ab = res["kernel_ab"]
+    assert ab["bucketed"]["engaged"] == 2, ab
+    assert ab["sync"]["engaged"] == 0, ab
+    assert ab["bucketed"]["bucket_epochs"] > 0, ab
+    assert ab["sync"]["bucket_epochs"] == 0, ab
+    assert ab["sync"]["rounds"] > 0, ab
+    assert ab["rounds_decreased"] is True, ab
+
+
 def test_bench_incremental_lane_single_flap_counters():
     """ISSUE 7 tier-1 smoke: a single-metric-flap churn sequence takes
     the incremental path (decision.solver.incr.solves advances) with
@@ -134,6 +159,14 @@ def test_bench_multichip_engages_above_threshold_only():
     assert len(res_on["multichip"]["shard_ms"]) == 8, res_on
     assert res_on["bytes_uploaded"] >= 0, res_on
     assert e1 > e0, (e0, e1)
+    # ISSUE 13: in the multichip tier the bucketed kernel moves the
+    # pmin halo exchange to the bucket-epoch boundary — the A/B lane
+    # must report strictly fewer halo exchanges than sync-per-round
+    ab = res_on["kernel_ab"]
+    assert ab["sync"]["halo_exchanges"] > 0, ab
+    assert ab["bucketed"]["halo_exchanges"] > 0, ab
+    assert ab["halo_decreased"] is True, ab
+    assert ab["rounds_decreased"] is True, ab
 
     res_off, _, _ = bench_config(
         "smoke-mc-off",

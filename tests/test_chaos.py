@@ -558,6 +558,119 @@ class TestMultichipSolverFailoverDrill:
             await stop_all(nodes)
 
 
+class TestBucketedKernelFailoverDrill:
+    @run_async
+    async def test_fault_during_bucketed_solve_fails_over(self):
+        """Δ-stepping drill: a 10-ring is the smallest live topology
+        whose plan forms shift classes (build_plan's usefulness floor
+        is 8 edges per delta), so the bucketed kernel actually engages
+        (delta_exp > 0) instead of silently falling back to sync. An
+        armed solver.exec fault lands on a bucketed solve mid-churn:
+        the failover must carry the event to the CPU oracle with NO
+        stale-route window — the fib lands directly on the post-churn
+        ECMP set — and after the device heals, churn runs bucketed
+        epochs again (the decision.device.bucket_epochs stat advances
+        post-heal)."""
+        registry.clear()
+        counters.set_counter("decision.solver.degraded", 0)
+        n = 10
+        names = [f"node-{i}" for i in range(n)]
+        links = [
+            (
+                f"node-{i}", f"if-{i}{(i + 1) % n}",
+                f"node-{(i + 1) % n}", f"if-{(i + 1) % n}{i}",
+            )
+            for i in range(n)
+        ]
+
+        def epoch_count():
+            return (
+                counters.get_counters("decision.device.bucket_epochs")
+                .get("decision.device.bucket_epochs.count.60", 0)
+            )
+
+        mesh, nodes = await start_mesh(
+            names,
+            links,
+            solver_backend="tpu",
+            decision_config=DecisionConfig(
+                debounce_min_ms=5,
+                debounce_max_ms=25,
+                spf_kernel="bucketed",
+                solver_probe_initial_backoff_s=0.2,
+                solver_probe_max_backoff_s=0.5,
+            ),
+        )
+        try:
+            for i, nm in enumerate(names):
+                nodes[nm].advertise_prefix(loopback(i))
+
+            def nh_set(pfx):
+                entry = nodes["node-0"].fib_routes.get(pfx)
+                if entry is None:
+                    return set()
+                return {nh.neighbor_node_name for nh in entry.nexthops}
+
+            # node-5 is diametrically opposite node-0: 5 hops either
+            # way around the ring -> ECMP over both ring neighbors
+            await wait_until(
+                lambda: nh_set(loopback(5)) == {"node-1", "node-9"},
+                timeout_s=CONVERGENCE_S,
+            )
+            # the drill is meaningless unless the Δ-stepping kernel is
+            # actually live: the convergence solves ran bucket epochs
+            assert epoch_count() > 0, "bucketed kernel never engaged"
+
+            # churn away from node-0's root links: cutting 4<->5 leaves
+            # only the counter-clockwise path
+            mesh.disconnect("node-4", "if-45", "node-5", "if-54")
+            await wait_until(
+                lambda: nh_set(loopback(5)) == {"node-9"},
+                timeout_s=CONVERGENCE_S,
+            )
+
+            # the device dies; the link comes back. The solve for this
+            # event would run bucketed epochs — the armed fault must
+            # push it to the CPU oracle, which lands the restored ECMP
+            # set directly (no window serving the stale single-path
+            # route)
+            failovers0 = _counter("decision.solver.failovers")
+            promotions0 = _counter("decision.solver.promotions")
+            registry.arm("solver.exec")
+            mesh.connect("node-4", "if-45", "node-5", "if-54")
+            await wait_until(
+                lambda: nh_set(loopback(5)) == {"node-1", "node-9"}
+                and _counter("decision.solver.degraded") == 1,
+                timeout_s=CONVERGENCE_S,
+            )
+            assert _counter("decision.solver.failovers") > failovers0
+
+            # heal: probes promote the device back and post-heal churn
+            # runs bucket epochs again
+            registry.clear("solver.exec")
+            await wait_until(
+                lambda: _counter("decision.solver.degraded") == 0
+                and _counter("decision.solver.promotions") > promotions0,
+                timeout_s=CONVERGENCE_S,
+            )
+            epochs0 = epoch_count()
+            mesh.disconnect("node-4", "if-45", "node-5", "if-54")
+            await wait_until(
+                lambda: nh_set(loopback(5)) == {"node-9"}
+                and epoch_count() > epochs0,
+                timeout_s=CONVERGENCE_S,
+            )
+            mesh.connect("node-4", "if-45", "node-5", "if-54")
+            await wait_until(
+                lambda: nh_set(loopback(5)) == {"node-1", "node-9"},
+                timeout_s=CONVERGENCE_S,
+            )
+        finally:
+            registry.clear()
+            counters.set_counter("decision.solver.degraded", 0)
+            await stop_all(nodes)
+
+
 class TestDecisionFiberCrashDrill:
     @run_async
     async def test_supervisor_restarts_crashed_ingest_fiber(self):

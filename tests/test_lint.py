@@ -324,6 +324,28 @@ def test_allowlist_keys_are_line_number_free(tmp_path):
     assert f1.key == f2.key
 
 
+def test_purity_traces_relax_kernel_roots():
+    """The shared round-loop module (ops/relax.py) is device code:
+    every loop body it hands to while_loop/fori_loop must be
+    discovered as a traced root by the purity walker (regression
+    guard: the ops/ module prefix covers the kernel extraction), and
+    the shipped kernels must run clean."""
+    project = Project(REPO_ROOT, ["openr_tpu"])
+    sf = project.file("openr_tpu/ops/relax.py")
+    assert sf is not None
+    assert purity_check._is_traced_file(sf.rel)
+    g = purity_check._ModuleGraph(sf)
+    # make_relax's fori body, run_sync's trip loop, run_bucketed's
+    # ladder pass + rung loop + epoch loop all ride lax control flow
+    assert {
+        "cls", "body", "cond", "one", "lbody", "lcond", "ebody", "econd",
+    } <= g.traced
+    assert not [
+        f for f in purity_check.run(project)
+        if f.path == "openr_tpu/ops/relax.py"
+    ]
+
+
 # -- the repo itself runs clean --------------------------------------------
 
 def test_repo_lint_is_clean():

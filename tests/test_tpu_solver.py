@@ -603,10 +603,14 @@ def test_ucmp_device_fixpoint_bounded_on_zero_weight_cycle():
     leaf_w = np.zeros(n_cap, np.int32)
     leaf_w[2] = 3
     fn = _ucmp_fn(e_cap, n_cap, True)
-    _reach, _w, overflow = fn(
+    _reach, _w, overflow, rounds = fn(
         src, dst, w_eff, adj_w, dist, leaf_mask, leaf_w
     )
     assert bool(overflow)
+    # the bound fired: executed rounds == the shared fixpoint ledger
+    from openr_tpu.ops.relax import fixpoint_bound
+
+    assert int(rounds) == fixpoint_bound(n_cap)
 
 
 def test_prewarm_tool_bakes_cache(tmp_path):
