@@ -469,6 +469,96 @@ def bench_whatif(name, gen, me) -> dict:
     return res
 
 
+def _ledger_record(name: str, res: dict) -> None:
+    """Append one config's headline numbers to the perf ledger — no-op
+    unless $OPENR_TPU_PERF_LEDGER points somewhere, so bare bench runs
+    and tests stay disk-free. tools/perf_diff.py --ledger and the
+    baseline_drift SLO read these back as stored baselines."""
+    from openr_tpu.runtime import perf_ledger
+
+    lg = perf_ledger.get_ledger()
+    if not lg.enabled or not isinstance(res, dict):
+        return
+    sig = f"n{res['nodes']}" if res.get("nodes") else "bench"
+    obs = {
+        k: res[k]
+        for k in ("compile_ms", "full_ms", "device_ms", "tpu_ms",
+                  "exec_overhead_ms", "peak_hbm_mb", "cold_program_ms",
+                  "incr_device_ms", "boot_first_rib_ms")
+        if isinstance(res.get(k), (int, float))
+    }
+    if obs:
+        lg.record(f"solve[{name}]", obs, signature=sig, variant="default")
+    for variant, kr in (res.get("kernel_ab") or {}).items():
+        vo = {
+            k: v for k, v in (kr or {}).items()
+            if isinstance(v, (int, float))
+        }
+        if vo:
+            lg.record(f"solve[{name}]", vo, signature=sig, variant=variant)
+
+
+def bench_boot() -> dict:
+    """Cold-start lane (runtime/lifecycle.py): two full node stacks on a
+    MockIoMesh; measures begin() -> first programmed RIB on boot-0. An
+    in-process approximation of a daemon restart — the explicit setup
+    phases (config load, device init) belong to main.py, but the
+    pipeline phases (initial sync, first solve, first RIB delta, first
+    FIB program) and the boot.first_rib_ms headline run the real path."""
+    import asyncio
+    import os
+
+    from openr_tpu.kvstore.wrapper import wait_until
+    from openr_tpu.runtime.lifecycle import boot_tracer
+    from openr_tpu.runtime.openr_wrapper import OpenrWrapper
+    from openr_tpu.spark import MockIoMesh
+
+    async def _run() -> dict:
+        boot_tracer.reset()
+        boot_tracer.begin("boot-0")
+        mesh = MockIoMesh()
+        kv_ports: dict[str, int] = {}
+        nodes = {
+            n: OpenrWrapper(n, mesh.provider(n), kv_ports)
+            for n in ("boot-0", "boot-1")
+        }
+        mesh.connect("boot-0", "if-01", "boot-1", "if-10")
+        try:
+            await nodes["boot-0"].start("if-01")
+            await nodes["boot-1"].start("if-10")
+            nodes["boot-0"].advertise_prefix("10.99.0.1/32")
+            nodes["boot-1"].advertise_prefix("10.99.0.2/32")
+            await wait_until(
+                lambda: boot_tracer.report().get("complete"),
+                timeout_s=30.0,
+            )
+        finally:
+            for w in nodes.values():
+                await w.stop()
+        return boot_tracer.report()
+
+    report = asyncio.run(_run())
+    out_dir = os.environ.get("OPENR_TPU_BOOT_TRACE_OUT", "")
+    if out_dir:
+        from openr_tpu.runtime.tracing import tracer as _tracer
+
+        os.makedirs(out_dir, exist_ok=True)
+        with open(os.path.join(out_dir, "boot_report.json"), "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True, default=str)
+        with open(os.path.join(out_dir, "boot_trace.json"), "w") as f:
+            f.write(_tracer.export_chrome_json(limit=64))
+    res = {
+        "boot_first_rib_ms": report.get("first_rib_ms"),
+        "complete": bool(report.get("complete")),
+        "phases": {
+            p["name"]: p["duration_ms"] for p in report.get("phases", [])
+        },
+    }
+    log(f"[boot] first_rib {res['boot_first_rib_ms']} ms "
+        f"phases {sorted(res['phases'])}")
+    return res
+
+
 def main() -> None:
     quick = "--quick" in sys.argv
     only = None
@@ -483,6 +573,14 @@ def main() -> None:
     from openr_tpu.ops.xla_cache import enable_compilation_cache
 
     cache_dir = enable_compilation_cache()
+    # perf-baseline ledger: opt-in via env so bare runs stay disk-free
+    import os as _env_os
+
+    from openr_tpu.runtime import perf_ledger
+
+    if _env_os.environ.get(perf_ledger.ENV_DIR):
+        perf_ledger.configure(perf_ledger.default_dir())
+        log(f"perf-ledger: {perf_ledger.get_ledger().path}")
     log(f"devices: {jax.devices()}  xla-cache: {cache_dir}")
     # measure the rig's fixed device round trip (a pull of 8 bytes):
     # everything below pays it once per recompute
@@ -502,6 +600,7 @@ def main() -> None:
             return None
         r, tpu_ms, cpu_ms = bench_config(name, *args, **kw)
         configs[name] = r
+        _ledger_record(name, r)
         return r, tpu_ms, cpu_ms
 
     # 1: 4-node mesh — CPU parity baseline (example_openr.conf scale).
@@ -526,6 +625,12 @@ def main() -> None:
             "node-16-16",
         )
 
+    # cold-start lane: boot-to-first-RIB through the full node stack
+    # (skipped in --only runs that name another config)
+    if only in (None, "boot"):
+        configs["boot"] = bench_boot()
+        _ledger_record("boot", configs["boot"])
+
     if quick:
         if not configs:
             sys.exit(f"--only={only} matched no config")
@@ -533,10 +638,15 @@ def main() -> None:
         out = configs[name]
         print(json.dumps({
             "metric": f"full_rib_recompute_{name}_ms",
-            "value": out.get("tpu_ms", out.get("sweep_ms")),
+            "value": out.get(
+                "tpu_ms", out.get("sweep_ms", out.get("boot_first_rib_ms"))
+            ),
             "unit": "ms",
             "vs_baseline": out.get("speedup", 1.0),
             "rig_rtt_ms": round(rtt_ms, 1),
+            "boot_first_rib_ms": configs.get("boot", {}).get(
+                "boot_first_rib_ms"
+            ),
             "configs": configs,
         }))
         return
@@ -703,6 +813,12 @@ def main() -> None:
         # chip's amortized per-solve compute (chained dispatches, no
         # per-solve pull); on locally-attached TPU hosts (PCIe, ~us
         # round trips) e2e converges to device_ms + sync + mat.
+        # boot lifecycle headline (runtime/lifecycle.py): cold process
+        # to first programmed RIB through the full node stack — ROADMAP
+        # item 1's "under 2 s" gate reads this number
+        "boot_first_rib_ms": configs.get("boot", {}).get(
+            "boot_first_rib_ms"
+        ),
         "rtt_note": "e2e = device_ms + host sync/mat + rig RTT; RTT is the tunnel's, not the design's",
         "configs": configs,
     }))

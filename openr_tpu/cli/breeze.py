@@ -981,6 +981,17 @@ def monitor_slo(ctx) -> None:
     _print(_call(ctx, "ctrl.monitor.slo"))
 
 
+@monitor.command("boot")
+@click.pass_context
+def monitor_boot(ctx) -> None:
+    """Boot-to-first-RIB lifecycle: per-phase wall times (config load,
+    device init, jit-cache attach, prewarm, initial sync, first solve
+    with its compile/device/mat split, first RIB delta, first FIB
+    program) and the boot.first_rib_ms headline. The cold-start triage
+    entry point (docs/Operations.md)."""
+    _print(_call(ctx, "ctrl.monitor.boot"))
+
+
 @monitor.command("dump")
 @click.option("--reason", default="manual", help="trigger attribution "
               "recorded in the bundle")
@@ -1085,9 +1096,13 @@ def fault() -> None:
               help="disarm after this many fires (0 = unlimited)")
 @click.option("--seed", default=None, type=int,
               help="override the registry seed for this site")
+@click.option("--delay-ms", default=0.0, type=float,
+              help="latency fault: firings SLEEP this long instead of "
+              "raising (perf-regression drills)")
 @click.pass_context
 def fault_inject(
-    ctx, site, probability, every_nth, one_shot, window_s, max_fires, seed
+    ctx, site, probability, every_nth, one_shot, window_s, max_fires,
+    seed, delay_ms,
 ) -> None:
     """Arm SITE (e.g. solver.exec, kvstore.flood, rpc.send,
     fib.program, queue.push, decision.ingest). With no schedule options
@@ -1095,7 +1110,7 @@ def fault_inject(
     _print(_call(ctx, "ctrl.fault.inject", {
         "site": site, "probability": probability, "every_nth": every_nth,
         "one_shot": one_shot, "window_s": window_s, "max_fires": max_fires,
-        "seed": seed,
+        "seed": seed, "delay_ms": delay_ms,
     }))
 
 

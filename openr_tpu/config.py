@@ -282,9 +282,15 @@ class MonitorConfig:
     metrics_listen_addr: str = "127.0.0.1"
     # --- SLO engine (docs/Observability.md § SLO engine) ---
     # declarative SLO table: name -> spec dict. Spec keys: kind
-    # ("stat" | "counter_delta" | "gauge_duration"), source (counter /
-    # stat name), threshold, and optional per-SLO fast_window_s /
-    # slow_window_s / burn_threshold overrides. Each SLO runs a
+    # ("stat" | "counter_delta" | "gauge_duration" | "baseline_drift"),
+    # source (counter / stat name), threshold, and optional per-SLO
+    # fast_window_s / slow_window_s / burn_threshold overrides.
+    # baseline_drift compares the live window quantile of `source`
+    # against a perf-ledger baseline (threshold = max allowed ratio;
+    # extra keys: baseline_kernel / baseline_metric / baseline_signature
+    # / baseline_variant / quantile / min_count / warmup_s); it needs
+    # perf_ledger_dir set, and never breaches without a stored
+    # baseline. Each SLO runs a
     # multi-window burn-rate state machine in the Monitor metrics loop:
     # ok -> fast_burn when the fast window's breach fraction crosses
     # burn_threshold, -> sustained_burn when the slow window agrees,
@@ -327,6 +333,15 @@ class MonitorConfig:
     flight_recorder_ring: int = 32
     # auto-trigger rate limit: a flapping trigger must not fill the disk
     flight_recorder_min_interval_s: float = 30.0
+    # --- perf-baseline ledger (docs/Observability.md § Perf baselines) ---
+    # directory for the persistent perf ledger (runtime/perf_ledger.py):
+    # rolling per-kernel timing baselines the `baseline_drift` SLO kind
+    # compares live windows against. "" = disabled: no disk writes, no
+    # baselines, drift SLOs never breach.
+    perf_ledger_dir: str = ""
+    # how often the live Monitor appends a solve observation to the
+    # ledger (kernel "solve", signature/variant "live")
+    perf_ledger_record_interval_s: float = 60.0
 
 
 @dataclass
@@ -349,7 +364,8 @@ class FaultInjectionConfig:
     here apply from daemon startup; ctrl.fault.{inject,clear,list} and
     `breeze fault ...` arm/disarm at runtime. Each schedule dict takes
     the registry.arm() keywords: site (required), probability, every_nth,
-    one_shot, window_s, max_fires, seed."""
+    one_shot, window_s, max_fires, seed, delay_ms (latency fault: sleep
+    instead of raise)."""
 
     enable_fault_injection: bool = False
     seed: int = 0
@@ -722,7 +738,7 @@ class Config:
             raise ConfigError(
                 "monitor slo_fast_window_s must not exceed slo_slow_window_s"
             )
-        _SLO_KINDS = {"stat", "counter_delta", "gauge_duration"}
+        _SLO_KINDS = {"stat", "counter_delta", "gauge_duration", "baseline_drift"}
         for name, spec in (mc.slos or {}).items():
             if not isinstance(spec, dict):
                 raise ConfigError(f"monitor slos[{name!r}] must be a dict")
@@ -738,6 +754,10 @@ class Config:
                 raise ConfigError(f"monitor slos[{name!r}] needs a 'threshold'")
         if mc.flight_recorder_ring < 1:
             raise ConfigError("monitor flight_recorder_ring must be >= 1")
+        if mc.perf_ledger_record_interval_s <= 0:
+            raise ConfigError(
+                "monitor perf_ledger_record_interval_s must be positive"
+            )
         sr = cfg.segment_routing_config
         if sr.enable_segment_routing:
             lo, hi = sr.sr_node_label_range

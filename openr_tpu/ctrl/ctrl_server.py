@@ -86,6 +86,7 @@ class CtrlServer(Actor):
         s.register("ctrl.monitor.fleet", self._monitor_fleet)
         s.register("ctrl.monitor.crashes", self._monitor_crashes)
         s.register("ctrl.monitor.slo", self._monitor_slo)
+        s.register("ctrl.monitor.boot", self._monitor_boot)
         s.register("ctrl.monitor.dump", self._monitor_dump)
         # fault-injection registry (runtime/faults.py): arm / disarm /
         # inspect chaos drills on the live daemon
@@ -396,6 +397,14 @@ class CtrlServer(Actor):
             raise RuntimeError("no monitor wired to ctrl")
         return self.monitor.slo_report()
 
+    async def _monitor_boot(self) -> dict:
+        """Boot-to-first-RIB phase ledger (runtime/lifecycle.py). Unlike
+        the other monitor endpoints this reads the process-global boot
+        tracer — it answers even before/without a wired monitor."""
+        from openr_tpu.runtime.lifecycle import boot_tracer
+
+        return boot_tracer.report()
+
     async def _monitor_dump(self, reason: str = "manual") -> dict:
         """Operator-triggered flight-recorder bundle."""
         if self.monitor is None:
@@ -480,6 +489,7 @@ class CtrlServer(Actor):
         window_s: float = 0.0,
         max_fires: int = 0,
         seed: Optional[int] = None,
+        delay_ms: float = 0.0,
     ) -> dict:
         from openr_tpu.runtime.faults import registry
 
@@ -491,6 +501,7 @@ class CtrlServer(Actor):
             window_s=float(window_s),
             max_fires=int(max_fires),
             seed=seed if seed is None else int(seed),
+            delay_ms=float(delay_ms),
         )
 
     async def _fault_clear(self, site: Optional[str] = None) -> dict:

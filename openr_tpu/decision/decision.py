@@ -44,6 +44,7 @@ from openr_tpu.decision.spf_solver import SpfSolver
 from openr_tpu.messaging import RQueue, ReplicateQueue
 from openr_tpu.runtime.actor import Actor
 from openr_tpu.runtime.faults import maybe_fail
+from openr_tpu.runtime.lifecycle import boot_tracer
 from openr_tpu.serde import from_plain, to_plain
 from openr_tpu.runtime.counters import counters
 from openr_tpu.runtime.throttle import AsyncDebounce, ExponentialBackoff
@@ -607,6 +608,10 @@ class Decision(Actor):
         counters.set_counter("decision.solve_epoch", self._solve_epoch)
         self._stamp_provenance(update, pending, full)
 
+        if not self._first_build_done:
+            # boot lifecycle (runtime/lifecycle.py): the first solve's
+            # compile/device/mat split, then the first RIB delta push
+            self._stamp_boot_first_solve(build_ms)
         if not self._first_build_done or not update.empty():
             perf = pending.perf_events or PerfEvents()
             add_perf_event(perf, self.node_name, "ROUTE_UPDATE")
@@ -617,6 +622,12 @@ class Decision(Actor):
             tracer.end_trace(ctx, status="no_change")
         if not self._first_build_done:
             self._first_build_done = True
+            boot_tracer.phase_mark(
+                "first_rib_delta",
+                node=self.node_name,
+                routes=len(new_db.unicast_routes),
+                solve_epoch=self._solve_epoch,
+            )
             self._route_updates_q.push(InitializationEvent.RIB_COMPUTED)
 
     # -- route provenance (observatory) ------------------------------------
@@ -956,6 +967,45 @@ class Decision(Actor):
                     parent_id=spf_sp.span_id, area=area or None,
                 )
                 cursor -= d / 1e3
+
+    def _stamp_boot_first_solve(self, build_ms: float) -> None:
+        """Boot lifecycle: record the first full solve with its
+        compile-vs-device-vs-materialize split — the solver's
+        last_timing says what the device paid, the kernel ledger says
+        what XLA compilation paid (runtime/lifecycle.py)."""
+        if not boot_tracer.active(self.node_name):
+            return
+        attrs: dict = {"build_ms": round(build_ms, 3)}
+        tm = getattr(self.solver, "last_timing", None)
+        if isinstance(tm, dict):
+            areas = tm.get("areas") or {"": tm}
+            for stage, out in (
+                ("sync_ms", "sync_ms"),
+                ("exec_ms", "device_ms"),
+                ("mat_ms", "mat_ms"),
+            ):
+                total = sum(
+                    s.get(stage)
+                    for s in areas.values()
+                    if isinstance(s.get(stage), (int, float))
+                )
+                if total:
+                    attrs[out] = round(total, 3)
+            for key in ("spf_kernel", "rounds", "bucket_epochs",
+                        "bytes_uploaded", "multichip"):
+                if tm.get(key):
+                    attrs[key] = tm[key]
+        # deferred: ops pulls in the device toolchain (same pattern as
+        # the flight recorder)
+        from openr_tpu.ops.xla_cache import ledger as kernel_ledger
+
+        snap = kernel_ledger.snapshot()
+        if snap:
+            attrs["compile_ms"] = round(
+                sum(e["compile_ms"] or 0.0 for e in snap.values()), 3
+            )
+            attrs["kernels_compiled"] = len(snap)
+        boot_tracer.phase_mark("first_solve", node=self.node_name, **attrs)
 
     # -- module API (role of semifuture_* Decision.h:154-195) --------------
 

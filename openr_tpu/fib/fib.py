@@ -43,6 +43,7 @@ from openr_tpu.messaging import RQueue, ReplicateQueue
 from openr_tpu.runtime.actor import Actor
 from openr_tpu.runtime.counters import counters
 from openr_tpu.runtime.faults import maybe_fail
+from openr_tpu.runtime.lifecycle import boot_tracer
 from openr_tpu.runtime.throttle import ExponentialBackoff
 from openr_tpu.runtime.tracing import TraceContext, tracer
 from openr_tpu.types import (
@@ -394,6 +395,16 @@ class Fib(Actor):
         )
         if not self._synced_signalled:
             self._synced_signalled = True
+            # boot lifecycle: the first programmed RIB closes the boot
+            # span tree and stamps boot.first_rib_ms
+            boot_tracer.phase_mark(
+                "first_fib_program",
+                node=self.node_name,
+                routes=(
+                    len(unicast) if hasattr(unicast, "__len__") else None
+                ),
+            )
+            boot_tracer.complete(node=self.node_name)
             self._fib_updates_q.push(InitializationEvent.FIB_SYNCED)
 
     # -- dirty-route retry (ref retryRoutes Fib.cpp:345-430) ---------------

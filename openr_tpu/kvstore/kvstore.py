@@ -48,6 +48,7 @@ from openr_tpu.messaging import RQueue, ReplicateQueue
 from openr_tpu.runtime.actor import Actor
 from openr_tpu.runtime.counters import counters
 from openr_tpu.runtime.faults import maybe_fail
+from openr_tpu.runtime.lifecycle import boot_tracer
 from openr_tpu.runtime.rpc import RpcClient, RpcServer
 from openr_tpu.runtime.throttle import ExponentialBackoff
 from openr_tpu.runtime.tracing import tracer
@@ -908,6 +909,12 @@ class KvStore(Actor):
                 if p.state != KvStorePeerState.INITIALIZED:
                     return
         self._initialized_signalled = True
+        boot_tracer.phase_mark(
+            "kvstore_initial_sync",
+            node=self.node_name,
+            areas=len(self.areas),
+            peers=sum(len(st.peers) for st in self.areas.values()),
+        )
         self._updates_q.push(InitializationEvent.KVSTORE_SYNCED)
 
     # -- self-originated keys (ref KvStore.h:304-309) ----------------------

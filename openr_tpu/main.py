@@ -23,9 +23,11 @@ import asyncio
 import logging
 import signal
 import sys
+import time
 
 from openr_tpu.config import Config
 from openr_tpu.prefix_manager import OriginatedPrefix
+from openr_tpu.runtime.lifecycle import boot_tracer
 from openr_tpu.runtime.monitor import Monitor, Watchdog
 from openr_tpu.runtime.openr_wrapper import OpenrWrapper
 from openr_tpu.runtime.persistent_store import PersistentStore
@@ -89,9 +91,15 @@ def _build_policy_manager(oc):
 
 
 async def run_daemon(args) -> None:
+    # boot lifecycle (runtime/lifecycle.py): t0 is taken BEFORE config
+    # load and backdated into begin() once the node name is known, so
+    # the span tree covers the whole cold start
+    t_boot = time.monotonic()
     cfg = Config.from_file(args.config)
     oc = cfg.raw
     node_name = oc.node_name
+    boot_tracer.begin(node_name, start=t_boot)
+    boot_tracer.phase_mark("config_load", node=node_name, path=args.config)
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
@@ -109,6 +117,49 @@ async def run_daemon(args) -> None:
     from openr_tpu.runtime.faults import registry as fault_registry
 
     fault_registry.configure(oc.fault_injection_config)
+
+    # -- device plane: backend init + persistent jit cache (boot phases) --
+    backend = oc.decision_config.solver_backend
+    if backend != "cpu":
+        with boot_tracer.phase(
+            "device_init", node=node_name, backend=backend
+        ) as ph:
+            try:
+                import jax
+
+                ph["platform"] = jax.default_backend()
+                ph["devices"] = jax.device_count()
+            # lint: allow(broad-except) cpu fallback boots without jax
+            except Exception as e:
+                ph["error"] = str(e)
+        with boot_tracer.phase("jit_cache_attach", node=node_name) as ph:
+            from openr_tpu.ops.xla_cache import enable_compilation_cache
+
+            # same resolution the solver applies later (idempotent) —
+            # attaching here folds the cache-load cost into its own
+            # boot phase instead of the first solve's
+            ph["cache_dir"] = enable_compilation_cache(
+                oc.decision_config.xla_cache_dir or None
+            )
+    else:
+        boot_tracer.phase_mark(
+            "device_init", node=node_name, backend=backend, skipped=True
+        )
+        boot_tracer.phase_mark("jit_cache_attach", node=node_name, skipped=True)
+
+    # prewarm happens offline (tools/prewarm.py); the phase attributes
+    # what the bake paid per the perf ledger so the boot report shows
+    # whether this start benefits from baked executables
+    from openr_tpu.runtime.perf_ledger import configure as configure_perf_ledger
+
+    _perf_ledger = configure_perf_ledger(oc.monitor_config.perf_ledger_dir)
+    _pw = _perf_ledger.prewarm_summary()
+    boot_tracer.phase_mark(
+        "prewarm",
+        node=node_name,
+        baked_ms=_pw["baked_ms"] or None,
+        namespaces=len(_pw["namespaces"]) or None,
+    )
 
     # -- persistent store (ref config-store start, Main.cpp:340) ----------
     store = (

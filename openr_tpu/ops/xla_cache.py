@@ -258,6 +258,13 @@ class KernelLedger:
             }
         if compile_ms is not None:
             counters.add_stat_value("xla_cache.compile_ms", compile_ms)
+            # perf observatory: compile times become per-kernel baselines
+            # (no-op unless a perf-ledger dir is configured)
+            from openr_tpu.runtime.perf_ledger import get_ledger
+
+            get_ledger().record(
+                name, {"compile_ms": compile_ms}, variant="compile"
+            )
         counters.increment("xla_cache.kernels_recorded")
 
     def bump_calls(self, name: str) -> None:

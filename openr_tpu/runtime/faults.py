@@ -16,6 +16,11 @@ Arming a site attaches a schedule:
   - one_shot     fire on the first check, then disarm
   - window_s     schedule stays armed for this long after arming
   - max_fires    disarm after this many firings
+  - delay_ms     instead of raising, a firing SLEEPS this long and
+    returns — a latency fault, not a loss fault. Used by the perf
+    drills: routing keeps converging while the site's wall-clock
+    inflates, which is exactly the regression shape the
+    ``baseline_drift`` SLO must catch
 
 Schedules come from ``config.py`` (fault_injection_config, armed at daemon
 startup) or at runtime via the ``ctrl.fault.{inject,clear,list}`` endpoints
@@ -66,6 +71,7 @@ class FaultSchedule:
         window_s: float = 0.0,
         max_fires: int = 0,
         seed: int = 0,
+        delay_ms: float = 0.0,
     ):
         self.site = site
         self.probability = probability
@@ -73,6 +79,7 @@ class FaultSchedule:
         self.window_s = window_s
         self.max_fires = max_fires
         self.seed = seed
+        self.delay_ms = delay_ms
         self.checks = 0
         self.fires = 0
         self.armed_at = time.monotonic()
@@ -88,6 +95,7 @@ class FaultSchedule:
             "window_s": self.window_s,
             "max_fires": self.max_fires,
             "seed": self.seed,
+            "delay_ms": self.delay_ms,
             "checks": self.checks,
             "fires": self.fires,
         }
@@ -125,6 +133,7 @@ class FaultRegistry:
         window_s: float = 0.0,
         max_fires: int = 0,
         seed: Optional[int] = None,
+        delay_ms: float = 0.0,
     ) -> dict:
         if not site:
             raise ValueError("fault site name must be non-empty")
@@ -133,6 +142,8 @@ class FaultRegistry:
             raise ValueError(f"probability {probability} not in [0, 1]")
         if int(every_nth) < 0 or int(max_fires) < 0 or float(window_s) < 0:
             raise ValueError("every_nth/max_fires/window_s must be >= 0")
+        if float(delay_ms) < 0:
+            raise ValueError("delay_ms must be >= 0")
         if one_shot:
             max_fires = 1
         self._armed[site] = FaultSchedule(
@@ -142,6 +153,7 @@ class FaultRegistry:
             window_s=float(window_s),
             max_fires=int(max_fires),
             seed=self.seed if seed is None else int(seed),
+            delay_ms=float(delay_ms),
         )
         counters.increment("runtime.fault.armed")
         return self._armed[site].describe()
@@ -193,6 +205,11 @@ class FaultRegistry:
             self._armed.pop(s.site, None)
         if span is not None and hasattr(span, "attributes"):
             span.attributes["fault_injected"] = s.site
+        if s.delay_ms > 0.0:
+            # latency fault: the site succeeds, just slower
+            counters.increment(f"runtime.fault.{s.site}.delayed")
+            time.sleep(s.delay_ms / 1e3)
+            return
         raise FaultInjected(s.site)
 
 

@@ -31,6 +31,7 @@ from openr_tpu.messaging import ReplicateQueue, RQueue
 from openr_tpu.runtime import device_stats
 from openr_tpu.runtime.actor import Actor
 from openr_tpu.runtime.counters import counters
+from openr_tpu.runtime.perf_ledger import configure as configure_perf_ledger
 from openr_tpu.runtime.tracing import tracer
 
 log = logging.getLogger(__name__)
@@ -96,6 +97,8 @@ class _SloTrack:
         "last_transition_ms",
         "_gauge_since",
         "_prev_counter",
+        "baseline",
+        "live",
     )
 
     def __init__(self, name: str, spec: dict):
@@ -112,6 +115,10 @@ class _SloTrack:
         self.last_transition_ms = 0
         self._gauge_since: Optional[float] = None
         self._prev_counter: Optional[float] = None
+        # baseline_drift bookkeeping: the ledger quantile and the live
+        # window quantile behind the last measured ratio
+        self.baseline: Optional[float] = None
+        self.live = 0.0
 
 
 class SloEngine:
@@ -135,11 +142,19 @@ class SloEngine:
                        tick > threshold (threshold 0 = any increase)
       gauge_duration — gauge continuously nonzero for ≥ threshold
                        seconds
+      baseline_drift — live window quantile of `source` divided by the
+                       perf-ledger baseline quantile (threshold = max
+                       allowed ratio, e.g. 1.5). Never breaches without
+                       a stored baseline, with fewer than `min_count`
+                       live samples in the window, or inside the
+                       `warmup_s` cold-start exclusion (a restarting
+                       node's compile-heavy first solves are not drift)
     """
 
     def __init__(self, node_name: str, cfg: MonitorConfig):
         self.node_name = node_name
         self.cfg = cfg
+        self._started = time.monotonic()
         self._tracks = {
             name: _SloTrack(name, dict(spec))
             for name, spec in (cfg.slos or {}).items()
@@ -164,6 +179,35 @@ class SloEngine:
             agg = next(iter(win.values()), {})
             value = float(agg.get(spec.get("quantile", "p99"), 0.0))
             return value, bool(agg.get("count", 0)) and value > threshold
+        if kind == "baseline_drift":
+            from openr_tpu.runtime.perf_ledger import get_ledger
+
+            fast_s, _ = self._windows(spec)
+            quantile = spec.get("quantile", "p95")
+            win = counters.get_statistics(
+                source, windows=(max(fast_s, 1.0),)
+            ).get(source, {})
+            agg = next(iter(win.values()), {})
+            track.live = float(agg.get(quantile, 0.0))
+            track.baseline = get_ledger().baseline(
+                spec.get("baseline_kernel", "solve"),
+                spec.get("baseline_metric", "device_ms"),
+                signature=spec.get("baseline_signature", "live"),
+                variant=spec.get("baseline_variant", "live"),
+                quantile=quantile,
+            )
+            if (
+                track.baseline is None
+                or track.baseline <= 0.0
+                # thin windows produce garbage quantiles
+                or int(agg.get("count", 0)) < int(spec.get("min_count", 3))
+                # cold-start exclusion: a fresh engine's first window is
+                # full of compile-heavy solves, not regressions
+                or now - self._started < float(spec.get("warmup_s", fast_s))
+            ):
+                return 0.0, False
+            value = track.live / track.baseline
+            return value, value > threshold
         if kind == "counter_delta":
             cur = float(counters.get_counter(source) or 0.0)
             prev = track._prev_counter
@@ -220,17 +264,24 @@ class SloEngine:
                 if prev_state == "ok":
                     track.alerts += 1
                     counters.increment(f"monitor.slo.{name}.alerts")
-                    alerts.append(
-                        {
-                            "slo": name,
-                            "state": track.state,
-                            "source": track.spec["source"],
-                            "threshold": float(track.spec["threshold"]),
-                            "value": round(value, 3),
-                            "fast_burn": round(track.fast_burn, 3),
-                            "slow_burn": round(track.slow_burn, 3),
-                        }
-                    )
+                    alert = {
+                        "slo": name,
+                        "kind": track.spec.get("kind", "stat"),
+                        "state": track.state,
+                        "source": track.spec["source"],
+                        "threshold": float(track.spec["threshold"]),
+                        "value": round(value, 3),
+                        "fast_burn": round(track.fast_burn, 3),
+                        "slow_burn": round(track.slow_burn, 3),
+                    }
+                    if track.spec.get("kind") == "baseline_drift":
+                        alert["baseline"] = (
+                            round(track.baseline, 3)
+                            if track.baseline is not None
+                            else None
+                        )
+                        alert["live"] = round(track.live, 3)
+                    alerts.append(alert)
             base = f"monitor.slo.{name}"
             counters.set_counter(
                 f"{base}.burning", float(_SLO_STATE_LEVEL[track.state])
@@ -259,6 +310,14 @@ class SloEngine:
                     "slow_burn": round(t.slow_burn, 3),
                     "alerts": t.alerts,
                     "last_transition_ms": t.last_transition_ms,
+                    **(
+                        {
+                            "baseline": round(t.baseline, 3),
+                            "live": round(t.live, 3),
+                        }
+                        if t.baseline is not None
+                        else {}
+                    ),
                 }
                 for name, t in self._tracks.items()
             },
@@ -440,6 +499,11 @@ class Monitor(Actor):
             if config.enable_flight_recorder
             else None
         )
+        # persistent perf-baseline ledger (runtime/perf_ledger.py): the
+        # baseline_drift SLO kind reads it, the recording loop below
+        # appends to it. "" keeps this process disk-free.
+        self.perf_ledger = configure_perf_ledger(config.perf_ledger_dir)
+        self._last_perf_record = time.monotonic()
         # divergence-events watermark for the edge-triggered recorder
         # trigger (distinct from the SLO, which has its own baseline)
         self._prev_divergence_events = float(
@@ -530,20 +594,22 @@ class Monitor(Actor):
             )
 
     async def _trigger_recorder(
-        self, reason: str, detail: dict, force: bool = False
+        self,
+        reason: str,
+        detail: dict,
+        force: bool = False,
+        extra: Optional[dict] = None,
     ) -> Optional[dict]:
         recorder = self.flight_recorder
         if recorder is None:
             return None
-        extra = (
-            {"slo": self.slo_engine.report()}
-            if self.slo_engine is not None
-            else None
-        )
+        merged = dict(extra or {})
+        if self.slo_engine is not None:
+            merged["slo"] = self.slo_engine.report()
         # the freeze walks lock-protected registries and the write hits
         # disk — worker thread, never the control-plane event loop
         return await asyncio.to_thread(
-            recorder.trigger, reason, detail, extra, force
+            recorder.trigger, reason, detail, merged or None, force
         )
 
     async def _observability_tick(self) -> None:
@@ -579,11 +645,71 @@ class Monitor(Actor):
                         sample.event,
                         {"node": sample.node_name, **sample.values},
                     )
-                await self._trigger_recorder(
-                    f"slo_burn:{alert['slo']}", alert
-                )
+                if alert.get("kind") == "baseline_drift":
+                    # a drifting kernel is a perf regression, not an
+                    # availability burn: the bundle gets the ledger
+                    # delta so triage starts from baseline-vs-live
+                    await self._trigger_recorder(
+                        "perf_regression",
+                        alert,
+                        extra={
+                            "perf_ledger_delta": {
+                                "slo": alert["slo"],
+                                "baseline": alert.get("baseline"),
+                                "live": alert.get("live"),
+                                "ratio": alert.get("value"),
+                                "threshold": alert.get("threshold"),
+                                "ledger": self.perf_ledger.snapshot(),
+                            }
+                        },
+                    )
+                else:
+                    await self._trigger_recorder(
+                        f"slo_burn:{alert['slo']}", alert
+                    )
+        self._maybe_record_live_perf()
         if recorder is not None:
             recorder.record_tick()
+
+    def _maybe_record_live_perf(self) -> None:
+        """Append a live solve observation (kernel "solve", signature/
+        variant "live") every perf_ledger_record_interval_s so a
+        long-running daemon accretes its own baseline. Skipped while any
+        drift SLO is burning — recording through a regression would pull
+        the baseline toward the regressed latency and mask it."""
+        lg = self.perf_ledger
+        if not lg.enabled:
+            return
+        now = time.monotonic()
+        interval = self.cfg.perf_ledger_record_interval_s
+        if now - self._last_perf_record < interval:
+            return
+        if self.slo_engine is not None and any(
+            t.spec.get("kind") == "baseline_drift" and t.state != "ok"
+            for t in self.slo_engine._tracks.values()
+        ):
+            return
+        win = (max(interval, 1.0),)
+        def agg(stat: str) -> dict:
+            return next(
+                iter(counters.get_statistics(stat, windows=win).get(stat, {}).values()),
+                {},
+            )
+        spf = agg("decision.spf_ms")
+        if not spf.get("count"):
+            return  # no solves this window — nothing worth a baseline
+        self._last_perf_record = now
+        obs = {
+            "device_ms": spf.get("p50", 0.0),
+            "solves": spf.get("count", 0),
+        }
+        mat = agg("decision.mat_ms")
+        if mat.get("count"):
+            obs["mat_ms"] = mat.get("p50", 0.0)
+        hbm, _ = device_stats.peak_hbm_mb(allow_import=False)
+        if hbm:
+            obs["peak_hbm_mb"] = float(hbm)
+        lg.record("solve", obs, signature="live", variant="live")
 
     async def _metrics_loop(self) -> None:
         """Process gauges (role of SystemMetrics.{h,cpp})."""

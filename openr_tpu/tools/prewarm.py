@@ -62,10 +62,20 @@ def _record_prewarm(namespace: str, nodes: int, dt_s: float) -> None:
     compile cost per workload class."""
     from openr_tpu.ops.xla_cache import ledger
     from openr_tpu.runtime.counters import counters
+    from openr_tpu.runtime.perf_ledger import get_ledger
 
     ledger.record(f"prewarm[{namespace}:{nodes}]", dt_s * 1e3, {})
     counters.add_stat_value(
         f"xla_cache.prewarm.{namespace}.compile_ms", dt_s * 1e3
+    )
+    # perf observatory: per-(namespace, shape-class) bake wall-time —
+    # boot traces attribute prewarm from this, and ROADMAP item 1
+    # measures its cold-start win against it
+    get_ledger().record(
+        "prewarm",
+        {"bake_ms": dt_s * 1e3},
+        signature=f"n{nodes}",
+        variant=namespace,
     )
 
 
@@ -269,6 +279,11 @@ def main(argv=None) -> int:
         help="also bake the what-if sweep (whatif) namespace",
     )
     p.add_argument(
+        "--perf-ledger-dir", default=None,
+        help="perf-ledger directory for bake-time records (default: "
+        "$OPENR_TPU_PERF_LEDGER / ~/.cache/openr_tpu/perf)",
+    )
+    p.add_argument(
         "--devices", type=int, default=0,
         help="force N virtual CPU devices (XLA_FLAGS host platform "
         "device count) — for baking the multichip namespace off-TPU; "
@@ -291,7 +306,13 @@ def main(argv=None) -> int:
             ).strip()
 
     from openr_tpu.ops.xla_cache import enable_compilation_cache
+    from openr_tpu.runtime import perf_ledger
 
+    perf_ledger.configure(
+        args.perf_ledger_dir
+        if args.perf_ledger_dir is not None
+        else perf_ledger.default_dir()
+    )
     cache = enable_compilation_cache(args.cache_dir)
     if cache is None:
         print("[prewarm] compilation cache DISABLED — nothing to bake",

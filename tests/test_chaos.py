@@ -848,3 +848,165 @@ class TestSparkGracefulRestartDrill:
         finally:
             registry.clear()
             await stop_all(nodes)
+
+
+class TestPerfRegressionDrill:
+    @run_async
+    async def test_latency_fault_trips_baseline_drift(self):
+        """ISSUE 14 drill: an armed solver.exec LATENCY fault (delay_ms)
+        inflates decision.spf_ms while routing keeps converging — no
+        failover, no route loss, just a slower kernel. The
+        baseline_drift SLO must compare the live window against the
+        pre-seeded perf-ledger baseline, burn into an alert, and freeze
+        a perf_regression bundle whose ledger delta shows
+        baseline-vs-live."""
+        import json
+        import os
+        import tempfile
+
+        from openr_tpu.runtime import perf_ledger
+        from openr_tpu.runtime.perf_ledger import PerfLedger
+
+        registry.clear()
+        ledger_dir = tempfile.mkdtemp(prefix="openr-tpu-perf-drill-")
+        rec_dir = tempfile.mkdtemp(prefix="openr-tpu-flightrec-perf-")
+        # the baseline a healthy fleet accreted before this "restart":
+        # p95 solve latency ~5ms
+        seed = PerfLedger(ledger_dir)
+        for _ in range(8):
+            seed.record(
+                "solve", {"device_ms": 5.0}, signature="live", variant="live"
+            )
+        names = ["node-0", "node-1", "node-2"]
+        links = [
+            ("node-0", "if-01", "node-1", "if-10"),
+            ("node-1", "if-12", "node-2", "if-21"),
+            ("node-2", "if-20", "node-0", "if-02"),
+        ]
+        mesh, nodes = await start_mesh(
+            names,
+            links,
+            decision_config=DecisionConfig(
+                debounce_min_ms=5, debounce_max_ms=25
+            ),
+        )
+        mon = Monitor(
+            "node-0",
+            MonitorConfig(
+                slos={
+                    "solve_drift": {
+                        "kind": "baseline_drift",
+                        "source": "decision.spf_ms",
+                        "threshold": 1.5,
+                        "min_count": 2,
+                        # drill-scale: no cold-start exclusion (the mesh
+                        # converges before the fault arms) and 2s/4s
+                        # burn windows so the machine runs in seconds
+                        "warmup_s": 0.0,
+                        "fast_window_s": 2.0,
+                        "slow_window_s": 4.0,
+                    }
+                },
+                slo_fast_window_s=2.0,
+                slo_slow_window_s=4.0,
+                perf_ledger_dir=ledger_dir,
+                flight_recorder_dir=rec_dir,
+                flight_recorder_ring=64,
+                flight_recorder_min_interval_s=0.0,
+            ),
+            nodes["node-0"].log_sample_queue.get_reader("perf-drill"),
+            interval_s=0.1,
+        )
+        await mon.start()
+        stop_churn = asyncio.Event()
+
+        async def churn():
+            """Flap a link-metric override: a link-ATTRIBUTE change
+            forces full rebuilds (the incremental path has no
+            solver.exec site), keeping decision.spf_ms measuring the
+            delayed solves; the topology itself never changes, so
+            routing stays converged throughout."""
+            flip = False
+            while not stop_churn.is_set():
+                flip = not flip
+                await nodes["node-0"].link_monitor.set_link_metric(
+                    "if-01", 10 if flip else None
+                )
+                await asyncio.sleep(0.15)
+
+        churn_task = None
+        try:
+            for i, n in enumerate(names):
+                nodes[n].advertise_prefix(loopback(i))
+
+            def converged():
+                for i, n in enumerate(names):
+                    expect = {loopback(j) for j in range(3) if j != i}
+                    if not expect <= set(nodes[n].fib_routes):
+                        return False
+                return True
+
+            await wait_until(converged, timeout_s=CONVERGENCE_S)
+            failovers0 = _counter("decision.solver.failovers")
+
+            # every solve now pays +40ms — slower, NOT broken
+            registry.arm("solver.exec", delay_ms=40.0)
+            churn_task = asyncio.ensure_future(churn())
+
+            # the latency fault actually fires (and never raises)
+            await wait_until(
+                lambda: _counter("runtime.fault.solver.exec.delayed") > 0,
+                timeout_s=CONVERGENCE_S,
+            )
+            # the drift SLO burns and the monitor freezes a
+            # perf_regression bundle (NOT a generic slo_burn)
+            await wait_until(
+                lambda: any(
+                    b["reason"] == "perf_regression"
+                    for b in mon.flight_recorder.bundles
+                ),
+                timeout_s=CONVERGENCE_S,
+            )
+            rep = mon.slo_report()["slos"]["solve_drift"]
+            assert rep["state"] in ("fast_burn", "sustained_burn"), rep
+            assert rep["baseline"] == 5.0
+            assert rep["live"] > rep["baseline"]
+
+            pr = next(
+                b
+                for b in mon.flight_recorder.bundles
+                if b["reason"] == "perf_regression"
+            )
+            with open(os.path.join(pr["path"], "bundle.json")) as f:
+                doc = json.load(f)
+            assert doc["trigger"]["reason"] == "perf_regression"
+            assert doc["trigger"]["detail"]["kind"] == "baseline_drift"
+            delta = doc["perf_ledger_delta"]
+            assert delta["slo"] == "solve_drift"
+            assert delta["baseline"] == 5.0
+            assert delta["live"] > 5.0
+            assert delta["ratio"] > 1.5
+            assert delta["threshold"] == 1.5
+            # the bundled ledger snapshot holds the live-solve key the
+            # baseline came from
+            assert any(
+                k.startswith("solve|live|live|")
+                for k in delta["ledger"]["keys"]
+            ), list(delta["ledger"]["keys"])
+            assert doc["slo"]["slos"]["solve_drift"]["state"] != "ok"
+
+            # the whole time: a PERF regression, not an availability
+            # event — no failover, no degraded mode, routes intact
+            assert _counter("decision.solver.failovers") == failovers0
+            assert _counter("decision.solver.degraded") == 0
+            assert converged()
+        finally:
+            registry.clear()
+            stop_churn.set()
+            if churn_task is not None:
+                with contextlib.suppress(Exception):
+                    await churn_task
+            with contextlib.suppress(Exception):
+                await mon.stop()
+            await stop_all(nodes)
+            perf_ledger.configure("")

@@ -32,6 +32,7 @@ CODE_INVALID = "metric-invalid"
 
 sys.path.insert(0, str(REPO_ROOT))
 
+from openr_tpu.runtime.lifecycle import BOOT_PHASES  # noqa: E402
 from openr_tpu.runtime.metrics_export import (  # noqa: E402
     is_valid_metric_name,
     normalize_metric_name,
@@ -90,6 +91,15 @@ def collect(project: Project) -> tuple[dict, dict]:
 
 def run(project: Project) -> list[Finding]:
     counter_names, stat_names = collect(project)
+    # The boot-phase gauges (runtime/lifecycle.py) are emitted with a
+    # runtime phase name, which collection abstracts to the placeholder.
+    # Their vocabulary is the closed BOOT_PHASES tuple, so expand the
+    # placeholder into every concrete `boot.phase.<name>_ms` gauge and
+    # let each participate in collision checking.
+    boot_site = counter_names.pop(f"boot.phase.{PLACEHOLDER}_ms", None)
+    if boot_site is not None:
+        for phase in BOOT_PHASES:
+            counter_names.setdefault(f"boot.phase.{phase}_ms", boot_site)
     findings: list[Finding] = []
     # exposition family -> (raw name, site); stats expand to their
     # derived families so `a.b` (stat) vs `a.b_max` (counter) is caught
