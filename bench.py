@@ -210,6 +210,9 @@ def bench_config(name, gen, me, runs=5, flap_victims=0, cpu_baseline=True,
         k: int(_counters.get_counter(f"xla_cache.{k}") or 0)
         for k in _XLA_KEYS
     }
+    retrace0 = sum(
+        _counters.get_counters("xla_cache.retraces.").values()
+    )
     samples, phases = [], {}
     for i in range(runs):
         _flap(states, adj_dbs, victims, i, area)
@@ -289,6 +292,14 @@ def bench_config(name, gen, me, runs=5, flap_victims=0, cpu_baseline=True,
         k: int(_counters.get_counter(f"xla_cache.{k}") or 0) - xla0[k]
         for k in _XLA_KEYS
     }
+    # unexpected recompiles over the churn loop (retrace sentinel,
+    # summed across namespaces). A warm steady state must report 0 —
+    # the smoke test gates on it; any nonzero means a trace-level
+    # cache-class fork that the factory key did not capture
+    res["xla_cache"]["retraces"] = int(
+        sum(_counters.get_counters("xla_cache.retraces.").values())
+        - retrace0
+    )
     # async dispatch queue depth gauge (0 unless a Decision actor with
     # async_dispatch ran in this process; reported so daemon-embedded
     # bench runs surface backlog)

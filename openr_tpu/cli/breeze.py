@@ -1164,7 +1164,9 @@ def tpu_profile(ctx, seconds, out_dir) -> None:
 def tpu_kernels(ctx) -> None:
     """XLA kernel cost ledger joined with achieved solver timings:
     estimated FLOPs/bytes per compiled pipeline plus achieved
-    GFLOP/s and GB/s from the last solve."""
+    GFLOP/s and GB/s from the last solve, and the retrace sentinel's
+    per-namespace unexpected-recompile counts and recent signature
+    deltas (any nonzero retraces on a warm daemon is triage-worthy)."""
     _print(_call(ctx, "ctrl.tpu.kernels"))
 
 

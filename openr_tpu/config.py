@@ -203,6 +203,15 @@ class DecisionConfig:
     # when a device-solve result is under suspicion. Both kernels reach
     # the identical int32 fixpoint.
     spf_kernel: str = "bucketed"
+    # opt-in jax.transfer_guard around the solver's exec hot path
+    # (decision/tpu_solver.py): "log" logs implicit host<->device
+    # transfers through jax; "disallow" turns each into a counted,
+    # attributed finding (decision.solver.transfer_guard.findings +
+    # a last_sentinels entry) and retries the dispatch unguarded so
+    # routing still converges. "off" (default) stays out of the way —
+    # the guard is a triage lever, not a production setting
+    # (docs/Operations.md).
+    transfer_guard: str = "off"
 
 
 @dataclass
@@ -691,6 +700,10 @@ class Config:
             raise ConfigError("decision multichip_batch must be >= 0")
         if dc.spf_kernel not in ("sync", "bucketed"):
             raise ConfigError(f"unknown spf_kernel {dc.spf_kernel!r}")
+        if dc.transfer_guard not in ("off", "log", "disallow"):
+            raise ConfigError(
+                f"unknown transfer_guard {dc.transfer_guard!r}"
+            )
         pc = cfg.platform_config
         if pc.bulk_threshold < 1:
             raise ConfigError("platform bulk_threshold must be >= 1")

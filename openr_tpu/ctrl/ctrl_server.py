@@ -604,12 +604,18 @@ class CtrlServer(Actor):
                     nbytes / (exec_ms / 1e3) / 1e9, 3
                 )
             achieved.append(row)
+        from openr_tpu.ops.xla_cache import retrace
+
         return {
             "backend": device_stats.collect_device_stats()["backend"],
             "kernels": kernels,
             "achieved": achieved,
             "last_timing": last_timing,
             "sentinels": getattr(solver, "last_sentinels", None) or {},
+            # per-namespace unexpected-recompile counts, cache-class
+            # census, and the recent-retrace ring (namespace, kernel,
+            # signature delta) — the triage view for a slow warm solve
+            "retrace": retrace.snapshot(),
         }
 
     async def _monitor_fleet(self) -> dict:

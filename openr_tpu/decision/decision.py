@@ -125,6 +125,7 @@ def make_solver(
         kwargs.pop("multichip_n_cap_threshold", None)
         kwargs.pop("multichip_batch", None)
         kwargs.pop("spf_kernel", None)
+        kwargs.pop("transfer_guard", None)
         return SpfSolver(node_name, **kwargs)
     if backend in ("tpu", "auto"):
         try:
@@ -147,6 +148,7 @@ def make_solver(
             kwargs.pop("multichip_n_cap_threshold", None)
             kwargs.pop("multichip_batch", None)
             kwargs.pop("spf_kernel", None)
+            kwargs.pop("transfer_guard", None)
             return SpfSolver(node_name, **kwargs)
     raise ValueError(f"unknown solver backend {backend!r}")
 
@@ -208,6 +210,7 @@ class Decision(Actor):
             )
             skw.setdefault("multichip_batch", config.multichip_batch)
             skw.setdefault("spf_kernel", config.spf_kernel)
+            skw.setdefault("transfer_guard", config.transfer_guard)
         self.solver = make_solver(
             node_name,
             backend,
@@ -583,6 +586,7 @@ class Decision(Actor):
         )
         self._fold_solver_timing(ctx, spf_sp)
         self._emit_sentinels(spf_sp)
+        self._emit_retraces(spf_sp)
 
         t_mat = time.perf_counter()
         with tracer.span(ctx, "decision.rib_diff", node=self.node_name):
@@ -926,6 +930,27 @@ class Decision(Actor):
                     values={"category": "sentinel", **sent},
                 )
             )
+
+    def _emit_retraces(self, spf_sp) -> None:
+        """Surface retrace-after-warmup events the device sentinel
+        (ops/xla_cache.retrace) queued during this solve: one
+        DEVICE_RETRACE LogSample per event — category "sentinel" so the
+        flight recorder retains the lead-up, and the event itself is in
+        the Monitor's trigger table, so a retrace on a supposedly-warm
+        kernel freezes a post-mortem bundle while routing continues."""
+        try:
+            from openr_tpu.ops.xla_cache import retrace
+
+            events = retrace.drain_events()
+        # lint: allow(broad-except) best-effort telemetry must not kill
+        except Exception:  # pragma: no cover - telemetry must not kill
+            return
+        if not events:
+            return
+        if spf_sp is not None:
+            spf_sp.attributes["device_retrace"] = len(events)
+        for evt in events:
+            self._emit_solver_sample("DEVICE_RETRACE", evt)
 
     def _fold_solver_timing(self, ctx, spf_sp) -> None:
         """Fold the TPU pipeline's last_timing breakdown in as timed

@@ -59,10 +59,13 @@ routes are host-built (they are O(adjacent links), not hot).
 
 from __future__ import annotations
 
+import logging
 from collections import OrderedDict
 from typing import Optional
 
 import numpy as np
+
+log = logging.getLogger(__name__)
 
 from openr_tpu.decision.columnar_rib import (
     ColumnarRib,
@@ -87,7 +90,7 @@ from openr_tpu.ops.edgeplan import (
     sync_plan,
 )
 from openr_tpu.ops import relax as relax_ops
-from openr_tpu.ops.xla_cache import bounded_jit_cache
+from openr_tpu.ops.xla_cache import bounded_jit_cache, retrace
 from openr_tpu.types import (
     PrefixForwardingAlgorithm,
     PrefixForwardingType,
@@ -1312,7 +1315,8 @@ class TpuSpfSolver:
         incremental_cone_frac: float = 0.25,
         multichip_n_cap_threshold: int = 131072,
         multichip_batch: int = 0,
-        spf_kernel: str = "bucketed", **solver_kwargs
+        spf_kernel: str = "bucketed",
+        transfer_guard: str = "off", **solver_kwargs
     ):
         # a restarting daemon must not pay the ~80s 100k-node compile
         # again — load executables from the persistent cache
@@ -1362,6 +1366,15 @@ class TpuSpfSolver:
         if spf_kernel not in ("sync", "bucketed"):
             raise ValueError(f"unknown spf_kernel {spf_kernel!r}")
         self.spf_kernel = spf_kernel
+        # opt-in jax.transfer_guard around the exec hot path: "log"
+        # logs implicit host<->device transfers, "disallow" turns each
+        # into a counted, attributed finding (the dispatch retries
+        # unguarded so routing converges regardless). Default off.
+        if transfer_guard not in ("off", "log", "disallow"):
+            raise ValueError(
+                f"unknown transfer_guard {transfer_guard!r}"
+            )
+        self.transfer_guard = transfer_guard
         # memoized tier mesh: built once per process (device topology is
         # static within a solver's lifetime; device LOSS surfaces as a
         # dispatch failure -> CPU-oracle failover, not a mesh rebuild)
@@ -2491,15 +2504,66 @@ class TpuSpfSolver:
             "t0": t0, "t1": t1,
         }
 
-    @staticmethod
-    def _lane_args(pv: dict) -> tuple:
+    def _lane_args(self, pv: dict) -> tuple:
         ad, vs = pv["ad"], pv["vs"]
+        root_idx = np.int32(pv["root_idx"])
+        root_nbr, root_w = pv["root_nbr"], pv["root_w"]
+        if self._transfer_guard_mode() is not None and pv.get("mc") is None:
+            # under the guard the per-dispatch root-table uploads go
+            # explicit (jax.device_put), so only UNexpected implicit
+            # transfers remain to trip it
+            root_idx = self._put_counted(np.asarray(root_idx))
+            root_nbr = self._put_counted(np.ascontiguousarray(root_nbr))
+            root_w = self._put_counted(np.ascontiguousarray(root_w))
         return (
             ad.d_deltas, ad.d_shift_w, ad.d_res_rows, ad.d_res_nbr,
             ad.d_res_w, ad.d_mbuf,
-            np.int32(pv["root_idx"]), pv["root_nbr"], pv["root_w"],
+            root_idx, root_nbr, root_w,
             *vs.prev,
         )
+
+    def _transfer_guard_mode(self) -> Optional[str]:
+        """Active jax.transfer_guard level for the exec hot path, or
+        None when the knob is off (decision_config.transfer_guard)."""
+        mode = self.transfer_guard
+        return mode if mode in ("log", "disallow") else None
+
+    def _run_exec(self, namespace: str, kernel_name: str, signature,
+                  run, args, area: str):
+        """ONE executable invocation under the retrace sentinel's scope
+        and — opt-in — jax.transfer_guard. A compile firing here after
+        the kernel's warmup is a retrace (ops/xla_cache.retrace); with
+        transfer_guard="disallow" an implicit host<->device transfer
+        raises, is counted + attributed as a finding, and the dispatch
+        retries unguarded so routing still converges. The multichip
+        tier skips the guard: its root tables take their placement from
+        the jit's in_shardings, which the guard cannot distinguish from
+        a stray implicit upload."""
+        mode = self._transfer_guard_mode()
+        if mode is None or namespace == "multichip":
+            with retrace.scope(namespace, kernel_name, signature):
+                return run(*args)
+        import jax
+
+        try:
+            with retrace.scope(namespace, kernel_name, signature):
+                with jax.transfer_guard(mode):
+                    return run(*args)
+        # lint: allow(broad-except) guard findings downgrade, not fail
+        except Exception as e:
+            if "transfer" not in str(e).lower():
+                raise
+            counters.increment("decision.solver.transfer_guard.findings")
+            self.last_sentinels["transfer_guard_findings"] = (
+                self.last_sentinels.get("transfer_guard_findings", 0) + 1
+            )
+            log.warning(
+                "transfer_guard finding: implicit transfer in area %s "
+                "kernel %s (%s); re-dispatching unguarded", area,
+                kernel_name, e,
+            )
+            with retrace.scope(namespace, kernel_name, signature):
+                return run(*args)
 
     def _dispatch_one(self, pv: dict):
         """Dispatch one area's pipeline and start the async result copy;
@@ -2531,7 +2595,10 @@ class TpuSpfSolver:
                 incr["sd_idx"], incr["sd_old"],
                 incr["rd_idx"], incr["rd_old"], incr["cone_limit"],
             )
-            delta_buf, full_buf, *new_prev = run(*args)
+            ns = "multichip" if mc is not None else "incr"
+            delta_buf, full_buf, *new_prev = self._run_exec(
+                ns, kernel_name, pv["shape_key"], run, args, pv["area"]
+            )
             # resident incremental state for the device-only probe
             # (bench.py incr_device_ms): prev outputs chain through
             # o[2:7], the distance plane through o[7], the dirty tail
@@ -2557,7 +2624,10 @@ class TpuSpfSolver:
                 pv["kernel"], pv["delta_exp"],
             )
         args = self._lane_args(pv)
-        delta_buf, full_buf, *new_prev = run(*args)
+        ns = "multichip" if mc is not None else ""
+        delta_buf, full_buf, *new_prev = self._run_exec(
+            ns, kernel_name, pv["shape_key"], run, args, pv["area"]
+        )
         counters.increment("decision.solver.full.solves")
         if self.incremental_spf:
             # full dispatch while incremental is on: first / ineligible
@@ -2588,7 +2658,10 @@ class TpuSpfSolver:
         area_args = tuple(
             tuple(lane[i] for lane in lanes) for i in range(14)
         )
-        outs = run(*area_args)
+        outs = self._run_exec(
+            "", kernel_name, pv0["shape_key"], run, area_args,
+            pv0["area"],
+        )
         counters.increment("decision.device.fused_dispatches")
         counters.increment("decision.device.fused_areas", g)
         counters.increment("decision.solver.full.solves", g)

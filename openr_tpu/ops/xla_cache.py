@@ -18,12 +18,13 @@ the setting at first compile).
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import logging
 import os
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 
 log = logging.getLogger(__name__)
 
@@ -109,6 +110,191 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
     return d
 
 
+# -- retrace sentinel -------------------------------------------------------
+#
+# The monitoring hook above answers "did the persistent cache hit?"; the
+# sentinel below answers "did XLA compile when we believed the kernel
+# was warm?". jax fires a backend-compile duration event once per fresh
+# executable build and stays silent on executable-cache hits, so a
+# compile observed while the solver is executing an already-warmed
+# (namespace, kernel) pair is a RETRACE — the silent ~8s routing-stale
+# stall ROADMAP item 1 chases. Mirrors the runtime/affinity.py design:
+# cheap enough to leave on, attribution at the point of damage.
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_NEVER = object()
+
+
+def _sig_delta(prev: tuple, cur: tuple) -> str:
+    if prev == cur:
+        return (
+            "signature unchanged — trace-level fork (closure capture, "
+            "dtype/weak-type drift, or non-array argument churn)"
+        )
+    return f"{prev!r} -> {cur!r}"
+
+
+class RetraceSentinel:
+    """Attributes unexpected XLA compiles to their jit-cache namespace.
+
+    The solver wraps each executable invocation in
+    ``scope(namespace, kernel_name, capacity_signature)``. The FIRST
+    compile observed for a (namespace, kernel) pair is warmup and is
+    recorded; any LATER compile for the same pair is a retrace:
+    `xla_cache.retraces.<namespace>` counts it, and a structured event
+    carrying the offending signature delta is queued for the Decision
+    actor to surface as a DEVICE_RETRACE LogSample (which trips the
+    flight recorder through the Monitor's trigger table).
+
+    Also keeps the per-namespace cache-class census (distinct capacity
+    signatures per bounded_jit_cache namespace) that
+    `xla_cache.classes.<namespace>` and ctrl.tpu.kernels report."""
+
+    MAX_EVENTS = 32
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._hooked: bool | None = None  # None = not yet attempted
+        # (namespace, kernel name) -> capacity signature at last compile
+        self._compiled: dict[tuple, tuple] = {}
+        # namespace label -> retrace count (counter fabric mirror)
+        self._retraces: dict[str, int] = {}
+        # namespace label -> {capacity signatures} (factory-miss census)
+        self._classes: dict[str, set] = {}
+        # pending LogSample payloads (drained by the Decision actor)
+        self._events: deque = deque(maxlen=self.MAX_EVENTS)
+        # retained ring for ctrl.tpu.kernels triage
+        self._recent: deque = deque(maxlen=self.MAX_EVENTS)
+
+    # -- jax hook ----------------------------------------------------------
+
+    def _ensure_hooked(self) -> bool:
+        if self._hooked is not None:
+            return self._hooked
+        with self._lock:
+            if self._hooked is not None:
+                return self._hooked
+            try:
+                from jax._src import monitoring
+
+                monitoring.register_event_duration_secs_listener(
+                    self._on_duration_event
+                )
+                self._hooked = True
+            # lint: allow(broad-except) private jax API; sentinel darkens
+            except Exception:  # pragma: no cover - jax internals moved
+                self._hooked = False
+            return self._hooked
+
+    def _on_duration_event(self, event: str, duration, **kwargs) -> None:
+        if event != _COMPILE_EVENT:
+            return
+        # compiles are synchronous within the dispatching call, so the
+        # thread-local scope stack names the kernel being built
+        stack = getattr(self._tls, "stack", None)
+        if not stack:
+            return
+        namespace, name, sig = stack[-1]
+        key = (namespace, name)
+        with self._lock:
+            prev = self._compiled.get(key, _NEVER)
+            self._compiled[key] = sig
+        if prev is _NEVER:
+            return  # warmup compile — expected
+        self._record_retrace(namespace, name, prev, sig)
+
+    def _record_retrace(
+        self, namespace: str, name: str, prev: tuple, sig: tuple
+    ) -> None:
+        from openr_tpu.runtime.counters import counters
+
+        label = namespace or "default"
+        counters.increment(f"xla_cache.retraces.{label}")
+        evt = {
+            "namespace": label,
+            "kernel": name,
+            "signature": repr(sig),
+            "signature_delta": _sig_delta(prev, sig),
+            "ts": time.time(),
+        }
+        with self._lock:
+            self._retraces[label] = self._retraces.get(label, 0) + 1
+            self._events.append(evt)
+            self._recent.append(dict(evt))
+        log.warning(
+            "retrace after warmup: %s kernel %s (%s)",
+            label, name, evt["signature_delta"],
+        )
+
+    # -- solver-facing API -------------------------------------------------
+
+    @contextlib.contextmanager
+    def scope(self, namespace: str, name: str, signature=()):
+        """Mark the dynamic extent of one executable invocation; any
+        compile firing inside it is attributed to (namespace, name)."""
+        if not self._ensure_hooked():
+            yield
+            return
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        stack.append((namespace, name, tuple(signature)))
+        try:
+            yield
+        finally:
+            stack.pop()
+
+    def note_class(self, namespace: str, sig: tuple) -> None:
+        """Factory-miss census: one distinct capacity signature seen in
+        `namespace` (called by bounded_jit_cache)."""
+        from openr_tpu.runtime.counters import counters
+
+        label = namespace or "default"
+        with self._lock:
+            classes = self._classes.setdefault(label, set())
+            classes.add(sig)
+            n = len(classes)
+        counters.set_counter(f"xla_cache.classes.{label}", n)
+
+    def forget(self, namespace: str) -> None:
+        """A bucket eviction dropped executables in `namespace`; their
+        re-compiles on regrowth are warmup, not retraces."""
+        with self._lock:
+            for key in [k for k in self._compiled if k[0] == namespace]:
+                del self._compiled[key]
+
+    def drain_events(self) -> list[dict]:
+        """Pending retrace events, consumed (Decision -> LogSample)."""
+        with self._lock:
+            out = list(self._events)
+            self._events.clear()
+        return out
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "retraces": dict(self._retraces),
+                "classes": {
+                    ns: len(sigs) for ns, sigs in self._classes.items()
+                },
+                "recent": [dict(e) for e in self._recent],
+            }
+
+    def reset(self) -> None:
+        """Test hook: drop warmup/census state (the jax listener cannot
+        be unregistered; an empty scope stack makes it a no-op)."""
+        with self._lock:
+            self._compiled.clear()
+            self._retraces.clear()
+            self._classes.clear()
+            self._events.clear()
+            self._recent.clear()
+
+
+retrace = RetraceSentinel()
+
+
 # -- bounded executable caches ----------------------------------------------
 #
 # The jit factories across the solver are keyed on capacity-class shapes.
@@ -177,7 +363,9 @@ def bounded_jit_cache(max_buckets: int = 8, namespace: str = ""):
             # compile outside the lock: factory bodies trace/compile and
             # may take seconds — a racing duplicate compile is benign
             counters.increment(prefix + "factory_misses")
+            retrace.note_class(namespace, sig)
             value = fn(*key)
+            evicted = False
             with lock:
                 group = buckets.setdefault(sig, {})
                 group.setdefault(key, value)
@@ -187,7 +375,13 @@ def bounded_jit_cache(max_buckets: int = 8, namespace: str = ""):
                     counters.increment(
                         prefix + "executable_evictions", len(dropped)
                     )
-                return group[key]
+                    evicted = True
+                value = group[key]
+            if evicted:
+                # dropped executables recompile as warmup on regrowth,
+                # not as retraces
+                retrace.forget(namespace)
+            return value
 
         def cache_clear():
             with lock:

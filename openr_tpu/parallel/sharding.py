@@ -27,13 +27,13 @@ mesh in lockstep with no host round-trips.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import numpy as np
 
 from openr_tpu.ops import relax as relax_ops
 from openr_tpu.ops.edgeplan import INF32E
+from openr_tpu.ops.xla_cache import bounded_jit_cache
 
 INF_E = int(INF32E)
 _UNROLL = relax_ops.UNROLL
@@ -63,7 +63,10 @@ def make_mesh(n_devices: Optional[int] = None, batch: Optional[int] = None):
     return Mesh(np.array(devs).reshape(batch, graph), ("batch", "graph"))
 
 
-@functools.lru_cache(maxsize=8)
+# bounded (not lru_cache): superseded fabric capacity buckets release
+# their executables' HBM, and the namespace shows up in the cache-class
+# census and retrace attribution (xla_cache.fabric_* / retraces.fabric)
+@bounded_jit_cache(namespace="fabric")
 def _sharded_fabric_fn(mesh, n_cap: int, s_cap: int, r_cap: int,
                        kr_cap: int, has_res: bool, d_cap: int,
                        p_cap: int, a_cap: int, n_trips: int,

@@ -569,6 +569,10 @@ class Monitor(Actor):
         "DECISION_SENTINEL_ANOMALY": "sentinel_anomaly",
         "SUPERVISOR_RESTART": "supervisor_restart",
         "DECISION_SOLVER_DEGRADED": "solver_failover",
+        # retrace-after-warmup (ops/xla_cache.retrace): a silent
+        # recompile on a supposedly-warm kernel is a routing-stale
+        # stall in the making — freeze the evidence
+        "DEVICE_RETRACE": "device_retrace",
     }
     # LogSample categories worth keeping in the recorder's event ring
     # even when they don't trigger (the bundle shows the lead-up)

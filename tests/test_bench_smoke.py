@@ -60,6 +60,10 @@ def test_bench_config_smoke_device_path():
     xc = res["xla_cache"]
     assert xc["factory_hits"] > 0, xc
     assert xc["executable_evictions"] == 0, xc
+    # ISSUE 15: zero unexpected retraces over warm churn — every
+    # compile after the per-kernel warmup is a trace-level cache-class
+    # fork the retrace sentinel attributes, and steady state has none
+    assert xc["retraces"] == 0, xc
     # ISSUE 7: the incremental churn lane must engage the seed-from-
     # previous path on a plain metric-flap sequence (no fallbacks) and
     # must not churn the incr executable namespace

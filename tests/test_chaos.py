@@ -1010,3 +1010,130 @@ class TestPerfRegressionDrill:
                 await mon.stop()
             await stop_all(nodes)
             perf_ledger.configure("")
+
+
+class TestDeviceRetraceFlightRecorderDrill:
+    @run_async
+    async def test_injected_cache_fork_trips_retrace_bundle(self):
+        """ISSUE 15 drill: an injected cache-class fork — the live jit
+        executables dropped out from under a warm mesh — must be caught
+        by the retrace sentinel on the next solve: the recompile is
+        attributed (namespace + signature delta), surfaced as a
+        DEVICE_RETRACE LogSample, and freezes a flight-recorder bundle,
+        while routing reconverges without a blip. All three nodes run in
+        one process and share the module-global factory caches, so the
+        process-global event queue may be drained by ANY node's Decision
+        — the drill monitors every node and asserts the bundle lands
+        somewhere, which is exactly the per-process production shape."""
+        import json
+        import os
+        import tempfile
+
+        from openr_tpu.decision import tpu_solver as ts
+        from openr_tpu.ops.xla_cache import retrace
+
+        def _clear_factories():
+            # the injection: python-level caches drop their executables
+            # WITHOUT the eviction path's retrace.forget() — the next
+            # dispatch re-jits a kernel the sentinel considers warm
+            for fn in (
+                ts._jitted_pipeline, ts._jitted_sssp_batch,
+                ts._plan_pipeline, ts._fused_pipeline,
+                ts._instrumented_pipeline, ts._instrumented_fused,
+                ts._scatter_jit,
+            ):
+                fn.cache_clear()
+
+        def _retraces():
+            return sum(
+                counters.get_counters("xla_cache.retraces.").values()
+            )
+
+        registry.clear()
+        _clear_factories()
+        retrace.reset()  # initial convergence compiles = clean warmup
+        rec_root = tempfile.mkdtemp(prefix="openr-tpu-retrace-drill-")
+        names = ["node-0", "node-1", "node-2"]
+        links = [
+            ("node-0", "if-01", "node-1", "if-10"),
+            ("node-1", "if-12", "node-2", "if-21"),
+            ("node-2", "if-20", "node-0", "if-02"),
+        ]
+        mesh, nodes = await start_mesh(
+            names,
+            links,
+            solver_backend="tpu",
+            decision_config=DecisionConfig(
+                debounce_min_ms=5,
+                debounce_max_ms=25,
+            ),
+        )
+        mons = {}
+        for n in names:
+            mons[n] = Monitor(
+                n,
+                MonitorConfig(
+                    flight_recorder_dir=os.path.join(rec_root, n),
+                    flight_recorder_min_interval_s=0.0,
+                ),
+                nodes[n].log_sample_queue.get_reader("retrace-drill"),
+                interval_s=0.1,
+            )
+            await mons[n].start()
+
+        def _bundles(reason):
+            return [
+                b
+                for mon in mons.values()
+                for b in mon.flight_recorder.bundles
+                if b["reason"] == reason
+            ]
+
+        try:
+            for i, n in enumerate(names):
+                nodes[n].advertise_prefix(loopback(i))
+
+            def converged():
+                for i, n in enumerate(names):
+                    expect = {loopback(j) for j in range(3) if j != i}
+                    if set(nodes[n].fib_routes) != expect:
+                        return False
+                return True
+
+            await wait_until(converged, timeout_s=CONVERGENCE_S)
+            await asyncio.sleep(0.3)  # let trailing rebuilds settle
+            retraces0 = _retraces()
+            bundles0 = len(_bundles("device_retrace"))
+
+            # INJECT the fork, then change the topology: the rebuild's
+            # re-jit of a supposedly-warm kernel is the retrace
+            _clear_factories()
+            mesh.disconnect("node-0", "if-02", "node-2", "if-20")
+
+            await wait_until(
+                lambda: _retraces() > retraces0, timeout_s=CONVERGENCE_S
+            )
+            await wait_until(
+                lambda: len(_bundles("device_retrace")) > bundles0,
+                timeout_s=CONVERGENCE_S,
+            )
+            fo = _bundles("device_retrace")[-1]
+            with open(os.path.join(fo["path"], "bundle.json")) as f:
+                doc = json.load(f)
+            assert doc["trigger"]["reason"] == "device_retrace"
+            assert doc["trigger"]["detail"]["event"] == "DEVICE_RETRACE"
+            # the attribution carries the namespace and signature delta
+            # the operator triages from (docs/Operations.md)
+            assert "namespace" in doc["trigger"]["detail"]
+            assert "signature_delta" in doc["trigger"]["detail"]
+
+            # the whole time: a telemetry event, not an availability
+            # event — routing reconverged through node-1
+            await wait_until(converged, timeout_s=CONVERGENCE_S)
+            assert _counter("decision.solver.degraded") == 0
+        finally:
+            registry.clear()
+            for mon in mons.values():
+                with contextlib.suppress(Exception):
+                    await mon.stop()
+            await stop_all(nodes)

@@ -1,6 +1,6 @@
 """tools.lint suite + runtime affinity sentinel tests.
 
-Fixture-based coverage for the four AST checkers (seeded violations
+Fixture-based coverage for the eight AST checkers (seeded violations
 must be flagged, clean idioms must not), the pragma/allowlist
 suppression machinery, a repo-runs-clean regression guard, and the
 thread-ownership sentinel — including the chaos-lane drill that proves
@@ -19,9 +19,12 @@ from openr_tpu.runtime import affinity
 from openr_tpu.runtime.counters import counters
 from tools.lint import affinity as affinity_check
 from tools.lint import blocking as blocking_check
+from tools.lint import donation as donation_check
 from tools.lint import excepts as excepts_check
 from tools.lint import metric_names as metric_check
 from tools.lint import purity as purity_check
+from tools.lint import recompile as recompile_check
+from tools.lint import shardcheck as shard_check
 from tools.lint.core import (
     REPO_ROOT,
     Allowlist,
@@ -344,6 +347,298 @@ def test_purity_traces_relax_kernel_roots():
         f for f in purity_check.run(project)
         if f.path == "openr_tpu/ops/relax.py"
     ]
+
+
+# -- recompile hygiene -----------------------------------------------------
+
+RECOMPILE_FIXTURE = """\
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    _tuning = {"unroll": 4}       # mutable module global
+    UNROLL = 4                    # ALL_CAPS constant: trace-safe
+
+    def factory(n_cap, wide):
+        scale = 2 if wide else 1
+
+        def pipeline(x):
+            k = _tuning["unroll"]         # seeded trace-capture
+            return jnp.sum(x) * k * UNROLL * n_cap * scale
+
+        return jax.jit(pipeline)
+
+    @functools.lru_cache(maxsize=8)
+    def cached_factory(n_cap):            # seeded unbounded-jit-cache
+        def pipeline(x):
+            return x * n_cap
+
+        return jax.jit(pipeline)
+"""
+
+
+def test_recompile_flags_captures_and_unbounded_cache(tmp_path):
+    project = make_project(
+        tmp_path,
+        {"openr_tpu/ops/fix_recompile.py": RECOMPILE_FIXTURE},
+        packages=("openr_tpu",),
+    )
+    findings = recompile_check.run(project)
+    assert {(f.code, f.detail) for f in findings} == {
+        ("trace-capture", "_tuning"),
+        ("unbounded-jit-cache", "cached_factory"),
+    }
+    # the capture finding names the mutable-global hazard, not a
+    # generic unresolved symbol
+    cap = next(f for f in findings if f.code == "trace-capture")
+    assert "mutable module global" in cap.message
+
+
+def test_recompile_clean_factory_is_silent(tmp_path):
+    # everything the traced closure reads flows through the factory
+    # parameters/locals, imports, or ALL_CAPS constants — the capacity
+    # signature owns it all
+    project = make_project(
+        tmp_path,
+        {
+            "openr_tpu/ops/fix_recompile_ok.py": """\
+                import jax
+                import jax.numpy as jnp
+
+                UNROLL = 4
+
+                def factory(n_cap, wide):
+                    scale = 2 if wide else 1
+
+                    def pipeline(x):
+                        return jnp.sum(x) * n_cap * scale * UNROLL
+
+                    return jax.jit(pipeline)
+            """,
+        },
+        packages=("openr_tpu",),
+    )
+    assert recompile_check.run(project) == []
+
+
+# -- sharding contracts ----------------------------------------------------
+
+# the PR 13 bug-shape, seeded: a mesh-aware jitted pull pipeline whose
+# concatenated boundary buffer is never re-pinned, plus the
+# traced-shift roll that GSPMD miscompiles to an unreduced partial-sum
+SHARD_FIXTURE = """\
+    import jax
+    import jax.numpy as jnp
+
+    def make_pull(mesh, rep):
+        def pull(a, b, shift):
+            delta_buf = jnp.concatenate([a, b])       # never constrained
+            rolled = jnp.roll(delta_buf, shift, axis=1)
+            return rolled
+        return jax.jit(pull)
+
+    def naked(x):
+        def body(v):
+            return jax.lax.pmin(v, "rows")
+        return jax.jit(body)(x)
+"""
+
+
+def test_shardcheck_catches_pr13_regression_shape(tmp_path):
+    project = make_project(
+        tmp_path,
+        {"openr_tpu/parallel/fix_shard.py": SHARD_FIXTURE},
+        packages=("openr_tpu",),
+    )
+    findings = shard_check.run(project)
+    got = {(f.code, f.detail) for f in findings}
+    assert ("unconstrained-boundary", "delta_buf") in got
+    assert ("sharded-axis-roll", "roll") in got
+    assert ("naked-collective", "pmin") in got
+    assert ("undeclared-axis", "pmin:rows") in got
+    roll = next(f for f in findings if f.code == "sharded-axis-roll")
+    assert "partial-sum" in roll.message
+
+
+def test_shardcheck_clean_shard_map_module_is_silent(tmp_path):
+    # the production shape: collectives under shard_map against a
+    # declared axis; the boundary buffer re-pinned (on the mesh path
+    # only — path-insensitive on purpose)
+    project = make_project(
+        tmp_path,
+        {
+            "openr_tpu/parallel/fix_shard_ok.py": """\
+                import jax
+                import jax.numpy as jnp
+                from jax.sharding import Mesh, PartitionSpec as P
+
+                def make_pull(mesh, rep):
+                    def pull(a, b):
+                        delta_buf = jnp.concatenate([a, b])
+                        if mesh is not None:
+                            delta_buf = jax.lax.with_sharding_constraint(
+                                delta_buf, rep)
+                        return delta_buf
+                    return jax.jit(pull)
+
+                def make_mc(mesh):
+                    def local_fn(x):
+                        i = jax.lax.axis_index("graph")
+                        return jax.lax.pmin(x + i, "graph")
+                    from jax.experimental.shard_map import shard_map
+                    return shard_map(
+                        local_fn, mesh=mesh,
+                        in_specs=(P("graph"),), out_specs=P("graph"),
+                    )
+            """,
+        },
+        packages=("openr_tpu",),
+    )
+    assert shard_check.run(project) == []
+
+
+def test_shardcheck_repo_declares_its_axes():
+    # the production multichip module passes its own contract: both
+    # mesh axes are declared, every collective sits under shard_map
+    project = Project(REPO_ROOT, ["openr_tpu"])
+    sf = project.file("openr_tpu/parallel/sharding.py")
+    assert shard_check._declared_axes(sf) >= {"batch", "graph"}
+    assert not [
+        f for f in shard_check.run(project)
+        if f.path == "openr_tpu/parallel/sharding.py"
+    ]
+
+
+# -- buffer donation -------------------------------------------------------
+
+DONATION_FIXTURE = """\
+    import jax
+
+    def _scatter_jit(donate=False):
+        def scatter(arr, idx, vals):
+            return arr.at[idx].set(vals)
+        if donate:
+            return jax.jit(scatter, donate_argnums=(0,))
+        return jax.jit(scatter)
+
+    class Solver:
+        def _scatter_counted(self, d_arr, idx, vals):
+            return _scatter_jit(True)(d_arr, idx, vals)
+
+        def bad(self, ad, idx, vals):
+            stale = self._scatter_counted(ad.d_w, idx, vals)
+            return stale, ad.d_w.shape       # seeded donated-read
+
+        def good(self, ad, idx, vals):
+            ad.d_w = self._scatter_counted(ad.d_w, idx, vals)
+            return ad.d_w.shape              # rebind idiom: fine
+"""
+
+
+def test_donation_flags_read_after_donate_through_wrappers(tmp_path):
+    project = make_project(
+        tmp_path,
+        {"openr_tpu/ops/fix_donation.py": DONATION_FIXTURE},
+        packages=("openr_tpu",),
+    )
+    findings = donation_check.run(project)
+    assert [(f.code, f.detail, f.scope) for f in findings] == [
+        ("donated-read", "ad.d_w", "Solver.bad"),
+    ]
+
+
+def test_donation_kwargs_dict_form_indexes_as_donating(tmp_path):
+    # _mc_scatter_jit's `{"donate_argnums": (0,)} if donate else {}`
+    # shape must index the factory as donating
+    project = make_project(
+        tmp_path,
+        {
+            "openr_tpu/ops/fix_donation_kw.py": """\
+                import jax
+
+                def _mc_scatter_jit(sharding, donate=False):
+                    def scatter(arr, idx, vals):
+                        return arr.at[idx].set(vals)
+                    kw = {"donate_argnums": (0,)} if donate else {}
+                    return jax.jit(scatter, **kw)
+
+                def syncs(buf, idx, vals, sh):
+                    out = _mc_scatter_jit(sh, True)(buf, idx, vals)
+                    return out + buf          # seeded donated-read
+            """,
+        },
+        packages=("openr_tpu",),
+    )
+    findings = donation_check.run(project)
+    assert [(f.code, f.detail) for f in findings] == [
+        ("donated-read", "buf"),
+    ]
+
+
+# -- pragma placement on decorated defs ------------------------------------
+
+def test_pragma_above_decorator_stack_covers_the_def(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            "openr_tpu/ops/fix_decorated.py": """\
+                import functools
+
+                import jax
+
+                # lint: allow(unbounded-jit-cache) fixture: blessed cache
+                @functools.lru_cache(maxsize=2)
+                @functools.wraps(print)
+                def cached(n):
+                    return jax.jit(lambda x: x * n)
+            """,
+        },
+        packages=("openr_tpu",),
+    )
+    findings = recompile_check.run(project)
+    assert codes(findings) == {"unbounded-jit-cache"}
+    # the finding anchors at the `def` line, below the whole decorator
+    # stack — the pragma above the first decorator must still cover it
+    allow = Allowlist.load(tmp_path / "missing.json")
+    assert apply_suppressions(findings, project, allow) == []
+
+
+# -- CLI: stale allowlist fails, --files narrows the report ----------------
+
+def test_unused_allowlist_entry_fails_full_run(tmp_path, capsys):
+    from tools.lint.__main__ import main as lint_main
+
+    al = tmp_path / "allowlist.json"
+    al.write_text(json.dumps({"entries": [
+        {"key": "openr_tpu/gone.py::f::broad-except::x",
+         "reason": "stale fixture entry"},
+    ]}))
+    rc = lint_main(["--allowlist", str(al)])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "unused allowlist entry" in err
+    assert "openr_tpu/gone.py::f::broad-except::x" in err
+
+
+def test_files_lane_narrows_report_and_skips_staleness(tmp_path, capsys):
+    # the diff-aware PR lane: a stale allowlist entry must NOT fail a
+    # partial report (it can't prove staleness), and findings outside
+    # the named files are filtered from the report
+    from tools.lint.__main__ import main as lint_main
+
+    al = tmp_path / "allowlist.json"
+    al.write_text(json.dumps({"entries": [
+        {"key": "openr_tpu/gone.py::f::broad-except::x",
+         "reason": "stale fixture entry"},
+    ]}))
+    rc = lint_main([
+        "--allowlist", str(al),
+        "--files", "openr_tpu/ops/relax.py",
+    ])
+    out = capsys.readouterr()
+    assert rc == 0, out.err
+    assert "unused allowlist entry" not in out.err
 
 
 # -- the repo itself runs clean --------------------------------------------

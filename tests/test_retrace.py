@@ -1,0 +1,177 @@
+"""ISSUE 15 device-contract sentinel tests: the retrace sentinel
+(ops/xla_cache.retrace) and the opt-in transfer guard.
+
+The sentinel tests force REAL XLA compiles (fresh `jax.jit` objects get
+fresh executable caches, so warmup is deterministic) and assert the
+warmup/retrace attribution rules: first compile per (namespace, kernel)
+is warmup, a later one is a counted retrace carrying a signature delta,
+and `forget()` resets a namespace back to warmup semantics. Arrays are
+built OUTSIDE the scopes — eager ops compile their own tiny executables
+and would otherwise be attributed to the scope under test.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.decision.tpu_solver import TpuSpfSolver
+from openr_tpu.models import topologies
+from openr_tpu.ops.xla_cache import retrace
+from openr_tpu.runtime.counters import counters
+from tests.test_tpu_solver import assert_rib_equal
+
+
+def _counter(key: str) -> float:
+    return counters.get_counter(key) or 0
+
+
+# -- retrace sentinel unit -------------------------------------------------
+
+
+class TestRetraceSentinel:
+    def test_warmup_then_fork_is_one_attributed_retrace(self):
+        retrace.reset()
+        before = _counter("xla_cache.retraces.probe")
+        f = jax.jit(lambda x: x * 2)
+        a = jnp.arange(8)
+        b = jnp.arange(16)
+
+        with retrace.scope("probe", "kern", (8,)):
+            f(a).block_until_ready()
+        assert retrace.drain_events() == []  # first compile = warmup
+
+        with retrace.scope("probe", "kern", (8,)):
+            f(a).block_until_ready()
+        assert retrace.drain_events() == []  # executable-cache hit
+
+        # same declared signature, new array shape: a trace-level fork
+        with retrace.scope("probe", "kern", (8,)):
+            f(b).block_until_ready()
+        events = retrace.drain_events()
+        assert len(events) == 1, events
+        evt = events[0]
+        assert evt["namespace"] == "probe"
+        assert evt["kernel"] == "kern"
+        assert "trace-level fork" in evt["signature_delta"]
+        assert _counter("xla_cache.retraces.probe") == before + 1
+
+    def test_signature_change_lands_in_the_delta(self):
+        retrace.reset()
+        f = jax.jit(lambda x: x + 1)
+        a = jnp.arange(8)
+        c = jnp.arange(32)
+        with retrace.scope("probe", "sig", (8,)):
+            f(a).block_until_ready()
+        retrace.drain_events()
+        # the fork crosses a DECLARED capacity boundary: the event names
+        # both signatures so triage sees which bucket edge was crossed
+        with retrace.scope("probe", "sig", (32,)):
+            f(c).block_until_ready()
+        events = retrace.drain_events()
+        assert len(events) == 1, events
+        assert "(8,)" in events[0]["signature_delta"]
+        assert "(32,)" in events[0]["signature_delta"]
+
+    def test_forget_resets_namespace_to_warmup(self):
+        retrace.reset()
+        f = jax.jit(lambda x: x - 1)
+        a = jnp.arange(8)
+        b = jnp.arange(16)
+        with retrace.scope("evicted", "kern", (8,)):
+            f(a).block_until_ready()
+        retrace.forget("evicted")  # bucket eviction dropped the exec
+        with retrace.scope("evicted", "kern", (16,)):
+            f(b).block_until_ready()
+        assert retrace.drain_events() == []  # regrowth = warmup again
+
+    def test_snapshot_carries_counts_census_and_recent_ring(self):
+        retrace.reset()
+        f = jax.jit(lambda x: x * 3)
+        a = jnp.arange(8)
+        b = jnp.arange(16)
+        with retrace.scope("snap", "kern", (8,)):
+            f(a).block_until_ready()
+        with retrace.scope("snap", "kern", (8,)):
+            f(b).block_until_ready()
+        retrace.note_class("snap", (8,))
+        retrace.note_class("snap", (16,))
+        snap = retrace.snapshot()
+        assert snap["retraces"] == {"snap": 1}
+        assert snap["classes"] == {"snap": 2}
+        # the recent ring RETAINS events drain_events() consumed — it is
+        # the `breeze tpu kernels` triage surface
+        retrace.drain_events()
+        recent = retrace.snapshot()["recent"]
+        assert [e["kernel"] for e in recent] == ["kern"]
+        assert "signature_delta" in recent[0]
+
+
+# -- Decision surfaces retraces as DEVICE_RETRACE LogSamples ---------------
+
+
+class TestDeviceRetraceLogSample:
+    def test_emit_retraces_pushes_sentinel_sample(self):
+        from openr_tpu.decision.decision import Decision
+
+        retrace.reset()
+        f = jax.jit(lambda x: x * 5)
+        a = jnp.arange(8)
+        b = jnp.arange(16)
+        with retrace.scope("emit", "kern", (8,)):
+            f(a).block_until_ready()
+        with retrace.scope("emit", "kern", (8,)):
+            f(b).block_until_ready()
+
+        class _Queue:
+            def __init__(self):
+                self.items = []
+
+            def push(self, sample):
+                self.items.append(sample)
+
+        d = Decision.__new__(Decision)
+        d.node_name = "node-0"
+        d.name = "decision"
+        d._log_samples = _Queue()
+
+        class _Span:
+            attributes = {}
+
+        sp = _Span()
+        d._emit_retraces(sp)
+        assert sp.attributes["device_retrace"] == 1
+        assert len(d._log_samples.items) == 1
+        sample = d._log_samples.items[0]
+        assert sample.event == "DEVICE_RETRACE"
+        assert sample.node_name == "node-0"
+        assert sample.values["category"] == "sentinel"
+        assert sample.values["namespace"] == "emit"
+        assert "signature_delta" in sample.values
+        # the queue was drained — a second emit is a no-op
+        d._emit_retraces(sp)
+        assert len(d._log_samples.items) == 1
+
+
+# -- transfer guard --------------------------------------------------------
+
+
+class TestTransferGuard:
+    def test_unknown_mode_rejected(self):
+        with pytest.raises(ValueError, match="transfer_guard"):
+            TpuSpfSolver("node-0", transfer_guard="loudly")
+
+    def test_disallow_mode_still_converges(self):
+        # the guard is a triage lever that must never break routing:
+        # root tables go up via explicit device_put, and any residual
+        # implicit transfer is caught, counted, and retried unguarded
+        adj_dbs, pfx = topologies.grid(4, node_labels=False)
+        states, ps = topologies.build_states(adj_dbs, pfx)
+        me = "node-1-1"
+        guarded = TpuSpfSolver(me, transfer_guard="disallow")
+        oracle = SpfSolver(me)
+        assert_rib_equal(
+            oracle.build_route_db(me, states, ps),
+            guarded.build_route_db(me, states, ps),
+            "transfer_guard=disallow",
+        )

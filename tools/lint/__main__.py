@@ -1,9 +1,15 @@
 """Entry point: `python -m tools.lint [--all] [--checker NAME ...]`.
 
-Runs the five project checkers over `openr_tpu/` (exit 1 on any
+Runs the eight project checkers over `openr_tpu/` (exit 1 on any
 unsuppressed finding); `--all` additionally shells out to ruff when it
 is installed (the CI lint lane installs it; a dev box without ruff
 gets a skip note, not a failure, since the container image is fixed).
+
+`--files a.py b.py` narrows the REPORT to findings in those files (the
+analysis still sees the whole package — the checkers are cross-file).
+This is the PR fast lane: lint only what the diff touched, with the
+unused-allowlist audit skipped (a partial report can't prove
+staleness). Pushes to main run the full `--all` lane.
 """
 
 from __future__ import annotations
@@ -14,7 +20,16 @@ import subprocess
 import sys
 from pathlib import Path
 
-from tools.lint import affinity, blocking, excepts, metric_names, purity
+from tools.lint import (
+    affinity,
+    blocking,
+    donation,
+    excepts,
+    metric_names,
+    purity,
+    recompile,
+    shardcheck,
+)
 from tools.lint.core import (
     DEFAULT_ALLOWLIST,
     REPO_ROOT,
@@ -29,6 +44,9 @@ CHECKERS = {
     "blocking": blocking.run,
     "excepts": excepts.run,
     "metric-names": metric_names.run,
+    "recompile": recompile.run,
+    "shardcheck": shardcheck.run,
+    "donation": donation.run,
 }
 
 
@@ -47,11 +65,22 @@ def _run_ruff() -> int | None:
     return proc.returncode
 
 
+def _normalize_rel(raw: str) -> str:
+    """A --files argument as a repo-relative forward-slash path."""
+    p = Path(raw)
+    if p.is_absolute():
+        try:
+            p = p.relative_to(REPO_ROOT)
+        except ValueError:
+            pass
+    return p.as_posix()
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="python -m tools.lint")
     ap.add_argument(
         "--checker", action="append", choices=sorted(CHECKERS),
-        help="run only the named checker(s); default: all five",
+        help="run only the named checker(s); default: all eight",
     )
     ap.add_argument(
         "--all", action="store_true",
@@ -64,6 +93,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument(
         "--package", default="openr_tpu",
         help="package directory to scan (default openr_tpu)",
+    )
+    ap.add_argument(
+        "--files", nargs="*", default=None, metavar="PATH",
+        help="report only findings in these files (diff-aware PR "
+        "lane); analysis still covers the whole package",
     )
     args = ap.parse_args(argv)
 
@@ -87,16 +121,26 @@ def main(argv: list[str] | None = None) -> int:
         findings.extend(sf.pragma_errors)
 
     remaining = apply_suppressions(findings, project, allowlist)
+    if args.files is not None:
+        wanted = {_normalize_rel(f) for f in args.files}
+        remaining = [fd for fd in remaining if fd.path in wanted]
     remaining.sort(key=lambda f: (f.path, f.line, f.code))
     for fd in remaining:
         print(fd.render(), file=sys.stderr)
     failures += len(remaining)
 
-    # stale allowlist entries rot into blanket permission — warn loudly
-    # (only when every checker ran; a partial run can't prove staleness)
-    if not args.checker:
+    # stale allowlist entries rot into blanket permission — a FAILURE,
+    # not a warning: the fix (delete the entry) is always one line
+    # (only when every checker saw every file; a partial run can't
+    # prove staleness)
+    if not args.checker and args.files is None:
         for key in allowlist.unused():
-            print(f"tools.lint: WARNING unused allowlist entry: {key}")
+            print(
+                f"tools.lint: unused allowlist entry: {key} — the "
+                f"finding it suppressed is gone; delete the entry",
+                file=sys.stderr,
+            )
+            failures += 1
 
     ruff_ran = False
     if args.all:

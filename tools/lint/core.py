@@ -1,6 +1,6 @@
 """Shared machinery for the `tools.lint` checkers.
 
-One parsed-AST pass over the package feeds all five checkers:
+One parsed-AST pass over the package feeds all eight checkers:
 
   - `SourceFile` — path, text, AST, per-line suppression pragmas, and a
     line -> enclosing-scope (dotted qualname) map.
@@ -105,6 +105,29 @@ class SourceFile:
                 self._pragmas.setdefault("broad-except", set()).update(
                     (i, i + 1)
                 )
+        self._extend_over_decorators()
+
+    def _extend_over_decorators(self) -> None:
+        """A pragma above a decorated def's FIRST decorator covers the
+        `def` line too. Findings anchor at the def's lineno, which for
+        a decorated def sits below the whole decorator stack — without
+        this, `# lint: allow(...)` placed where a human naturally puts
+        it (above the decorators) silently failed to suppress."""
+        stacks = [
+            (min(d.lineno for d in node.decorator_list), node.lineno)
+            for node in ast.walk(self.tree)
+            if isinstance(
+                node,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            )
+            and node.decorator_list
+        ]
+        for lines in self._pragmas.values():
+            extra = set()
+            for dec_start, def_line in stacks:
+                if any(dec_start <= c <= def_line for c in lines):
+                    extra.update(range(dec_start, def_line + 1))
+            lines |= extra
 
     def suppressed(self, code: str, line: int) -> bool:
         return line in self._pragmas.get(code, ())

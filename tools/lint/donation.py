@@ -1,0 +1,240 @@
+"""Buffer-donation checker (`donated-read`).
+
+`donate_argnums` hands an input buffer's HBM to XLA for in-place reuse
+— after dispatch the Python handle is deleted/invalid, and touching it
+again raises (GPU/TPU) or silently reads stale memory depending on
+backend and timing. The delta-sync path (`_sync_area` ->
+`_diff_scatter` -> `_scatter_counted` -> `_scatter_jit`/
+`_mc_scatter_jit`) donates the resident device array on every scatter,
+so the contract is: a donated expression must not be READ on any path
+after the donating call. The safe idiom is the same-statement rebind —
+
+    ad.d_shift_w = self._diff_scatter(ad.d_shift_w, ...)
+
+— where the stale handle is overwritten by the result in the very
+statement that donates it.
+
+Detection:
+
+1. Index donating callables:
+   - factories whose body jits with `donate_argnums=(...)` (including
+     the `{"donate_argnums": ...}` kwargs-dict form) — a call of the
+     factory's RESULT donates those positions;
+   - names bound directly to `jax.jit(f, donate_argnums=...)`;
+   - wrappers, to a fixpoint: a def that forwards one of its own
+     parameters into a donated position of a known donating callable
+     donates that parameter position to ITS callers (`self._...`
+     method calls shift positions by one for the receiver).
+2. Within each def, a statement that makes a donating call marks the
+   donated argument expressions dead from the end of that statement —
+   UNLESS the statement assigns the result back to the identical
+   expression (the rebind idiom), or is a `return` (control flow
+   leaves, nothing downstream on that path can read it).
+3. Any later load of a dead expression in the same def is flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.core import Finding, Project
+from tools.lint.purity import _is_traced_file, _terminal_name
+
+CODE = "donated-read"
+
+
+def _donated_positions(call: ast.Call) -> set[int] | None:
+    """donate_argnums positions declared on a jit call, else None."""
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            return _const_positions(kw.value)
+    return None
+
+
+def _const_positions(node: ast.AST) -> set[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = set()
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(
+                elt.value, int
+            ):
+                out.add(elt.value)
+        return out
+    return set()
+
+
+def _factory_donations(fn: ast.AST) -> set[int]:
+    """Donated positions of the callable a factory returns: union of
+    every `donate_argnums` its body declares, in keyword or
+    kwargs-dict form (a conditional `{"donate_argnums": (0,)} if
+    donate else {}` still donates on SOME path, which is what the
+    read-after rule cares about)."""
+    positions: set[int] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            pos = _donated_positions(node)
+            if pos:
+                positions |= pos
+        elif isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                if (
+                    isinstance(k, ast.Constant)
+                    and k.value == "donate_argnums"
+                ):
+                    positions |= _const_positions(v)
+    return positions
+
+
+def _param_names(fn) -> list[str]:
+    a = fn.args
+    return [arg.arg for arg in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+
+
+class _Index:
+    """Project-wide donating-callable index, name-granular (the repo
+    doesn't reuse factory/wrapper names across modules)."""
+
+    def __init__(self, project: Project):
+        # factory name -> donated positions of the returned callable
+        self.factories: dict[str, set[int]] = {}
+        # callable/wrapper name -> donated CALL-SITE arg positions
+        self.wrappers: dict[str, set[int]] = {}
+        self.defs: list[tuple] = []  # (SourceFile, def node)
+        for sf in project.files:
+            if not _is_traced_file(sf.rel):
+                continue
+            for node in ast.walk(sf.tree):
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    self.defs.append((sf, node))
+                    pos = _factory_donations(node)
+                    if pos:
+                        self.factories[node.name] = pos
+                elif isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call
+                ):
+                    # x = jax.jit(f, donate_argnums=(0,))
+                    pos = _donated_positions(node.value)
+                    if pos and len(node.targets) == 1 and isinstance(
+                        node.targets[0], ast.Name
+                    ):
+                        self.wrappers[node.targets[0].id] = pos
+        self._propagate()
+
+    def donated_args(self, call: ast.Call) -> list[ast.AST]:
+        """Argument expressions a call donates, or []."""
+        # factory double-call: Factory(...)(buf, ...)
+        if isinstance(call.func, ast.Call):
+            fname = _terminal_name(call.func.func)
+            pos = self.factories.get(fname or "")
+            if pos:
+                return [
+                    call.args[p] for p in pos if p < len(call.args)
+                ]
+            return []
+        name = _terminal_name(call.func)
+        pos = self.wrappers.get(name or "")
+        if pos:
+            return [call.args[p] for p in pos if p < len(call.args)]
+        return []
+
+    def _propagate(self) -> None:
+        changed = True
+        while changed:
+            changed = False
+            for _sf, fn in self.defs:
+                params = _param_names(fn)
+                is_method = bool(params) and params[0] in ("self", "cls")
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    for arg in self.donated_args(node):
+                        if not isinstance(arg, ast.Name):
+                            continue
+                        if arg.id not in params:
+                            continue
+                        p = params.index(arg.id)
+                        if is_method:
+                            p -= 1  # callers pass via `self.f(...)`
+                        if p < 0:
+                            continue
+                        got = self.wrappers.setdefault(fn.name, set())
+                        if p not in got:
+                            got.add(p)
+                            changed = True
+
+
+def _parents(tree: ast.AST) -> dict[ast.AST, ast.AST]:
+    par: dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            par[child] = node
+    return par
+
+
+def _stmt_of(node: ast.AST, parents: dict) -> ast.stmt | None:
+    while node is not None and not isinstance(node, ast.stmt):
+        node = parents.get(node)
+    return node
+
+
+def run(project: Project) -> list[Finding]:
+    index = _Index(project)
+    findings: list[Finding] = []
+    for sf, fn in index.defs:
+        parents = _parents(fn)
+        # (unparsed donated expr, dead-after line)
+        dead: list[tuple[str, int]] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            args = index.donated_args(node)
+            if not args:
+                continue
+            stmt = _stmt_of(node, parents)
+            if stmt is None or isinstance(stmt, ast.Return):
+                continue
+            rebinds: set[str] = set()
+            if isinstance(stmt, ast.Assign):
+                rebinds = {ast.unparse(t) for t in stmt.targets}
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                rebinds = {ast.unparse(stmt.target)}
+            for arg in args:
+                if not isinstance(arg, (ast.Name, ast.Attribute)):
+                    continue
+                expr = ast.unparse(arg)
+                if expr in rebinds:
+                    continue
+                dead.append((expr, stmt.end_lineno or stmt.lineno))
+        if not dead:
+            continue
+        for node in ast.walk(fn):
+            if not (
+                isinstance(node, (ast.Name, ast.Attribute))
+                and isinstance(node.ctx, ast.Load)
+            ):
+                continue
+            expr = ast.unparse(node)
+            for dexpr, after in dead:
+                if expr == dexpr and node.lineno > after:
+                    findings.append(Finding(
+                        sf.rel, node.lineno, CODE,
+                        sf.scope_at(node.lineno), dexpr,
+                        f"`{dexpr}` is read after being donated at "
+                        f"line {after} — the donated buffer's handle "
+                        f"is invalid after dispatch; rebind the "
+                        f"result onto the same expression in the "
+                        f"donating statement, or drop the later read",
+                    ))
+                    break
+    seen: set[tuple] = set()
+    out = []
+    for fd in findings:
+        k = (fd.path, fd.line, fd.code, fd.detail)
+        if k not in seen:
+            seen.add(k)
+            out.append(fd)
+    return out

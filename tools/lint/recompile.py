@@ -1,0 +1,258 @@
+"""Recompile-hygiene checker (`trace-capture`, `unbounded-jit-cache`).
+
+A jit-compiled pipeline is a pure function of its traced array args and
+its trace-time constants. Every OTHER value a traced closure reads is a
+recompile hazard: a Python scalar, bool, enum, or config read closed
+over at trace time is baked into the executable, so a later change
+either silently forks a cache class (same capacity signature, different
+program) or retraces — the ~8 s routing-stale stall the retrace
+sentinel (ops/xla_cache.retrace) exists to catch at runtime. This
+checker catches the shape statically:
+
+  - `trace-capture`: a name read inside traced code that resolves to
+    neither (a) a parameter/local of the traced function or any
+    enclosing factory function — i.e. part of the capacity signature /
+    static-arg set threaded through the factory — nor (b) a module
+    import, def, or class, nor (c) an ALL_CAPS module constant, nor
+    (d) a builtin. What remains is a mutable module global or an
+    unresolvable capture: exactly the values that fork cache classes
+    behind the factory key's back. This is the cross-check of the
+    `EdgePlan`/capacity-signature fields against what the closures in
+    `tpu_solver`, `relax`, `incremental`, `sweep`, `sharding`, `ucmp`,
+    and `ksp2` actually capture — anything not flowing through the
+    factory parameters is flagged.
+  - `unbounded-jit-cache`: `functools.lru_cache`/`functools.cache` on a
+    factory that builds jit/shard_map executables. An unbounded cache
+    never drops a superseded capacity bucket's executable (the slow HBM
+    leak bounded_jit_cache exists to stop), and it is invisible to the
+    per-namespace cache-class census — use
+    `ops.xla_cache.bounded_jit_cache(namespace=...)`.
+
+Traced-root discovery is shared with the purity checker
+(tools/lint/purity.py): roots are `@jit`-decorated defs plus every
+local function handed to a tracing combinator, closed over the
+same-module and `openr_tpu.ops.*` call graph.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+
+from tools.lint.core import Finding, Project, SourceFile
+from tools.lint.purity import (
+    _is_traced_file,
+    _ModuleGraph,
+    _propagate,
+    _terminal_name,
+    _TRACING_FUNCS,
+)
+
+CODE_CAPTURE = "trace-capture"
+CODE_UNBOUNDED = "unbounded-jit-cache"
+
+_BUILTINS = set(dir(builtins))
+_LRU_NAMES = {"lru_cache", "cache"}
+
+
+def _walk_shallow(fn: ast.AST):
+    """Yield `fn`'s body nodes without descending into nested defs —
+    each nested def is analyzed on its own pass with its own scope
+    chain. Lambdas and comprehensions ARE descended (they trace inline
+    and their params/targets fold into the local set)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _local_names(fn: ast.AST) -> set[str]:
+    """Parameter + locally-bound names of one def (shallow), including
+    lambda params and comprehension targets that appear inline."""
+    names: set[str] = set()
+    if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        a = fn.args
+        for arg in (*a.posonlyargs, *a.args, *a.kwonlyargs):
+            names.add(arg.arg)
+        if a.vararg:
+            names.add(a.vararg.arg)
+        if a.kwarg:
+            names.add(a.kwarg.arg)
+    for node in _walk_shallow(fn):
+        if isinstance(node, ast.Name) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            names.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            names.add(node.name)
+        elif isinstance(node, ast.Lambda):
+            a = node.args
+            for arg in (*a.posonlyargs, *a.args, *a.kwonlyargs):
+                names.add(arg.arg)
+        elif isinstance(node, ast.ExceptHandler) and node.name:
+            names.add(node.name)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                names.add((alias.asname or alias.name).split(".")[0])
+        elif isinstance(node, (ast.Global, ast.Nonlocal)):
+            names.update(node.names)
+    return names
+
+
+def _module_scope(sf: SourceFile) -> tuple[set[str], set[str]]:
+    """-> (static-safe module names, mutable module globals). Imports,
+    defs, classes, and ALL_CAPS assignments are static-safe; any other
+    module-level binding is a mutable global a traced closure must not
+    read."""
+    safe: set[str] = set()
+    mutable: set[str] = set()
+    for node in sf.tree.body:
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                safe.add((alias.asname or alias.name).split(".")[0])
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            safe.add(node.name)
+        elif isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for t in targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        if n.id.isupper() or n.id == "__all__":
+                            safe.add(n.id)
+                        else:
+                            mutable.add(n.id)
+        elif isinstance(node, (ast.If, ast.Try)):
+            # version-guarded imports / fallback defs
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.Import, ast.ImportFrom)):
+                    for alias in sub.names:
+                        safe.add(
+                            (alias.asname or alias.name).split(".")[0]
+                        )
+                elif isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)
+                ):
+                    safe.add(sub.name)
+    mutable -= safe
+    return safe, mutable
+
+
+def _flag_captures(
+    g: _ModuleGraph, findings: list[Finding]
+) -> None:
+    sf = g.sf
+    mod_safe, mod_mutable = _module_scope(sf)
+
+    def visit(node: ast.AST, chain: list):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if child.name in g.traced:
+                    _check_one(child, chain)
+                visit(child, chain + [child])
+            else:
+                visit(child, chain)
+
+    def _check_one(fn, chain):
+        allowed = _local_names(fn)
+        for enclosing in chain:
+            if isinstance(
+                enclosing, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                allowed |= _local_names(enclosing)
+        seen: set[str] = set()
+        for node in _walk_shallow(fn):
+            if not (
+                isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+            ):
+                continue
+            name = node.id
+            if (
+                name in allowed
+                or name in mod_safe
+                or name in _BUILTINS
+                or name in seen
+            ):
+                continue
+            seen.add(name)
+            if name in mod_mutable:
+                findings.append(Finding(
+                    sf.rel, node.lineno, CODE_CAPTURE,
+                    sf.scope_at(node.lineno), name,
+                    f"traced code reads mutable module global "
+                    f"`{name}` — its value freezes at trace time and a "
+                    f"later change silently forks the cache class or "
+                    f"retraces; thread it through the factory key (or "
+                    f"pragma if it is genuinely constant)",
+                ))
+            else:
+                findings.append(Finding(
+                    sf.rel, node.lineno, CODE_CAPTURE,
+                    sf.scope_at(node.lineno), name,
+                    f"traced code captures `{name}`, which is not a "
+                    f"factory parameter/local, module import/def, "
+                    f"ALL_CAPS constant, or builtin — a trace-time "
+                    f"capture outside the capacity signature",
+                ))
+
+    visit(sf.tree, [])
+
+
+def _flag_unbounded(sf: SourceFile, findings: list[Finding]) -> None:
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        has_lru = False
+        for dec in node.decorator_list:
+            tname = _terminal_name(dec)
+            if isinstance(dec, ast.Call):
+                tname = _terminal_name(dec.func)
+            if tname in _LRU_NAMES:
+                has_lru = True
+        if not has_lru:
+            continue
+        builds_exec = any(
+            isinstance(sub, ast.Call)
+            and _terminal_name(sub.func) in _TRACING_FUNCS
+            for sub in ast.walk(node)
+        )
+        if builds_exec:
+            findings.append(Finding(
+                sf.rel, node.lineno, CODE_UNBOUNDED,
+                sf.scope_at(node.lineno), node.name,
+                f"`{node.name}` caches jit executables through an "
+                f"unbounded functools cache — superseded capacity "
+                f"buckets never evict and the factory is invisible to "
+                f"the per-namespace cache-class census; use "
+                f"ops.xla_cache.bounded_jit_cache(namespace=...)",
+            ))
+
+
+def run(project: Project) -> list[Finding]:
+    graphs = {
+        sf.rel: _ModuleGraph(sf)
+        for sf in project.files
+        if _is_traced_file(sf.rel)
+    }
+    _propagate(graphs)
+    findings: list[Finding] = []
+    for g in graphs.values():
+        _flag_captures(g, findings)
+        _flag_unbounded(g.sf, findings)
+    seen: set[tuple] = set()
+    out = []
+    for fd in findings:
+        k = (fd.path, fd.line, fd.code, fd.detail)
+        if k not in seen:
+            seen.add(k)
+            out.append(fd)
+    return out

@@ -1,0 +1,271 @@
+"""Sharding-contract checker (`naked-collective`, `undeclared-axis`,
+`unconstrained-boundary`, `sharded-axis-roll`).
+
+The multichip tier has exactly one legal shape: collectives run inside
+a `shard_map` body against a mesh axis the mesh declares, and every
+buffer that crosses the shard_map/GSPMD boundary back into the
+replicated pipeline tail is pinned with `with_sharding_constraint`.
+Each rule here is a bug-shape the repo has already hit or that XLA
+miscompiles silently:
+
+  - `naked-collective`: `lax.pmin/pmax/psum/...`/`axis_index` outside
+    a function handed to `shard_map`. Under plain jit there is no
+    named axis — at best a trace error at first multichip solve, at
+    worst (nested vmap with a colliding axis name) a wrong-answer
+    reduction.
+  - `undeclared-axis`: a collective naming an axis string the module's
+    `Mesh(...)`/`P(...)` specs never declare — a typo'd axis traces
+    fine single-chip and explodes only on the multichip fabric.
+  - `unconstrained-boundary`: in mesh-aware traced code, a
+    `jnp.concatenate` result that is never re-pinned with
+    `with_sharding_constraint`. This is the exact PR 13 bug-shape:
+    GSPMD re-partitions the short concatenate and emits an
+    all-gather per consumer inside the sweep loop; the constraint on
+    the inputs does not reach back through the concatenate.
+  - `sharded-axis-roll`: `jnp.roll` with a traced (non-constant) shift
+    in mesh-aware GSPMD code outside shard_map. A traced shift along a
+    sharded axis lowers to an unreduced partial-sum — outputs come
+    back multiplied by the orthogonal mesh-axis size (the
+    `make_mc_sssp` docstring documents the miscompile; shard_map with
+    an explicit `lax.pmin` halo is the fix).
+
+Rules are path-insensitive on purpose: a constraint applied on ANY
+path (e.g. only `if mesh is not None`) counts, because the buffer only
+crosses a shard boundary when a mesh exists.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.core import Finding, Project, SourceFile
+from tools.lint.purity import (
+    _is_traced_file,
+    _ModuleGraph,
+    _propagate,
+    _terminal_name,
+)
+
+CODE_NAKED = "naked-collective"
+CODE_AXIS = "undeclared-axis"
+CODE_BOUNDARY = "unconstrained-boundary"
+CODE_ROLL = "sharded-axis-roll"
+
+_COLLECTIVES = {
+    "pmin", "pmax", "psum", "pmean", "all_gather", "all_to_all",
+    "ppermute", "pshuffle", "pbroadcast", "axis_index",
+}
+_SPEC_CALLS = {"Mesh", "P", "PartitionSpec"}
+
+
+def _shard_scope_spans(g: _ModuleGraph) -> list[tuple[int, int]]:
+    """Line spans of defs handed to `shard_map` (nested defs and the
+    combine lambdas live inside these spans, so a span test covers the
+    whole local-function closure). Name -> ALL same-named def nodes:
+    the factories each define their own `local_fn`, and the span set
+    must cover every one of them, not just the lexically last."""
+    by_name: dict[str, list[ast.AST]] = {}
+    for node in ast.walk(g.sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, []).append(node)
+    spans = []
+    for node in ast.walk(g.sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        tname = _terminal_name(node.func)
+        if tname == "partial" and node.args:
+            tname = _terminal_name(node.args[0])
+            fargs = node.args[1:]
+        else:
+            fargs = node.args
+        if tname != "shard_map":
+            continue
+        for arg in fargs:
+            aname = _terminal_name(arg)
+            for fn in by_name.get(aname or "", ()):
+                spans.append((fn.lineno, fn.end_lineno or fn.lineno))
+    return spans
+
+
+def _declared_axes(sf: SourceFile) -> set[str]:
+    axes: set[str] = set()
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _terminal_name(node.func) not in _SPEC_CALLS:
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Constant) and isinstance(
+                sub.value, str
+            ):
+                axes.add(sub.value)
+    return axes
+
+
+def _in_spans(line: int, spans: list[tuple[int, int]]) -> bool:
+    return any(lo <= line <= hi for lo, hi in spans)
+
+
+def _is_jnp_call(node: ast.Call, attr: str) -> bool:
+    f = node.func
+    return (
+        isinstance(f, ast.Attribute)
+        and f.attr == attr
+        and isinstance(f.value, ast.Name)
+        and f.value.id in ("jnp", "jax_numpy")
+    )
+
+
+def _axis_strings(node: ast.Call) -> list[ast.Constant]:
+    out = []
+    for arg in node.args:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            out.append(arg)
+    for kw in node.keywords:
+        if kw.arg in ("axis_name", "axis") and isinstance(
+            kw.value, ast.Constant
+        ) and isinstance(kw.value.value, str):
+            out.append(kw.value)
+    return out
+
+
+def _mesh_aware(fn: ast.AST, chain: list) -> bool:
+    """The def (or an enclosing factory) threads a `mesh` — only then
+    do GSPMD boundary rules apply."""
+    for scope in [fn, *chain]:
+        if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = scope.args
+            for arg in (*a.posonlyargs, *a.args, *a.kwonlyargs):
+                if arg.arg == "mesh":
+                    return True
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and node.id == "mesh":
+            return True
+    return False
+
+
+def _flag_collectives(
+    g: _ModuleGraph, spans: list, axes: set[str],
+    findings: list[Finding],
+) -> None:
+    sf = g.sf
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        tname = _terminal_name(node.func)
+        if tname not in _COLLECTIVES:
+            continue
+        scope = sf.scope_at(node.lineno)
+        if not _in_spans(node.lineno, spans):
+            findings.append(Finding(
+                sf.rel, node.lineno, CODE_NAKED, scope, tname,
+                f"`{tname}` outside a shard_map body — there is no "
+                f"named mesh axis here; under plain jit this traces "
+                f"to an error or, with a colliding vmap axis name, a "
+                f"wrong-answer reduction",
+            ))
+        for axis in _axis_strings(node):
+            if axis.value not in axes:
+                findings.append(Finding(
+                    sf.rel, node.lineno, CODE_AXIS, scope,
+                    f"{tname}:{axis.value}",
+                    f"`{tname}` names axis {axis.value!r}, which no "
+                    f"Mesh(...)/P(...) spec in this module declares — "
+                    f"a typo'd axis only fails on the multichip "
+                    f"fabric",
+                ))
+
+
+def _flag_boundaries(
+    g: _ModuleGraph, spans: list, findings: list[Finding]
+) -> None:
+    sf = g.sf
+
+    def visit(node: ast.AST, chain: list):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if child.name in g.traced and _mesh_aware(child, chain):
+                    _check_def(child)
+                visit(child, chain + [child])
+            else:
+                visit(child, chain)
+
+    def _check_def(fn):
+        # names this def ever pins with with_sharding_constraint
+        constrained: set[str] = set()
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Call)
+                and _terminal_name(node.func) == "with_sharding_constraint"
+                and node.args
+                and isinstance(node.args[0], ast.Name)
+            ):
+                constrained.add(node.args[0].id)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                tgt = node.targets[0].id
+                has_concat = any(
+                    isinstance(sub, ast.Call)
+                    and _is_jnp_call(sub, "concatenate")
+                    for sub in ast.walk(node.value)
+                )
+                if has_concat and tgt not in constrained and not _in_spans(
+                    node.lineno, spans
+                ):
+                    findings.append(Finding(
+                        sf.rel, node.lineno, CODE_BOUNDARY,
+                        sf.scope_at(node.lineno), tgt,
+                        f"`{tgt}` concatenates sharded inputs but is "
+                        f"never re-pinned with with_sharding_constraint "
+                        f"— GSPMD re-partitions the short concatenate "
+                        f"and emits an all-gather per consumer; the "
+                        f"constraint on the inputs does not reach back "
+                        f"through the concatenate",
+                    ))
+            elif isinstance(node, ast.Call) and _is_jnp_call(node, "roll"):
+                if _in_spans(node.lineno, spans):
+                    continue
+                shift = node.args[1] if len(node.args) > 1 else None
+                if shift is None:
+                    continue
+                static = isinstance(shift, ast.Constant) or (
+                    isinstance(shift, ast.UnaryOp)
+                    and isinstance(shift.operand, ast.Constant)
+                )
+                if not static:
+                    findings.append(Finding(
+                        sf.rel, node.lineno, CODE_ROLL,
+                        sf.scope_at(node.lineno), "roll",
+                        "jnp.roll with a traced shift in mesh-aware "
+                        "GSPMD code outside shard_map — a traced shift "
+                        "along a sharded axis lowers to an unreduced "
+                        "partial-sum (outputs multiplied by the "
+                        "orthogonal mesh-axis size); move it under "
+                        "shard_map with an explicit collective halo",
+                    ))
+
+    visit(sf.tree, [])
+
+
+def run(project: Project) -> list[Finding]:
+    graphs = {
+        sf.rel: _ModuleGraph(sf)
+        for sf in project.files
+        if _is_traced_file(sf.rel)
+    }
+    _propagate(graphs)
+    findings: list[Finding] = []
+    for g in graphs.values():
+        spans = _shard_scope_spans(g)
+        axes = _declared_axes(g.sf)
+        _flag_collectives(g, spans, axes, findings)
+        _flag_boundaries(g, spans, findings)
+    seen: set[tuple] = set()
+    out = []
+    for fd in findings:
+        k = (fd.path, fd.line, fd.code, fd.detail)
+        if k not in seen:
+            seen.add(k)
+            out.append(fd)
+    return out
