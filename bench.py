@@ -480,6 +480,125 @@ def bench_whatif(name, gen, me) -> dict:
     return res
 
 
+def bench_flapstorm(name, gen, me, events=100, rate_hz=100.0,
+                    flap_victims=8, small_graph_nodes=0, **solver_kw):
+    """Sustained flap-storm churn lane (streaming pipeline, ISSUE 16):
+    paced single-victim metric flaps at rate_hz through a
+    streaming_pipeline=True solver, each epoch's RIB delta programmed
+    into the mock FibService — churn-to-FIB-ack is flap-apply ->
+    programming ack, per-epoch download is last_timing's
+    bytes_downloaded (proportional to changed rows, not n). The closing
+    idle epoch (no flap) pins the standstill property: zero changed
+    rows, download still exactly one within-budget streaming payload."""
+    import asyncio as _asyncio
+
+    from openr_tpu.decision.tpu_solver import TpuSpfSolver
+    from openr_tpu.fib.fib_service import MockFibService
+    from openr_tpu.models import topologies
+    from openr_tpu.runtime.counters import _percentile
+
+    t0 = time.perf_counter()
+    adj_dbs, prefix_dbs = gen()
+    states, ps = topologies.build_states(adj_dbs, prefix_dbs)
+    area = next(iter(states))
+    log(f"[{name}] {len(adj_dbs)} nodes "
+        f"({time.perf_counter() - t0:.1f}s build)")
+
+    tpu = TpuSpfSolver(me, small_graph_nodes=small_graph_nodes,
+                       streaming_pipeline=True, **solver_kw)
+    db = tpu.build_route_db(me, states, ps)  # cold seed: full pull
+    full_bytes = int(
+        getattr(tpu, "last_timing", {}).get("bytes_downloaded") or 0
+    )
+    # warm the streamed epoch executable before pacing starts — the
+    # storm measures steady-state churn, not the one-time jit compile
+    _flap(states, adj_dbs, [1], 7919, area)
+    db = tpu.build_route_db(me, states, ps)
+    from openr_tpu.runtime.counters import counters as _counters
+
+    # post-boot retraces over the storm (summed across namespaces, so
+    # the new "stream" namespace is covered): a warm steady state must
+    # report 0 — the smoke test gates on it
+    retrace0 = sum(_counters.get_counters("xla_cache.retraces.").values())
+    svc = MockFibService()
+    victims = list(range(1, flap_victims + 1))
+    interval = 1.0 / rate_hz
+
+    async def _storm():
+        nonlocal db
+        acks, dl_bytes, rows, engaged, overflows = [], [], [], 0, 0
+        start = time.perf_counter()
+        for i in range(events):
+            target = start + i * interval
+            delay = target - time.perf_counter()
+            if delay > 0:
+                await _asyncio.sleep(delay)
+            _flap(states, adj_dbs, [victims[i % len(victims)]], i, area)
+            t_ev = time.perf_counter()
+            new_db = tpu.build_route_db(me, states, ps)
+            update = db.calculate_update(new_db)
+            # force ONLY the changed rows (lazy column map) and program
+            # them — the real Fib actor's incremental add/delete path
+            changed = list(update.unicast_routes_to_update.values())
+            if changed:
+                await svc.add_unicast_routes(0, changed)
+            if update.unicast_routes_to_delete:
+                await svc.delete_unicast_routes(
+                    0, update.unicast_routes_to_delete
+                )
+            acks.append((time.perf_counter() - t_ev) * 1e3)
+            db = new_db
+            tm = getattr(tpu, "last_timing", {})
+            dl_bytes.append(int(tm.get("bytes_downloaded") or 0))
+            st = tm.get("stream") or {}
+            if st.get("epochs"):
+                engaged += 1
+                overflows += int(st.get("overflows") or 0)
+            rows.append(int(st.get("changed_rows") or 0))
+        wall_s = time.perf_counter() - start
+        return acks, dl_bytes, rows, engaged, overflows, wall_s
+
+    acks, dl_bytes, rows, engaged, overflows, wall_s = (
+        _asyncio.run(_storm())
+    )
+    # idle epoch: nothing changed since the last solve — the streaming
+    # payload still ships (count=0), so the download stands still at
+    # exactly one within-budget payload
+    tpu.build_route_db(me, states, ps)
+    tm = getattr(tpu, "last_timing", {})
+    idle_bytes = int(tm.get("bytes_downloaded") or 0)
+    idle_rows = int((tm.get("stream") or {}).get("changed_rows") or 0)
+
+    sa, sb = sorted(acks), sorted(dl_bytes)
+    res = {
+        "nodes": len(adj_dbs),
+        "events": events,
+        "rate_hz": rate_hz,
+        "achieved_rate_hz": round(events / wall_s, 1) if wall_s else None,
+        "ack_p50_ms": round(_percentile(sa, 50.0), 2),
+        "ack_p99_ms": round(_percentile(sa, 99.0), 2),
+        "bytes_downloaded_per_epoch": int(_percentile(sb, 50.0)),
+        "bytes_downloaded_max": max(dl_bytes) if dl_bytes else 0,
+        "full_plane_bytes": full_bytes,
+        "idle_bytes_downloaded": idle_bytes,
+        "idle_changed_rows": idle_rows,
+        "changed_rows_max": max(rows) if rows else 0,
+        "stream_engaged": engaged,
+        "stream_overflows": overflows,
+        "fib_routes": len(svc.unicast),
+        "retraces": int(
+            sum(_counters.get_counters("xla_cache.retraces.").values())
+            - retrace0
+        ),
+    }
+    log(f"[{name}] flapstorm: ack p50 {res['ack_p50_ms']} / p99 "
+        f"{res['ack_p99_ms']} ms at {res['achieved_rate_hz']} ev/s "
+        f"(asked {rate_hz}) / dl {res['bytes_downloaded_per_epoch']} B "
+        f"per epoch (full {full_bytes} B) / idle {idle_bytes} B "
+        f"/ engaged {engaged}/{events}")
+    return res
+
+
 def _ledger_record(name: str, res: dict) -> None:
     """Append one config's headline numbers to the perf ledger — no-op
     unless $OPENR_TPU_PERF_LEDGER points somewhere, so bare bench runs
@@ -495,7 +614,9 @@ def _ledger_record(name: str, res: dict) -> None:
         k: res[k]
         for k in ("compile_ms", "full_ms", "device_ms", "tpu_ms",
                   "exec_overhead_ms", "peak_hbm_mb", "cold_program_ms",
-                  "incr_device_ms", "boot_first_rib_ms")
+                  "incr_device_ms", "boot_first_rib_ms",
+                  "ack_p50_ms", "ack_p99_ms",
+                  "bytes_downloaded_per_epoch")
         if isinstance(res.get(k), (int, float))
     }
     if obs:
@@ -636,6 +757,18 @@ def main() -> None:
             "node-16-16",
         )
 
+    # streaming churn lane at 1k (CI-friendly size, same code path as
+    # the 100k headline below): runs only when named — the quick CI
+    # gate calls `--only=flapstorm_tg1k` and perf_diffs the committed
+    # BENCH_FLAPSTORM baseline
+    if only == "flapstorm_tg1k":
+        configs["flapstorm_tg1k"] = bench_flapstorm(
+            "flapstorm_tg1k",
+            lambda: topologies.grid(32, node_labels=False),
+            "node-16-16", events=60, rate_hz=100.0,
+        )
+        _ledger_record("flapstorm_tg1k", configs["flapstorm_tg1k"])
+
     # cold-start lane: boot-to-first-RIB through the full node stack
     # (skipped in --only runs that name another config)
     if only in (None, "boot"):
@@ -650,7 +783,11 @@ def main() -> None:
         print(json.dumps({
             "metric": f"full_rib_recompute_{name}_ms",
             "value": out.get(
-                "tpu_ms", out.get("sweep_ms", out.get("boot_first_rib_ms"))
+                "tpu_ms",
+                out.get(
+                    "sweep_ms",
+                    out.get("boot_first_rib_ms", out.get("ack_p99_ms")),
+                ),
             ),
             "unit": "ms",
             "vs_baseline": out.get("speedup", 1.0),
@@ -693,6 +830,18 @@ def main() -> None:
     )
     if r5 is not None:
         headline = ("full_rib_recompute_100k_ms", r5[1], r5[2])
+
+    # 5a: sustained flap storm at the 100k headline scale — the
+    # streaming pipeline's churn-to-FIB-ack distribution and per-epoch
+    # download (ISSUE 16 acceptance: p99 < 25 ms on the TPU rig, bytes
+    # proportional to changed rows)
+    if only in (None, "flapstorm100k"):
+        configs["flapstorm100k"] = bench_flapstorm(
+            "flapstorm100k",
+            lambda: topologies.grid(316, node_labels=False),
+            "node-158-158", events=200, rate_hz=100.0,
+        )
+        _ledger_record("flapstorm100k", configs["flapstorm100k"])
 
     # 5b: the SAME 100k LSDB forced through the multichip capacity tier
     # (n_cap 131072 sits exactly AT the default threshold, so halving it
@@ -830,6 +979,16 @@ def main() -> None:
         "boot_first_rib_ms": configs.get("boot", {}).get(
             "boot_first_rib_ms"
         ),
+        # streaming-churn headline (ISSUE 16): flap-apply -> FIB ack
+        # p99 under a sustained 100-events/s storm at 100k, plus the
+        # changed-rows-proportional per-epoch download beside the full
+        # plane it replaces
+        "churn_to_fib_ack_p99_ms_100k": configs.get(
+            "flapstorm100k", {}
+        ).get("ack_p99_ms"),
+        "stream_bytes_per_epoch_100k": configs.get(
+            "flapstorm100k", {}
+        ).get("bytes_downloaded_per_epoch"),
         "rtt_note": "e2e = device_ms + host sync/mat + rig RTT; RTT is the tunnel's, not the design's",
         "configs": configs,
     }))

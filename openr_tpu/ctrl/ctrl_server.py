@@ -306,8 +306,34 @@ class CtrlServer(Actor):
                 "device_halo_exchanges": device_stats.get(
                     "decision.device.halo_exchanges", {}
                 ),
+                "device_bytes_downloaded": device_stats.get(
+                    "decision.device.bytes_downloaded", {}
+                ),
             },
         }
+        # device-kernel rows for the LAST solve, whatever its shape —
+        # solver.last_timing is refreshed by every device collect
+        # (full, incremental seed-from-previous, streamed epoch), so
+        # these render after an incremental solve too, where the
+        # windowed stats above can have already aged out
+        solver = (
+            getattr(self.decision, "solver", None)
+            if self.decision is not None
+            else None
+        )
+        tm = getattr(solver, "last_timing", None)
+        if isinstance(tm, dict) and tm:
+            last = {
+                k: tm[k]
+                for k in ("spf_kernel", "rounds", "bucket_epochs",
+                          "halo_exchanges", "incremental",
+                          "bytes_uploaded", "bytes_downloaded")
+                if tm.get(k) is not None
+            }
+            # streamed churn epochs: budget use + changed-rows download
+            if isinstance(tm.get("stream"), dict):
+                last["stream"] = tm["stream"]
+            out["solver"]["last_solve"] = last
         if fleet:
             out["fleet"] = await self._fleet_convergence()
         return out

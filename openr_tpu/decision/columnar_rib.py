@@ -316,7 +316,11 @@ class ColumnarRib:
         # oldest epoch the journal can still diff against; reset by
         # set_full_packed and by journal trimming
         self.journal_floor = 0
-        self.journal: list[tuple[int, np.ndarray]] = []
+        # (epoch, rows, exact): `exact` marks a device-exact entry —
+        # the row set IS the set of rows whose columns differ from the
+        # previous epoch (the streaming pipeline's on-device diff), not
+        # a superset a consumer must re-compare
+        self.journal: list[tuple[int, np.ndarray, bool]] = []
         self.routes: dict[str, RibUnicastEntry] = {}
         # routes is COMPLETE iff materialized; otherwise it is a partial
         # per-row cache (invalidated row-wise by apply_rows)
@@ -387,9 +391,15 @@ class ColumnarRib:
         )
 
     def apply_rows(self, rows: np.ndarray, met, s3w, nhw,
-                   lfa_slot=None, lfa_metric=None) -> None:
+                   lfa_slot=None, lfa_metric=None, ok=None,
+                   exact: bool = False) -> None:
         """Steady-state delta: patch the changed rows in place (after
-        copy-on-write if a snapshot is watching)."""
+        copy-on-write if a snapshot is watching). When `ok` is None
+        (classic delta payload) the route-level filter is recomputed
+        host-side, which costs an unpack of both word planes; a caller
+        holding the device route-ok bit (apply_rows_packed) passes it
+        in and the unpack only happens if the eager route cache needs
+        the masks."""
         rows = np.asarray(rows)
         live = rows < self.p_n
         if not live.all():
@@ -397,6 +407,8 @@ class ColumnarRib:
             met = met[live]
             s3w = s3w[live]
             nhw = nhw[live]
+            if ok is not None:
+                ok = ok[live]
             if lfa_slot is not None:
                 lfa_slot = lfa_slot[live]
                 lfa_metric = lfa_metric[live]
@@ -406,11 +418,14 @@ class ColumnarRib:
         c = self.cols
         a_cap = self.matrix.ann_node.shape[1]
         d_n = len(self.links)
-        s3 = unpack_words(s3w, a_cap)
-        nhm = unpack_words(nhw, max(d_n, 1))
-        ok = route_ok_rows(
-            self.matrix, self.root_idx, rows, met, s3, nhm, self.block_v4
-        )
+        s3 = nhm = None
+        if ok is None:
+            s3 = unpack_words(s3w, a_cap)
+            nhm = unpack_words(nhw, max(d_n, 1))
+            ok = route_ok_rows(
+                self.matrix, self.root_idx, rows, met, s3, nhm,
+                self.block_v4,
+            )
         c.met[rows] = met
         c.s3w[rows] = s3w
         c.nhw[rows] = nhw
@@ -421,15 +436,18 @@ class ColumnarRib:
         c._key_rows = None
         c._row_of = None
         self.epoch += 1
-        self.journal.append((self.epoch, np.asarray(rows)))
+        self.journal.append((self.epoch, np.asarray(rows), exact))
         if len(self.journal) > _JOURNAL_MAX:
-            dropped_epoch, _ = self.journal.pop(0)
+            dropped_epoch, _, _ = self.journal.pop(0)
             self.journal_floor = dropped_epoch
         # keep the route cache coherent: eager patch when complete
         # (preserves the seed's O(changed) steady-state cost), row-wise
         # invalidation when partial
         plist = self.matrix.prefix_list
         if self.materialized:
+            if s3 is None:
+                s3 = unpack_words(s3w, a_cap)
+                nhm = unpack_words(nhw, max(d_n, 1))
             for i, r in enumerate(rows.tolist()):
                 if not ok[i]:
                     self.routes.pop(plist[r], None)
@@ -445,16 +463,41 @@ class ColumnarRib:
             for r in rows.tolist():
                 self.routes.pop(plist[r], None)
 
+    def apply_rows_packed(self, rows: np.ndarray, met, s3w, nhw, ok,
+                          lfa_slot=None, lfa_metric=None) -> None:
+        """Streaming-epoch delta (ops/stream.py payload): the device
+        route-ok bit arrives with the rows, so the patch is pure column
+        writes — no host word-unpack, no route_ok_rows recompute — and
+        the journal entry is device-exact: the row set is EXACTLY the
+        rows whose columns differ from the previous epoch, which lets
+        fast_unicast_column_diff skip its re-compare (exact_since)."""
+        self.apply_rows(
+            rows, met, s3w, nhw, lfa_slot, lfa_metric,
+            ok=np.asarray(ok, bool), exact=True,
+        )
+
     # -- reads (view side) -------------------------------------------------
 
     def covers(self, epoch: int) -> bool:
         return epoch >= self.journal_floor
 
     def changed_rows_since(self, epoch: int) -> np.ndarray:
-        parts = [r for e, r in self.journal if e > epoch]
+        parts = [r for e, r, _x in self.journal if e > epoch]
         if not parts:
             return np.zeros(0, np.int64)
         return np.unique(np.concatenate(parts))
+
+    def exact_since(self, epoch: int) -> bool:
+        """True iff the journal from `epoch` to the tip is ONE
+        device-exact entry — the streaming steady state, one epoch per
+        solve. The on-device diff is exact against the IMMEDIATELY
+        preceding epoch only: across several epochs the union may hold
+        rows that changed and changed back, which only a host
+        re-compare filters out. When this holds,
+        fast_unicast_column_diff consumes changed_rows_since verbatim
+        instead of re-comparing the columns."""
+        entries = [x for e, _r, x in self.journal if e > epoch]
+        return len(entries) == 1 and entries[0]
 
     def _build_rows_into(self, cols: _Cols, rows: np.ndarray,
                          routes: dict) -> None:

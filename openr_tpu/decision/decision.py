@@ -126,6 +126,7 @@ def make_solver(
         kwargs.pop("multichip_batch", None)
         kwargs.pop("spf_kernel", None)
         kwargs.pop("transfer_guard", None)
+        kwargs.pop("streaming_pipeline", None)
         return SpfSolver(node_name, **kwargs)
     if backend in ("tpu", "auto"):
         try:
@@ -149,6 +150,7 @@ def make_solver(
             kwargs.pop("multichip_batch", None)
             kwargs.pop("spf_kernel", None)
             kwargs.pop("transfer_guard", None)
+            kwargs.pop("streaming_pipeline", None)
             return SpfSolver(node_name, **kwargs)
     raise ValueError(f"unknown solver backend {backend!r}")
 
@@ -211,6 +213,9 @@ class Decision(Actor):
             skw.setdefault("multichip_batch", config.multichip_batch)
             skw.setdefault("spf_kernel", config.spf_kernel)
             skw.setdefault("transfer_guard", config.transfer_guard)
+            skw.setdefault(
+                "streaming_pipeline", config.streaming_pipeline
+            )
         self.solver = make_solver(
             node_name,
             backend,
@@ -248,6 +253,19 @@ class Decision(Actor):
         self._provenance = ProvenanceLedger()
         self._ingest_tags: dict[str, tuple] = {}
         self._solve_epoch = 0
+        # streaming-pipeline epoch overlap: with
+        # cfg.streaming_pipeline + async_dispatch, epoch N's finish
+        # (RIB diff, provenance stamp, FIB push) runs as a deferred
+        # loop task chained on the previous finish, so the dispatch
+        # fiber may admit epoch N+1's coalesced delta while N's
+        # netlink program is still in flight. _fence_gen is the epoch
+        # fence: bumped whenever the world a deferred finish solved
+        # against may no longer hold (dispatch-fiber crash, degraded
+        # failover) — a finish whose captured fence is stale discards
+        # itself instead of programming a stale batch.
+        self._fence_gen = 0
+        self._stream_finish: Optional[asyncio.Task] = None
+        self._finish_done_t = 0.0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -278,12 +296,20 @@ class Decision(Actor):
         on the loop), but batched/queued pending updates may have been
         lost — force a full rebuild so the next debounce re-derives
         routes from scratch."""
+        if task_name.endswith(".dispatch"):
+            # the crash orphans any deferred streaming finish still
+            # chained on the loop: its solve predates whatever state
+            # the fiber lost, so fence it out — it must not program a
+            # batch over the full rebuild forced below
+            self._fence_gen += 1
         self.pending.needs_full_rebuild = True
         self._trigger_rebuild()
 
     async def on_stop(self) -> None:
         if self._rebuild_debounced is not None:
             self._rebuild_debounced.cancel()
+        if self._stream_finish is not None:
+            self._stream_finish.cancel()
 
     # -- queue consumption -------------------------------------------------
 
@@ -562,13 +588,94 @@ class Decision(Actor):
     async def _rebuild_async(self, pending: PendingUpdates) -> None:
         """Dispatch-fiber rebuild: identical to _rebuild except the full
         solve's one blocking host sync runs off-loop (_solve_full_async),
-        so LSDB ingestion continues during the device round trip."""
+        so LSDB ingestion continues during the device round trip.
+
+        With the streaming pipeline on, the finish itself (RIB diff,
+        provenance, FIB push) also leaves the dispatch fiber: it defers
+        onto the loop chained behind the previous epoch's finish, so the
+        fiber loops back to admit the next coalesced LSDB delta while
+        the previous epoch's netlink program is still in flight. Only
+        finishes overlap — dispatch N+1 never starts before collect N
+        (the solver's vantage state is single-flight by construction)."""
         ctx, spf_sp, full, t0 = self._begin_rebuild(pending)
         if full:
             new_db = await self._solve_full_async(ctx, spf_sp)
         else:
             new_db = self._incremental_db(pending)
+        if (
+            self.cfg.streaming_pipeline
+            and full
+            and not self._degraded
+            and new_db is not None
+        ):
+            self._defer_finish(pending, ctx, spf_sp, t0, new_db, full)
+            return
+        # non-overlapping finish: drain the chain first — the diff in
+        # _finish_rebuild runs against self.route_db, which a deferred
+        # predecessor still owns until it lands
+        if self._stream_finish is not None:
+            try:
+                await self._stream_finish
+            # lint: allow(broad-except) predecessor already logged it
+            except Exception:  # pragma: no cover - logged at source
+                pass
         self._finish_rebuild(pending, ctx, spf_sp, t0, new_db, full)
+
+    def _defer_finish(
+        self, pending: PendingUpdates, ctx, spf_sp, t0, new_db, full
+    ) -> None:
+        """Queue epoch N's finish as a loop task behind epoch N-1's.
+        Finishes stay strictly ordered (each awaits its predecessor), so
+        acks and provenance stamps attribute to the right epoch; the
+        captured fence generation lets a finish whose world moved on
+        (fiber restart, degraded flip) discard itself and requeue a
+        full rebuild instead of programming a stale batch."""
+        prev = self._stream_finish
+        fence = self._fence_gen
+
+        async def _finish() -> None:
+            if prev is not None:
+                try:
+                    await prev
+                # lint: allow(broad-except) predecessor logged it
+                except Exception:  # pragma: no cover - logged at source
+                    pass
+            try:
+                if self._fence_gen != fence:
+                    counters.increment("decision.stream.fenced")
+                    if spf_sp is not None:
+                        spf_sp.attributes["fenced"] = True
+                        tracer.end_span(spf_sp)
+                    tracer.end_trace(ctx, status="fenced")
+                    self.pending.needs_full_rebuild = True
+                    self._trigger_rebuild()
+                    return
+                # overlap won: how far past this epoch's solve START the
+                # previous finish (and its FIB program) was still
+                # running — 0 when the pipeline had already drained
+                overlap_ms = max(0.0, (self._finish_done_t - t0) * 1e3)
+                if prev is not None and overlap_ms > 0:
+                    counters.add_stat_value(
+                        "decision.stream.overlap_ms", overlap_ms
+                    )
+                    if spf_sp is not None:
+                        spf_sp.attributes["overlap_ms"] = round(
+                            overlap_ms, 3
+                        )
+                self._finish_rebuild(pending, ctx, spf_sp, t0, new_db, full)
+            # lint: allow(broad-except) fiber-equivalent crash recovery
+            except Exception:
+                log.exception(
+                    "%s: deferred epoch finish failed; forcing a full "
+                    "rebuild", self.name,
+                )
+                counters.increment("decision.stream.finish_errors")
+                self.pending.needs_full_rebuild = True
+                self._trigger_rebuild()
+            finally:
+                self._finish_done_t = time.perf_counter()
+
+        self._stream_finish = asyncio.ensure_future(_finish())
 
     def _finish_rebuild(
         self, pending: PendingUpdates, ctx, spf_sp, t0, new_db, full=True
@@ -610,6 +717,7 @@ class Decision(Actor):
         counters.increment("decision.route_builds")
         self._solve_epoch += 1
         counters.set_counter("decision.solve_epoch", self._solve_epoch)
+        update.solve_epoch = self._solve_epoch
         self._stamp_provenance(update, pending, full)
 
         if not self._first_build_done:
@@ -775,6 +883,9 @@ class Decision(Actor):
 
     def _enter_degraded(self, exc: Exception) -> None:
         self._degraded = True
+        # epoch fence: any deferred streaming finish solved on the
+        # now-suspect primary; discard rather than program its batch
+        self._fence_gen += 1
         counters.set_counter("decision.solver.degraded", 1)
         counters.increment("decision.solver.failovers")
         log.error(
@@ -975,10 +1086,19 @@ class Decision(Actor):
         # on every device solve; bucket epochs / halo exchanges when the
         # bucketed kernel or the multichip tier engaged
         for key in ("spf_kernel", "rounds", "bucket_epochs",
-                    "halo_exchanges"):
+                    "halo_exchanges", "bytes_downloaded"):
             v = tm.get(key)
             if v:
                 spf_sp.attributes[key] = v
+        st = tm.get("stream")
+        if isinstance(st, dict):
+            # streamed churn epochs (changed-rows-only download): the
+            # span carries the per-solve totals; the running counters
+            # are decision.stream.{epochs,changed_rows,bytes_downloaded}
+            spf_sp.attributes["stream_epochs"] = st.get("epochs")
+            spf_sp.attributes["stream_changed_rows"] = st.get(
+                "changed_rows"
+            )
         areas = tm.get("areas") or {"": tm}
         cursor = spf_sp.end
         for area, stages in sorted(areas.items(), reverse=True):
@@ -1017,7 +1137,8 @@ class Decision(Actor):
                 if total:
                     attrs[out] = round(total, 3)
             for key in ("spf_kernel", "rounds", "bucket_epochs",
-                        "bytes_uploaded", "multichip"):
+                        "bytes_uploaded", "bytes_downloaded",
+                        "multichip"):
                 if tm.get(key):
                     attrs[key] = tm[key]
         # deferred: ops pulls in the device toolchain (same pattern as

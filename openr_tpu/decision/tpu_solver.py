@@ -370,7 +370,8 @@ def _make_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
                    lfa: bool = False, block_v4: bool = False,
                    sentinels: bool = True, emit_dist: bool = False,
                    incr: bool = False, mesh=None,
-                   kernel: str = "sync", delta_exp: int = 0):
+                   kernel: str = "sync", delta_exp: int = 0,
+                   stream: int = 0):
     """The fused production pipeline (raw closure — _plan_pipeline jits
     it for the single-area path, _fused_pipeline vmaps it over a group
     of same-shape areas). Outputs:
@@ -404,12 +405,22 @@ def _make_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
     handles fine (it is only the SSSP's dynamic roll it miscompiles;
     see make_mc_sssp). Fixpoint uniqueness keeps the output
     bit-identical to the single-chip tier.
+
+    With `stream` nonzero (a STREAM_BUDGETS bucket) this is the
+    streaming-epoch kernel (jit-cache namespace "stream"): the delta
+    payload uses the small bucketed budget instead of the classic
+    _DELTA_BUDGET and carries the device route-ok bit per changed row
+    (ops/stream.py layout), so the host applies the rows without
+    unpacking words. The changed mask and compaction are the SAME
+    ops/stream.py stages the classic delta path runs — parity by
+    construction.
     """
     import jax
     import jax.numpy as jnp
 
     from openr_tpu.ops.compact import route_ok_device
     from openr_tpu.ops.incremental import incremental_sssp
+    from openr_tpu.ops.stream import column_diff, compact_changed_rows
 
     wa = -(-a_cap // 16)
     wd = -(-d_cap // 16)
@@ -557,32 +568,25 @@ def _make_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
         s3w = _pack_words(s3)
         nhw = _pack_words(nh_mask)
 
-        changed = (
-            (metric != prev_metric)
-            | jnp.any(s3w != prev_s3w, axis=1)
-            | jnp.any(nhw != prev_nhw, axis=1)
-        )
-        if lfa:
-            changed |= (lfa_slot != prev_lfa_slot) | (
-                lfa_metric != prev_lfa_metric
-            )
-        count = changed.sum().astype(jnp.int32)
-        cidx = jnp.nonzero(changed, size=budget, fill_value=p_cap)[0]
-        safe = jnp.clip(cidx, 0, p_cap - 1).astype(jnp.int32)
-        delta_parts = [
-            count[None],
-            trips[None].astype(jnp.int32),
-            cidx.astype(jnp.int32),
-            metric[safe],
-            s3w[safe].ravel(),
-            nhw[safe].ravel(),
-        ]
-        # cold-rebuild compaction: route-level ok computed on device;
-        # only ok rows' outputs ship (gathered to the front — pad slots
-        # past okc carry the last ok row's values and are ignored)
+        # route-level ok computed on device: compacts the cold full
+        # pull to ok rows, and on the streaming path rides the delta
+        # payload per changed row (the host apply is then unpack-free)
         ok = route_ok_device(
             metric, s3, nh_mask, ann_node, min_nh, v4_blocked, root,
         )
+        changed = column_diff(
+            metric, s3w, nhw, lfa_slot, lfa_metric,
+            prev_metric, prev_s3w, prev_nhw,
+            prev_lfa_slot, prev_lfa_metric, lfa,
+        )
+        count, delta_parts = compact_changed_rows(
+            changed, trips, metric, s3w, nhw,
+            ok if stream else None,
+            lfa_slot, lfa_metric, stream or budget, p_cap, lfa,
+        )
+        # cold-rebuild compaction: only ok rows' outputs ship (gathered
+        # to the front — pad slots past okc carry the last ok row's
+        # values and are ignored)
         okc = ok.sum().astype(jnp.int32)
         oidx = jnp.nonzero(ok, size=p_cap, fill_value=p_cap)[0]
         osafe = jnp.clip(oidx, 0, p_cap - 1).astype(jnp.int32)
@@ -595,7 +599,7 @@ def _make_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
             nhw[osafe].ravel(),
         ]
         if lfa:
-            delta_parts += [lfa_slot[safe], lfa_metric[safe]]
+            # delta-side lfa columns already rode compact_changed_rows
             full_parts += [lfa_slot[osafe], lfa_metric[osafe]]
         if sentinels:
             # numerical-health sentinels: two scalar reductions riding
@@ -797,6 +801,67 @@ def _instrumented_incr(
         n_cap, s_cap, r_cap, kr_cap, has_res, d_cap, p_cap, a_cap,
         budget, dirty_cap, lfa, block_v4, sentinels,
         kernel, delta_exp,
+    )
+    return name, instrument_jit(name, jitted)
+
+
+@bounded_jit_cache(namespace="stream")
+def _stream_pipeline(n_cap: int, s_cap: int, r_cap: int, kr_cap: int,
+                     has_res: bool,
+                     d_cap: int, p_cap: int, a_cap: int, budget: int,
+                     dirty_cap: int, sbudget: int, lfa: bool = False,
+                     block_v4: bool = False, sentinels: bool = True,
+                     kernel: str = "sync", delta_exp: int = 0,
+                     donate: bool = True):
+    """Streaming-epoch executable: one dispatch chains the incremental
+    relax, selection/LFA and the on-device column diff, downloading a
+    `sbudget`-row compacted payload with the device route-ok bit
+    (ops/stream.py). The previous epoch's published planes and warm
+    distance seed are DONATED — the epoch double-buffer updates HBM in
+    place, so keeping the columns resident across solves costs one
+    plane set, not two. `sbudget` (a STREAM_BUDGETS bucket) and
+    `dirty_cap` are both capacity-signature ints, so budget churn
+    buckets inside the "stream" namespace and can never evict the
+    full-solve or incr executables. Donation is gated off on CPU
+    (XLA cannot honor it there and jax warns) and whenever a transfer
+    guard is armed (the guarded-retry path would replay consumed
+    buffers)."""
+    import jax
+
+    kw = {"donate_argnums": (9, 10, 11, 12, 13, 14)} if donate else {}
+    return jax.jit(
+        _make_pipeline(
+            n_cap, s_cap, r_cap, kr_cap, has_res, d_cap, p_cap, a_cap,
+            budget, lfa, block_v4, sentinels, emit_dist=True, incr=True,
+            kernel=kernel, delta_exp=delta_exp, stream=sbudget,
+        ),
+        **kw,
+    )
+
+
+@bounded_jit_cache(namespace="stream")
+def _instrumented_stream(
+    n_cap: int, s_cap: int, r_cap: int, kr_cap: int, has_res: bool,
+    d_cap: int, p_cap: int, a_cap: int, budget: int, dirty_cap: int,
+    sbudget: int, lfa: bool, block_v4: bool, sentinels: bool,
+    kernel: str = "sync", delta_exp: int = 0, donate: bool = True,
+) -> tuple:
+    """(kernel name, instrumented callable) for a streaming-epoch shape
+    class — the stream-namespace analogue of _instrumented_incr."""
+    from openr_tpu.ops.xla_cache import instrument_jit
+
+    name = (
+        f"pipeline_stream[n={n_cap},s={s_cap},d={d_cap},p={p_cap},"
+        f"a={a_cap},dd={dirty_cap},sb={sbudget}"
+        + (",res" if has_res else "")
+        + (",lfa" if lfa else "")
+        + (f",bk{delta_exp}" if kernel == "bucketed" else "")
+        + "]"
+    )
+    jitted = _stream_pipeline(
+        n_cap, s_cap, r_cap, kr_cap, has_res, d_cap, p_cap, a_cap,
+        budget, dirty_cap, sbudget, lfa, block_v4, sentinels,
+        kernel, delta_exp, donate,
     )
     return name, instrument_jit(name, jitted)
 
@@ -1062,6 +1127,7 @@ class _VantageState:
     __slots__ = (
         "shape_key", "matrix_version", "prev", "crib",
         "links_tuple", "valid", "prev_dist", "dist_epoch", "root_sig",
+        "stream_budget",
     )
 
     def __init__(self):
@@ -1071,6 +1137,12 @@ class _VantageState:
         self.crib: Optional[ColumnarRib] = None
         self.links_tuple: tuple = ()
         self.valid = False
+        # streaming-epoch changed-rows budget (ops/stream.py bucket):
+        # tracks this vantage's recent churn — grows on payload
+        # overflow, shrinks back toward the floor on quiet epochs.
+        # Floor literal mirrors STREAM_BUDGETS[0] (importing the ops
+        # module pulls in jax, which this module defers to solve time).
+        self.stream_budget = 64
         # incremental-solve seed state: the [D, N] distance plane of
         # the last single-area dispatch, the area drain epoch it
         # corresponds to, and the root out-link signature it was
@@ -1316,7 +1388,8 @@ class TpuSpfSolver:
         multichip_n_cap_threshold: int = 131072,
         multichip_batch: int = 0,
         spf_kernel: str = "bucketed",
-        transfer_guard: str = "off", **solver_kwargs
+        transfer_guard: str = "off",
+        streaming_pipeline: bool = False, **solver_kwargs
     ):
         # a restarting daemon must not pay the ~80s 100k-node compile
         # again — load executables from the persistent cache
@@ -1348,7 +1421,22 @@ class TpuSpfSolver:
         # first solve, shape/root churn, journal gaps, zero-weight
         # edges, or when the cone exceeds incremental_cone_frac of the
         # fabric's node-lanes (decided on device, same dispatch).
-        self.incremental_spf = bool(incremental_spf)
+        # streaming churn pipeline (ops/stream.py): fuse the incremental
+        # relax, selection and the on-device column diff into one
+        # dispatch per epoch, download a bucketed changed-rows payload
+        # carrying the device route-ok bit, and DONATE the previous
+        # epoch's resident planes (in-place HBM double-buffer). Implies
+        # incremental_spf — the streaming epoch is the incremental solve
+        # with a different download contract; every incremental
+        # fallback rung (first solve, shape/root churn, journal gaps,
+        # payload overflow, CPU failover) drops to the classic path.
+        if not isinstance(streaming_pipeline, bool):
+            raise ValueError(
+                f"streaming_pipeline must be a bool, "
+                f"got {streaming_pipeline!r}"
+            )
+        self.streaming_pipeline = streaming_pipeline
+        self.incremental_spf = bool(incremental_spf) or streaming_pipeline
         self.incremental_cone_frac = float(incremental_cone_frac)
         # multichip capacity tier (parallel/sharding.py): an area whose
         # padded n_cap exceeds the threshold — with >1 device visible —
@@ -1716,6 +1804,10 @@ class TpuSpfSolver:
         bucket_epochs_total = 0
         halo_total = 0
         bucketed_engaged = False
+        bytes_downloaded = 0
+        stream_epochs = 0
+        stream_changed = 0
+        stream_overflows = 0
         for area, fut in pending.futures:
             res = fut.result()
             views.append(res["view"])
@@ -1725,6 +1817,14 @@ class TpuSpfSolver:
             rounds_total += int(stats.get("rounds") or 0)
             bucket_epochs_total += int(stats.get("bucket_epochs") or 0)
             halo_total += int(stats.get("halo_exchanges") or 0)
+            # download ledger (ISSUE 16): every path reports its pulled
+            # bytes; streaming epochs additionally report budget use
+            bytes_downloaded += int(stats.get("bytes_downloaded") or 0)
+            if stats.get("stream"):
+                stream_epochs += 1
+                stream_changed += int(stats.get("changed_rows") or 0)
+                if stats["stream"].get("overflow"):
+                    stream_overflows += 1
             if stats.get("spf_kernel") == "bucketed":
                 bucketed_engaged = True
             if stats.get("incremental"):
@@ -1773,6 +1873,9 @@ class TpuSpfSolver:
             counters.add_stat_value(
                 "decision.device.halo_exchanges", halo_total
             )
+        counters.add_stat_value(
+            "decision.device.bytes_downloaded", bytes_downloaded
+        )
         wall = (_time.perf_counter() - pending.t_pipe0) * 1e3
         self.last_timing = {
             **stages,
@@ -1780,6 +1883,7 @@ class TpuSpfSolver:
             "pipeline_stages_ms": sum(stages.values()),
             "areas": area_timing,
             "bytes_uploaded": float(pending.bytes_uploaded),
+            "bytes_downloaded": float(bytes_downloaded),
             "incremental": incremental,
             "multichip": multichip,
             "rounds": rounds_total,
@@ -1788,6 +1892,13 @@ class TpuSpfSolver:
             "spf_kernel": "bucketed" if bucketed_engaged else "sync",
             **pending.ksp2_timing,
         }
+        if stream_epochs:
+            self.last_timing["stream"] = {
+                "epochs": stream_epochs,
+                "changed_rows": stream_changed,
+                "bytes_downloaded": bytes_downloaded,
+                "overflows": stream_overflows,
+            }
         return route_db
 
     def _prime_ucmp(
@@ -2578,6 +2689,13 @@ class TpuSpfSolver:
         if mc is not None:
             counters.increment("decision.solver.multichip.dispatches")
         if incr is not None:
+            if mc is None and self.streaming_pipeline:
+                # streaming epoch: same eligibility ladder as the
+                # incremental solve (its rungs ARE the fallback ladder
+                # — first solve, shape/root churn, journal gaps all
+                # land in the full branch below), different download
+                # contract + donated double-buffer
+                return self._dispatch_stream(pv)
             if mc is not None:
                 kernel_name, run = _instrumented_mc_incr(
                     mc, *pv["shape_key"], _DELTA_BUDGET, incr["cap"],
@@ -2642,6 +2760,58 @@ class TpuSpfSolver:
             pv, kernel_name, delta_buf, full_buf, new_prev, emit=emit
         )
 
+    def _dispatch_stream(self, pv: dict):
+        """Streaming-epoch dispatch (jit-cache namespace "stream"): ONE
+        fused executable chains the incremental relax, selection/LFA
+        and the on-device column diff, and the download is the bucketed
+        changed-rows payload carrying the device route-ok bit
+        (ops/stream.py). The previous epoch's published planes + warm
+        distance seed are DONATED into the dispatch — the epoch
+        double-buffer flips in place in HBM — so the vantage advances
+        to the new handles IMMEDIATELY after dispatch and stays invalid
+        until prepare() commits the columnar patch: an abandoned
+        prepare costs one clean full rebuild, never a crib that has
+        silently diverged from the resident planes. Donation also kills
+        the device-probe replay state (its stored prev handles), so
+        both probes are cleared."""
+        incr, vs = pv["incr"], pv["vs"]
+        sbudget = int(vs.stream_budget) or 64
+        # the guarded-retry path in _run_exec replays the call after a
+        # finding — impossible once the inputs are donated — and CPU
+        # cannot honor donation at all: gate it off for both
+        donate = (
+            self._donation_on() and self._transfer_guard_mode() is None
+        )
+        kernel_name, run = _instrumented_stream(
+            *pv["shape_key"], _DELTA_BUDGET, incr["cap"], sbudget,
+            pv["lfa"], pv["block_v4"], self.enable_sentinels,
+            pv["kernel"], pv["delta_exp"], donate,
+        )
+        args = self._lane_args(pv) + (
+            vs.prev_dist,
+            incr["sd_idx"], incr["sd_old"],
+            incr["rd_idx"], incr["rd_old"], incr["cone_limit"],
+        )
+        delta_buf, full_buf, *new_prev = self._run_exec(
+            "stream", kernel_name, pv["shape_key"], run, args,
+            pv["area"],
+        )
+        prepare = self._make_prepare(
+            pv, kernel_name, delta_buf, full_buf, new_prev,
+            emit=True, incr=True, stream=sbudget,
+        )
+        # post-donation hygiene, on the dispatch thread before anything
+        # can observe the dead handles: advance the double-buffer,
+        # invalidate until the prepare lands, drop the replay probes
+        vs.prev = tuple(new_prev[:5])
+        vs.prev_dist = new_prev[5]
+        vs.dist_epoch = pv["dist_epoch"]
+        vs.root_sig = pv["root_sig"]
+        vs.valid = False
+        self._last_exec = None
+        self._last_exec_incr = None
+        return prepare
+
     def _dispatch_fused(self, group: list[dict]) -> list[tuple]:
         """ONE vmapped dispatch for a group of same-shape areas; returns
         (pv, prepare) pairs. Per-area inputs travel as g-tuples (a
@@ -2675,13 +2845,25 @@ class TpuSpfSolver:
 
     def _make_prepare(self, pv: dict, kernel_name: str, delta_buf,
                       full_buf, new_prev, fused: int = 0,
-                      emit: bool = False, incr: bool = False):
+                      emit: bool = False, incr: bool = False,
+                      stream: int = 0):
         """Start the async device->host copy of the buffer the solve
         will consume and build the prepare() closure that patches the
         vantage's columnar RIB on the materialization worker.
         Thread-safety: one worker thread, and the caller does not touch
-        this vantage's state until it collects the future."""
+        this vantage's state until it collects the future.
+
+        With `stream` (the streaming epoch's changed-rows bucket) the
+        delta payload is the bucketed ops/stream.py layout: the device
+        route-ok bit rides per changed row, so the patch goes through
+        apply_rows_packed — no host word-unpack, and the crib journal
+        entry is marked device-exact (fast_unicast_column_diff then
+        skips its re-compare). An over-budget epoch falls back to the
+        device-compacted full pull and the budget grows for the next
+        epoch."""
         import time as _time
+
+        from openr_tpu.ops.stream import STREAM_BUDGETS, stream_budget
 
         plan, matrix, vs = pv["plan"], pv["matrix"], pv["vs"]
         lfa = pv["lfa"]
@@ -2717,7 +2899,7 @@ class TpuSpfSolver:
                 vs.root_sig = pv["root_sig"]
             wa = -(-a_cap // 16)
             wd = -(-d_cap // 16)
-            b = _DELTA_BUDGET
+            b = stream or _DELTA_BUDGET
             crib = vs.crib
             count = None
             trips = 0
@@ -2783,17 +2965,32 @@ class TpuSpfSolver:
                 metric = dbuf[o:o + b]; o += b
                 s3w = dbuf[o:o + b * wa].reshape(b, wa); o += b * wa
                 nhw = dbuf[o:o + b * wd].reshape(b, wd); o += b * wd
+                okb = None
+                if stream:
+                    # streaming payload: device route-ok bit per row
+                    okb = dbuf[o:o + b]; o += b
                 lfa_slot = lfa_metric = None
                 if lfa:
                     lfa_slot = dbuf[o:o + b]; o += b
                     lfa_metric = dbuf[o:o + b]
                 live = cidx < p_cap
-                crib.apply_rows(
-                    cidx[live][:count], metric[live][:count],
-                    s3w[live][:count], nhw[live][:count],
-                    None if lfa_slot is None else lfa_slot[live][:count],
-                    None if lfa_metric is None else lfa_metric[live][:count],
-                )
+                if stream:
+                    crib.apply_rows_packed(
+                        cidx[live][:count], metric[live][:count],
+                        s3w[live][:count], nhw[live][:count],
+                        okb[live][:count].astype(bool),
+                        None if lfa_slot is None
+                        else lfa_slot[live][:count],
+                        None if lfa_metric is None
+                        else lfa_metric[live][:count],
+                    )
+                else:
+                    crib.apply_rows(
+                        cidx[live][:count], metric[live][:count],
+                        s3w[live][:count], nhw[live][:count],
+                        None if lfa_slot is None else lfa_slot[live][:count],
+                        None if lfa_metric is None else lfa_metric[live][:count],
+                    )
             # tail layout, back to front: [-1] is always the executed-
             # relaxation rounds scalar; the incremental kernel's
             # [cone, fell_back] sit at [-3]/[-2]; the sentinel scalars
@@ -2825,6 +3022,40 @@ class TpuSpfSolver:
                     "unreachable_rows": int(sbuf[off - 2]),
                     "saturated_rows": int(sbuf[off - 1]),
                 }
+            # device->host download accounting: every pulled buffer
+            # counts (an over-budget streaming epoch pays both the
+            # delta head-peek and the full pull)
+            bytes_dl = 0
+            if was_valid:
+                bytes_dl += int(dbuf.nbytes)
+            if full_pull:
+                bytes_dl += int(fbuf.nbytes)
+            stats["bytes_downloaded"] = bytes_dl
+            if stream:
+                stats["stream"] = {
+                    "budget": b,
+                    "overflow": bool(full_pull),
+                }
+                # adapt next epoch's bucket to the observed churn: grow
+                # past an overflow, settle back toward the floor when
+                # the storm quiets (quantized — budget churn can't
+                # thrash the "stream" jit-cache namespace)
+                vs.stream_budget = (
+                    stream_budget(count or 0) or STREAM_BUDGETS[-1]
+                )
+                # donation left the vantage invalid across the dispatch
+                # window; the columnar patch above committed, so the
+                # resident planes and the crib agree again
+                vs.valid = True
+                counters.increment("decision.stream.epochs")
+                counters.add_stat_value(
+                    "decision.stream.changed_rows", count or 0
+                )
+                counters.add_stat_value(
+                    "decision.stream.bytes_downloaded", bytes_dl
+                )
+                if full_pull:
+                    counters.increment("decision.stream.overflows")
             stats["trips"] = trips
             # executed-relaxation work accounting (ISSUE 13): rounds is
             # the device-counted relaxation passes; under the bucketed

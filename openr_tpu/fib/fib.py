@@ -147,6 +147,9 @@ class Fib(Actor):
         # (first wins; later ones close as "coalesced", like pending
         # publications do in Decision)
         self._pending_trace: Optional[TraceContext] = None
+        # newest Decision solve epoch folded into the pending dirty set
+        # (epoch fence attribution: the pass that programs publishes it)
+        self._pending_epoch: Optional[int] = None
         # convergence perf-event ring (ref PerfDatabase)
         self.perf_db: collections.deque[PerfEvents] = collections.deque(
             maxlen=32
@@ -201,6 +204,8 @@ class Fib(Actor):
         ctx = tracer.context_of(upd)
         sp = tracer.start_span(ctx, "fib.diff", node=self.node_name)
         rs.update(upd)
+        if upd.solve_epoch is not None:
+            self._pending_epoch = upd.solve_epoch
         if upd.perf_events is not None:
             add_perf_event(upd.perf_events, self.node_name, "FIB_RECEIVED")
 
@@ -389,6 +394,7 @@ class Fib(Actor):
                 type=RouteUpdateType.FULL_SYNC,
                 unicast_routes_to_update=unicast,
                 mpls_routes_to_update=mpls,
+                solve_epoch=self._pending_epoch,
             ),
             perf,
             trace=trace,
@@ -475,7 +481,10 @@ class Fib(Actor):
             for l, ts in rs.dirty_labels.items()
             if ts <= now and l not in rs.mpls_routes
         ]
-        programmed = DecisionRouteUpdate(type=RouteUpdateType.INCREMENTAL)
+        programmed = DecisionRouteUpdate(
+            type=RouteUpdateType.INCREMENTAL,
+            solve_epoch=self._pending_epoch,
+        )
         ok = True
         try:
             # chaos seam: everything due stays dirty and retries
@@ -603,6 +612,13 @@ class Fib(Actor):
                     )
                 )
         counters.increment("fib.routes_programmed")
+        if programmed.solve_epoch is not None:
+            # the ack attributes to the NEWEST epoch this pass folded
+            # in; the gauge makes programmed-epoch monotonicity (the
+            # fence property: a stale batch is never programmed)
+            # observable from tests and the chaos drill
+            counters.set_counter("fib.solve_epoch", programmed.solve_epoch)
+            self._pending_epoch = None
         self._fib_updates_q.push(programmed, trace=trace)
         # fleet-convergence ack: a trace stitched to an origin event
         # reports (origin_event_id, this node, origin->ack latency) back
@@ -630,11 +646,15 @@ class Fib(Actor):
             except Exception:
                 counters.increment("fib.conv_ack_failures")
         # programming ack published: the topology event has converged
+        end_attrs = {}
+        if programmed.solve_epoch is not None:
+            end_attrs["solve_epoch"] = programmed.solve_epoch
         tracer.end_trace(
             trace,
             status="ok",
             routes=len(programmed.unicast_routes_to_update)
             + len(programmed.unicast_routes_to_delete),
+            **end_attrs,
         )
 
     # -- agent liveness (ref Fib::keepAlive) -------------------------------

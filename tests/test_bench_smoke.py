@@ -298,3 +298,35 @@ def test_bench_config_small_graph_delegation_still_reports():
     # ISSUE 7 satellite: changed_rows reports 0 (not null) on delegated
     # small configs, uniform with the device-path configs
     assert res["changed_rows"] == 0, res
+
+
+def test_bench_flapstorm_lane_standstill_and_zero_retraces():
+    """ISSUE 16 tier-1 gate over the streaming churn lane: every storm
+    event must take the streamed epoch path, the closing idle epoch
+    must download exactly one within-budget payload with ZERO changed
+    rows (bytes stand still when nothing changed — the
+    changed-rows-proportional download claim at its boundary), and the
+    warm storm must run without a single post-boot retrace in any
+    executable namespace, the new stream namespace included."""
+    from bench import bench_flapstorm
+    from openr_tpu.models import topologies
+
+    res = bench_flapstorm(
+        "smoke-storm",
+        lambda: topologies.grid(4, node_labels=False),
+        "node-2-2",
+        events=6,
+        rate_hz=500.0,
+        flap_victims=2,
+    )
+    assert res["stream_engaged"] == res["events"] == 6, res
+    assert res["stream_overflows"] == 0, res
+    assert res["idle_changed_rows"] == 0, res
+    # standstill: the idle epoch's download equals a within-budget
+    # churn epoch's — payloads are budget-shaped, not row-count-shaped
+    assert res["idle_bytes_downloaded"] == res[
+        "bytes_downloaded_per_epoch"
+    ], res
+    assert res["retraces"] == 0, res
+    assert res["ack_p99_ms"] > 0, res
+    assert res["fib_routes"] > 0, res

@@ -807,3 +807,45 @@ def test_chaos_sentinel_catches_cross_thread_solver_dispatch(affinity_on):
     # the owning thread is unaffected and keeps solving
     db2 = solver.build_route_db("a", states, ps)
     assert db2 is not None
+
+
+def test_purity_and_donation_trace_stream_epoch_roots():
+    """ISSUE 16: the fused streaming-epoch kernel is device code end to
+    end. ops/stream.py rides the ops/ traced prefix (its column-diff +
+    compaction stages are purity-analyzed), the solver module's
+    `pipeline` jit root — which _stream_pipeline wraps for the fused
+    epoch — is discovered, and the stream stages' function-local
+    imports resolve to the traced module, so a host impurity seeded in
+    either stage would flow to the root's findings. The donation
+    checker must index the stream executable's conditional kwargs-dict
+    donation (the epoch double-buffer's donated planes + warm seed),
+    and the shipped modules must run clean."""
+    project = Project(REPO_ROOT, ["openr_tpu"])
+    sf = project.file("openr_tpu/ops/stream.py")
+    assert sf is not None
+    assert purity_check._is_traced_file(sf.rel)
+    solver = project.file("openr_tpu/decision/tpu_solver.py")
+    g = purity_check._ModuleGraph(solver)
+    assert "pipeline" in g.traced, g.traced
+    assert g.imports.get("column_diff") == (
+        "openr_tpu.ops.stream", "column_diff"
+    )
+    assert g.imports.get("compact_changed_rows") == (
+        "openr_tpu.ops.stream", "compact_changed_rows"
+    )
+    # the streaming executable donates the prev planes + distance seed
+    # (positions 9-14) through the conditional dict form — the
+    # read-after-donate rule must see every position
+    donated = donation_check._factory_donations(
+        g.defs["_stream_pipeline"]
+    )
+    assert {9, 10, 11, 12, 13, 14} <= donated, donated
+    findings = [
+        f
+        for f in purity_check.run(project) + donation_check.run(project)
+        if f.path in (
+            "openr_tpu/ops/stream.py",
+            "openr_tpu/decision/tpu_solver.py",
+        )
+    ]
+    assert not findings, findings
