@@ -213,11 +213,36 @@ def bench_config(name, gen, me, runs=5, flap_victims=0, cpu_baseline=True,
     retrace0 = sum(
         _counters.get_counters("xla_cache.retraces.").values()
     )
-    samples, phases = [], {}
+    from openr_tpu.runtime.latency_budget import latency_budget
+
+    samples, phases, budget_rows = [], {}, []
+    dispatch = getattr(tpu, "dispatch_route_db", None)
     for i in range(runs):
         _flap(states, adj_dbs, victims, i, area)
         t0 = time.perf_counter()
-        tpu.build_route_db(me, states, ps)
+        # per-solve latency budget: drive the explicit dispatch/collect
+        # split so the churn loop emits per-component columns (no
+        # program/ack stage in this lane — the storm lane covers those)
+        bud = latency_budget.begin(("churn", name, i))
+        if dispatch is not None:
+            pending = dispatch(me, states, ps)
+            if bud is not None:
+                bud.advance("host_sync")
+            tpu.collect_route_db(pending)
+            tm_i = getattr(tpu, "last_timing", {}) or {}
+            if bud is not None:
+                bud.advance_split(
+                    {
+                        "device_exec": tm_i.get("exec_ms"),
+                        "payload_apply": tm_i.get("mat_ms"),
+                    },
+                    primary="collect_block",
+                )
+        else:
+            tpu.build_route_db(me, states, ps)
+            if bud is not None:
+                bud.advance("device_exec")
+        budget_rows.append(latency_budget.close(bud))
         samples.append((time.perf_counter() - t0) * 1e3)
         for k, v in getattr(tpu, "last_timing", {}).items():
             if isinstance(v, (int, float)):
@@ -249,6 +274,8 @@ def bench_config(name, gen, me, runs=5, flap_victims=0, cpu_baseline=True,
     # uniform across fabric sizes: 0 when the delta pull had no changed
     # rows (or the config delegated to the CPU oracle), never null
     res["changed_rows"] = int(tpu.last_device_stats.get("changed_rows") or 0)
+    # per-component latency-budget columns + conservation (ISSUE 17)
+    res.update(_budget_summary(budget_rows))
     # peak HBM across devices at end of the churn loop — None on backends
     # (cpu) that don't expose memory_stats()
     from openr_tpu.runtime.device_stats import peak_hbm_mb
@@ -480,6 +507,49 @@ def bench_whatif(name, gen, me) -> dict:
     return res
 
 
+def _budget_summary(rows: list) -> dict:
+    """Flatten closed latency-budget rows (runtime/latency_budget.py)
+    into per-component bench columns: budget_<comp>_{p50,p99}_ms, the
+    conservation check (unattributed vs e2e), and the p50->p99 tail
+    attribution (ISSUE 17 acceptance: top-2 components cover >=80% of
+    the gap under flapstorm)."""
+    from openr_tpu.runtime.counters import _percentile
+    from openr_tpu.runtime.latency_budget import (
+        BUDGET_COMPONENTS,
+        tail_attribution,
+    )
+
+    rows = [r for r in rows if r]
+    if not rows:
+        return {}
+    per = {c: [] for c in BUDGET_COMPONENTS}
+    e2e, unattr = [], []
+    for r in rows:
+        e2e.append(r["e2e_ms"])
+        unattr.append(r["unattributed_ms"])
+        for c in BUDGET_COMPONENTS:
+            per[c].append(r["components"].get(c, 0.0))
+    out = {}
+    for c in BUDGET_COMPONENTS:
+        pv = sorted(per[c])
+        if not pv or pv[-1] <= 0.0:
+            continue  # component never engaged in this lane
+        out[f"budget_{c}_p50_ms"] = round(_percentile(pv, 50.0), 3)
+        out[f"budget_{c}_p99_ms"] = round(_percentile(pv, 99.0), 3)
+    ev, uv = sorted(e2e), sorted(unattr)
+    out["budget_e2e_p50_ms"] = round(_percentile(ev, 50.0), 3)
+    out["budget_e2e_p99_ms"] = round(_percentile(ev, 99.0), 3)
+    out["budget_unattributed_p99_ms"] = round(_percentile(uv, 99.0), 3)
+    # conservation: total unattributed residual as a fraction of total
+    # e2e across the lane's epochs (gate: < 5%)
+    out["budget_unattributed_frac"] = round(
+        sum(unattr) / max(sum(e2e), 1e-9), 4
+    )
+    out["budget_epochs"] = len(rows)
+    out["budget_tail"] = tail_attribution(per, e2e)
+    return out
+
+
 def bench_flapstorm(name, gen, me, events=100, rate_hz=100.0,
                     flap_victims=8, small_graph_nodes=0, **solver_kw):
     """Sustained flap-storm churn lane (streaming pipeline, ISSUE 16):
@@ -524,9 +594,13 @@ def bench_flapstorm(name, gen, me, events=100, rate_hz=100.0,
     victims = list(range(1, flap_victims + 1))
     interval = 1.0 / rate_hz
 
+    from openr_tpu.runtime.latency_budget import latency_budget
+
     async def _storm():
         nonlocal db
         acks, dl_bytes, rows, engaged, overflows = [], [], [], 0, 0
+        budget_rows = []
+        dispatch = getattr(tpu, "dispatch_route_db", None)
         start = time.perf_counter()
         for i in range(events):
             target = start + i * interval
@@ -535,17 +609,46 @@ def bench_flapstorm(name, gen, me, events=100, rate_hz=100.0,
                 await _asyncio.sleep(delay)
             _flap(states, adj_dbs, [victims[i % len(victims)]], i, area)
             t_ev = time.perf_counter()
-            new_db = tpu.build_route_db(me, states, ps)
+            # per-event latency budget: the storm drives the explicit
+            # dispatch/collect split so every churn-to-ack interval
+            # decomposes into the canonical component taxonomy with the
+            # conservation invariant enforced at close
+            bud = latency_budget.begin(("storm", name, i))
+            if dispatch is not None:
+                pending = dispatch(me, states, ps)
+                if bud is not None:
+                    bud.advance("host_sync")
+                new_db = tpu.collect_route_db(pending)
+                tm_i = getattr(tpu, "last_timing", {}) or {}
+                if bud is not None:
+                    bud.advance_split(
+                        {
+                            "device_exec": tm_i.get("exec_ms"),
+                            "payload_apply": tm_i.get("mat_ms"),
+                        },
+                        primary="collect_block",
+                    )
+            else:
+                new_db = tpu.build_route_db(me, states, ps)
+                if bud is not None:
+                    bud.advance("device_exec")
             update = db.calculate_update(new_db)
             # force ONLY the changed rows (lazy column map) and program
             # them — the real Fib actor's incremental add/delete path
             changed = list(update.unicast_routes_to_update.values())
+            if bud is not None:
+                bud.advance("payload_apply")
             if changed:
                 await svc.add_unicast_routes(0, changed)
             if update.unicast_routes_to_delete:
                 await svc.delete_unicast_routes(
                     0, update.unicast_routes_to_delete
                 )
+            if bud is not None:
+                bud.advance("program")
+            budget_rows.append(
+                latency_budget.close(bud, final_component="ack_rtt")
+            )
             acks.append((time.perf_counter() - t_ev) * 1e3)
             db = new_db
             tm = getattr(tpu, "last_timing", {})
@@ -556,9 +659,11 @@ def bench_flapstorm(name, gen, me, events=100, rate_hz=100.0,
                 overflows += int(st.get("overflows") or 0)
             rows.append(int(st.get("changed_rows") or 0))
         wall_s = time.perf_counter() - start
-        return acks, dl_bytes, rows, engaged, overflows, wall_s
+        return (
+            acks, dl_bytes, rows, engaged, overflows, wall_s, budget_rows
+        )
 
-    acks, dl_bytes, rows, engaged, overflows, wall_s = (
+    acks, dl_bytes, rows, engaged, overflows, wall_s, budget_rows = (
         _asyncio.run(_storm())
     )
     # idle epoch: nothing changed since the last solve — the streaming
@@ -591,11 +696,17 @@ def bench_flapstorm(name, gen, me, events=100, rate_hz=100.0,
             - retrace0
         ),
     }
+    res.update(_budget_summary(budget_rows))
     log(f"[{name}] flapstorm: ack p50 {res['ack_p50_ms']} / p99 "
         f"{res['ack_p99_ms']} ms at {res['achieved_rate_hz']} ev/s "
         f"(asked {rate_hz}) / dl {res['bytes_downloaded_per_epoch']} B "
         f"per epoch (full {full_bytes} B) / idle {idle_bytes} B "
         f"/ engaged {engaged}/{events}")
+    tail = (res.get("budget_tail") or {}).get("ranked") or []
+    log(f"[{name}] budget: e2e p99 {res.get('budget_e2e_p99_ms')} ms, "
+        f"unattributed frac {res.get('budget_unattributed_frac')}, "
+        f"tail owners "
+        f"{[(t['component'], t['gap_ms']) for t in tail[:2]]}")
     return res
 
 
@@ -619,6 +730,15 @@ def _ledger_record(name: str, res: dict) -> None:
                   "bytes_downloaded_per_epoch")
         if isinstance(res.get(k), (int, float))
     }
+    # per-component budget baselines: perf_diff --ledger and the CI gate
+    # diff the breakdown, so a regression names the component that moved
+    obs.update(
+        {
+            k: v
+            for k, v in res.items()
+            if k.startswith("budget_") and isinstance(v, (int, float))
+        }
+    )
     if obs:
         lg.record(f"solve[{name}]", obs, signature=sig, variant="default")
     for variant, kr in (res.get("kernel_ab") or {}).items():
@@ -689,6 +809,38 @@ def bench_boot() -> dict:
     log(f"[boot] first_rib {res['boot_first_rib_ms']} ms "
         f"phases {sorted(res['phases'])}")
     return res
+
+
+def _write_budget_out(configs) -> None:
+    """Dump the per-lane latency-budget waterfall to
+    $OPENR_TPU_BUDGET_OUT (CI uploads it as a failure artifact). The doc
+    carries each lane's `budget_*` columns plus the ledger's own
+    report() so a red bench lane is triageable offline — the waterfall
+    names the component, not just the regressed total."""
+    import os
+
+    path = os.environ.get("OPENR_TPU_BUDGET_OUT")
+    if not path:
+        return
+    from openr_tpu.runtime.latency_budget import latency_budget
+
+    doc = {
+        "lanes": {
+            name: {
+                k: v for k, v in res.items() if k.startswith("budget_")
+            }
+            for name, res in configs.items()
+            if isinstance(res, dict)
+            and any(k.startswith("budget_") for k in res)
+        },
+        "ledger": latency_budget.report(),
+    }
+    try:
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        log(f"budget waterfall: {path}")
+    except OSError as exc:
+        log(f"budget waterfall: write failed ({exc})")
 
 
 def main() -> None:
@@ -778,6 +930,7 @@ def main() -> None:
     if quick:
         if not configs:
             sys.exit(f"--only={only} matched no config")
+        _write_budget_out(configs)
         name = "tg1k" if "tg1k" in configs else next(iter(configs))
         out = configs[name]
         print(json.dumps({
@@ -905,6 +1058,7 @@ def main() -> None:
             configs[last].get("cpu_ms"),
         )
     metric, tpu_ms, cpu_ms = headline
+    _write_budget_out(configs)
     dev = configs.get("lsdb100k", {}).get("device_ms")
     print(json.dumps({
         "metric": metric,

@@ -487,6 +487,99 @@ def decision_convergence(ctx, fleet) -> None:
     _print(_call(ctx, "ctrl.decision.convergence", {"fleet": fleet}))
 
 
+@decision.command("budget")
+@click.option(
+    "--fleet",
+    is_flag=True,
+    help="join the fleet conv-ack view: per-origin-event convergence "
+    "with the straggler's dominant budget COMPONENT named",
+)
+@click.option(
+    "--raw", is_flag=True, help="full JSON report instead of the waterfall"
+)
+@click.option(
+    "--window",
+    default="600",
+    help="stat window in seconds for the percentile columns (60/600/3600)",
+)
+@click.pass_context
+def decision_budget(ctx, fleet, raw, window) -> None:
+    """Churn-to-ack latency budget waterfall: every epoch decomposed
+    into the canonical component taxonomy (ingest_wait .. ack_rtt) with
+    a conservation invariant — components sum to measured e2e, residual
+    exported as budget.unattributed_ms. Names which component owns the
+    p50→p99 tail."""
+    rep = _call(ctx, "ctrl.decision.budget", {"fleet": fleet})
+    if raw:
+        _print(rep)
+        return
+
+    def _agg(win: dict) -> dict:
+        if not isinstance(win, dict) or not win:
+            return {}
+        return win.get(window) or next(iter(win.values()), {}) or {}
+
+    e2e = _agg(rep.get("e2e"))
+    e2e_p99 = float(e2e.get("p99") or 0.0)
+    click.echo(
+        f"latency budget — node {rep.get('node', '?')}  "
+        f"(window {window}s, epochs {rep.get('conservation', {}).get('epochs') or 0})"
+    )
+    click.echo(
+        f"{'component':<16}{'p50':>9}{'p95':>9}{'p99':>9}  share(p99)"
+    )
+    for comp in rep.get("taxonomy", []):
+        agg = _agg(rep.get("components", {}).get(comp))
+        if not agg or not agg.get("count"):
+            continue
+        p99 = float(agg.get("p99") or 0.0)
+        share = (p99 / e2e_p99) if e2e_p99 > 0 else 0.0
+        bar = "#" * max(0, min(30, int(round(share * 30))))
+        click.echo(
+            f"{comp:<16}{agg.get('p50', 0.0):>9.3f}"
+            f"{agg.get('p95', 0.0):>9.3f}{p99:>9.3f}  "
+            f"{bar} {share * 100.0:.0f}%"
+        )
+    click.echo(
+        f"{'e2e':<16}{e2e.get('p50', 0.0):>9.3f}"
+        f"{e2e.get('p95', 0.0):>9.3f}{e2e_p99:>9.3f}"
+    )
+    un = _agg(rep.get("unattributed"))
+    un_p99 = float(un.get("p99") or 0.0)
+    pct = (100.0 * un_p99 / e2e_p99) if e2e_p99 > 0 else 0.0
+    click.echo(
+        f"{'unattributed':<16}{un.get('p50', 0.0):>9.3f}"
+        f"{un.get('p95', 0.0):>9.3f}{un_p99:>9.3f}  "
+        f"({pct:.1f}% of e2e p99 — conservation "
+        f"{'OK' if pct < 5.0 else 'DRIFTING'})"
+    )
+    tail = rep.get("tail") or {}
+    ranked = tail.get("ranked") or []
+    if ranked:
+        named = ", ".join(
+            f"{r['component']} +{r['gap_ms']:.3f}ms" for r in ranked[:2]
+        )
+        cov = tail.get("top2_coverage")
+        cov_s = f" (top-2 cover {cov * 100.0:.0f}% of gap)" if cov else ""
+        click.echo(
+            f"p50→p99 tail: {named}{cov_s}"
+        )
+    if fleet and rep.get("fleet"):
+        click.echo("\nfleet events (straggler node → component):")
+        for ev in rep["fleet"].get("events", [])[:10]:
+            comp = ev.get("straggler_component")
+            comp_s = (
+                f" [{comp} {ev.get('straggler_component_ms', 0.0):.3f}ms]"
+                if comp
+                else ""
+            )
+            click.echo(
+                f"  {ev['event']}: {ev['fleet_ms']:.3f}ms "
+                f"straggler={ev['straggler']}{comp_s} "
+                f"({ev['nodes_acked']} acked)"
+            )
+
+
 @decision.command("rib-policy")
 @click.option("--clear", is_flag=True, help="remove the active policy")
 @click.option(

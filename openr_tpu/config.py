@@ -338,6 +338,16 @@ class MonitorConfig:
                 "source": "decision.solver.degraded",
                 "threshold": 5.0,
             },
+            # conservation drift of the latency-budget ledger: a growing
+            # unattributed residual means the component taxonomy rotted
+            # (a stage nobody stamps appeared) — page BEFORE the
+            # per-component numbers mislead (docs/Observability.md
+            # § Latency budget)
+            "budget_unattributed_p99_ms": {
+                "kind": "stat",
+                "source": "budget.unattributed_ms",
+                "threshold": 5.0,
+            },
         }
     )
     slo_fast_window_s: float = 60.0

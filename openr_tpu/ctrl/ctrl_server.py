@@ -148,6 +148,7 @@ class CtrlServer(Actor):
             s.register(
                 "ctrl.decision.convergence", self._decision_convergence
             )
+            s.register("ctrl.decision.budget", self._decision_budget)
             s.register("ctrl.decision.whatif.sweep", self._whatif_sweep)
             s.register("ctrl.decision.whatif.drain", self._whatif_drain)
             s.register(
@@ -334,6 +335,42 @@ class CtrlServer(Actor):
             if isinstance(tm.get("stream"), dict):
                 last["stream"] = tm["stream"]
             out["solver"]["last_solve"] = last
+            # windowed decision.device.* stats age out during idle (the
+            # sample ring only answers for the trailing windows) and the
+            # rows above render blank — fall back to the last_timing
+            # snapshot, same pattern as the kernel rows
+            for row, key in (
+                ("device_rounds", "rounds"),
+                ("device_bucket_epochs", "bucket_epochs"),
+                ("device_halo_exchanges", "halo_exchanges"),
+                ("device_bytes_downloaded", "bytes_downloaded"),
+            ):
+                if tm.get(key) is None:
+                    continue
+                win = out["solver"].get(row) or {}
+                if all(
+                    not (w or {}).get("count")
+                    for w in win.values()
+                    if isinstance(w, dict)
+                ):
+                    out["solver"][row] = {
+                        "snapshot": tm[key],
+                        "source": "last_timing",
+                    }
+        if fleet:
+            out["fleet"] = await self._fleet_convergence()
+        return out
+
+    async def _decision_budget(self, fleet: bool = False) -> dict:
+        """Latency-budget waterfall: the per-epoch churn-to-ack budget
+        ledger's per-component windows, conservation accounting, and
+        p50->p99 tail attribution (runtime/latency_budget.py). With
+        fleet=True, joins the fleet conv-ack view so each origin event
+        also names the straggler's dominant budget COMPONENT."""
+        from openr_tpu.runtime.latency_budget import latency_budget
+
+        out = latency_budget.report()
+        out["node"] = self.node_name
         if fleet:
             out["fleet"] = await self._fleet_convergence()
         return out
@@ -376,6 +413,15 @@ class CtrlServer(Actor):
                         ms = float(ack.get("ms", 0.0))
                         # one node can re-program for the same origin
                         # event (coalesced floods) — keep its slowest ack
+                        if ms >= ev["acks"].get(node, 0.0):
+                            # the slowest ack's dominant budget component
+                            # (fib.py threads it through the conv-ack) —
+                            # names the straggler STAGE, not just the node
+                            if ack.get("comp"):
+                                ev.setdefault("comps", {})[node] = {
+                                    "component": ack["comp"],
+                                    "ms": float(ack.get("comp_ms", 0.0)),
+                                }
                         ev["acks"][node] = max(
                             ev["acks"].get(node, 0.0), ms
                         )
@@ -385,19 +431,22 @@ class CtrlServer(Actor):
         rows = []
         for event_id, ev in events.items():
             straggler = max(ev["acks"], key=ev["acks"].get)
-            rows.append(
-                {
-                    "event": event_id,
-                    "origin": ev["origin"],
-                    "ts_ms": ev["ts_ms"],
-                    "fleet_ms": round(ev["acks"][straggler], 3),
-                    "straggler": straggler,
-                    "nodes_acked": len(ev["acks"]),
-                    "acks": {
-                        n: round(ms, 3) for n, ms in ev["acks"].items()
-                    },
-                }
-            )
+            row = {
+                "event": event_id,
+                "origin": ev["origin"],
+                "ts_ms": ev["ts_ms"],
+                "fleet_ms": round(ev["acks"][straggler], 3),
+                "straggler": straggler,
+                "nodes_acked": len(ev["acks"]),
+                "acks": {
+                    n: round(ms, 3) for n, ms in ev["acks"].items()
+                },
+            }
+            comp = (ev.get("comps") or {}).get(straggler)
+            if comp:
+                row["straggler_component"] = comp["component"]
+                row["straggler_component_ms"] = round(comp["ms"], 3)
+            rows.append(row)
         rows.sort(key=lambda r: r["ts_ms"], reverse=True)
         fleet_ms = sorted(r["fleet_ms"] for r in rows)
         return {

@@ -47,6 +47,7 @@ from openr_tpu.runtime.faults import maybe_fail
 from openr_tpu.runtime.lifecycle import boot_tracer
 from openr_tpu.serde import from_plain, to_plain
 from openr_tpu.runtime.counters import counters
+from openr_tpu.runtime.latency_budget import latency_budget
 from openr_tpu.runtime.throttle import AsyncDebounce, ExponentialBackoff
 from openr_tpu.runtime.tracing import TraceContext, tracer
 from openr_tpu.serde import deserialize
@@ -508,6 +509,7 @@ class Decision(Actor):
         superseded requests are never solved separately."""
         while True:
             pending = await self._solve_q.get()
+            t_pickup = time.monotonic()
             if self.cfg.dispatch_coalesce_ms > 0:
                 await asyncio.sleep(self.cfg.dispatch_coalesce_ms / 1e3)
             while not self._solve_q.empty():
@@ -518,6 +520,13 @@ class Decision(Actor):
             counters.set_counter(
                 "decision.dispatch.depth", self._solve_q.qsize()
             )
+            # latency budget: the epoch anchors at the trace's KvStore
+            # receive stamp; [recv, pickup] is ingest_wait and
+            # [pickup, now] the coalesce window (incl. merged deltas)
+            bud = latency_budget.begin_for_trace(pending.trace)
+            if bud is not None:
+                bud.advance("ingest_wait", t_pickup)
+                bud.advance("coalesce_hold")
             # chaos seam: crash the dispatch fiber between coalesce and
             # solve — the supervisor drill (restart + full-rebuild
             # recovery, on_fiber_restart) needs a deterministic place
@@ -602,6 +611,9 @@ class Decision(Actor):
             new_db = await self._solve_full_async(ctx, spf_sp)
         else:
             new_db = self._incremental_db(pending)
+            bud = latency_budget.of_trace(ctx)
+            if bud is not None:
+                bud.advance("device_exec")
         if (
             self.cfg.streaming_pipeline
             and full
@@ -619,6 +631,9 @@ class Decision(Actor):
             # lint: allow(broad-except) predecessor already logged it
             except Exception:  # pragma: no cover - logged at source
                 pass
+            bud = latency_budget.of_trace(ctx)
+            if bud is not None:
+                bud.advance("fence_hold")
         self._finish_rebuild(pending, ctx, spf_sp, t0, new_db, full)
 
     def _defer_finish(
@@ -641,12 +656,18 @@ class Decision(Actor):
                 except Exception:  # pragma: no cover - logged at source
                     pass
             try:
+                bud = latency_budget.of_trace(ctx)
+                if bud is not None:
+                    # time chained behind the previous finish (plus any
+                    # fence-discard detour) is fence_hold by definition
+                    bud.advance("fence_hold")
                 if self._fence_gen != fence:
                     counters.increment("decision.stream.fenced")
                     if spf_sp is not None:
                         spf_sp.attributes["fenced"] = True
                         tracer.end_span(spf_sp)
                     tracer.end_trace(ctx, status="fenced")
+                    latency_budget.close(bud, status="requeued")
                     self.pending.needs_full_rebuild = True
                     self._trigger_rebuild()
                     return
@@ -670,6 +691,7 @@ class Decision(Actor):
                     "rebuild", self.name,
                 )
                 counters.increment("decision.stream.finish_errors")
+                latency_budget.discard_trace(ctx)
                 self.pending.needs_full_rebuild = True
                 self._trigger_rebuild()
             finally:
@@ -683,6 +705,7 @@ class Decision(Actor):
         if new_db is None:
             tracer.end_span(spf_sp)
             tracer.end_trace(ctx, status="not_in_lsdb")
+            latency_budget.discard_trace(ctx)
             # keep the batch's advertisement memory: these events must
             # still attribute routes once we do appear in the LSDB
             self._ingest_tags.update(pending.provenance_tags)
@@ -728,10 +751,16 @@ class Decision(Actor):
             perf = pending.perf_events or PerfEvents()
             add_perf_event(perf, self.node_name, "ROUTE_UPDATE")
             update.perf_events = perf
+            bud = latency_budget.of_trace(ctx)
+            if bud is not None:
+                # RIB policy + diff + provenance stamping since the
+                # solve landed is payload application
+                bud.advance("payload_apply")
             self._route_updates_q.push(update, trace=ctx)
         else:
             # rebuild produced no RIB delta: the event converged here
             tracer.end_trace(ctx, status="no_change")
+            latency_budget.discard_trace(ctx)
         if not self._first_build_done:
             self._first_build_done = True
             boot_tracer.phase_mark(
@@ -851,23 +880,53 @@ class Decision(Actor):
         as before. Same mid-flight failover as the sync path."""
         fallback = getattr(self.solver, "cpu", None)
         dispatch = getattr(self.solver, "dispatch_route_db", None)
+        bud = latency_budget.of_trace(ctx)
         if not self._degraded:
             try:
                 maybe_fail("solver.exec", span=spf_sp)
                 if dispatch is None:
-                    return self.solver.build_route_db(
+                    db = self.solver.build_route_db(
                         self.node_name, self.area_link_states,
                         self.prefix_state,
                     )
+                    if bud is not None:
+                        bud.advance("device_exec")
+                    return db
                 build = dispatch(
                     self.node_name, self.area_link_states, self.prefix_state
                 )
+                if bud is not None:
+                    # dispatch phase = LSDB delta reads + host->device
+                    # upload, no blocking sync
+                    bud.advance("host_sync")
+
+                def _collect():
+                    if bud is not None:
+                        # executor picked the collect up: everything
+                        # since dispatch returned was queueing gap
+                        bud.advance("dispatch_gap")
+                    return self.solver.collect_route_db(build)
+
                 loop = asyncio.get_running_loop()
                 # collect_route_db is @affinity.executor_safe: phase 2
-                # reads only device buffers + the pending snapshot
-                return await loop.run_in_executor(
-                    None, self.solver.collect_route_db, build
-                )
+                # reads only device buffers + the pending snapshot. The
+                # budget stamp rides along: nothing else touches this
+                # epoch's budget until the await returns.
+                # lint: allow(executor-escape) budget cursor is epoch-private; collect is executor_safe
+                db = await loop.run_in_executor(None, _collect)
+                if bud is not None:
+                    tm = getattr(self.solver, "last_timing", None) or {}
+                    # the collect segment splits by the solver's own
+                    # clocks: device kernels vs host materialize; the
+                    # remainder (blocking sync + drain) is collect_block
+                    bud.advance_split(
+                        {
+                            "device_exec": tm.get("exec_ms"),
+                            "payload_apply": tm.get("mat_ms"),
+                        },
+                        primary="collect_block",
+                    )
+                return db
             except Exception as e:
                 if not self.cfg.enable_solver_failover or fallback is None:
                     raise
@@ -877,9 +936,12 @@ class Decision(Actor):
         tracer.annotate(ctx, degraded=True)
         # the oracle reads LSDB state, so the degraded path stays on the
         # loop (blocking it — acceptable while degraded)
-        return fallback.build_route_db(
+        db = fallback.build_route_db(
             self.node_name, self.area_link_states, self.prefix_state
         )
+        if bud is not None:
+            bud.advance("device_exec")
+        return db
 
     def _enter_degraded(self, exc: Exception) -> None:
         self._degraded = True

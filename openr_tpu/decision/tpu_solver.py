@@ -1168,7 +1168,7 @@ class _PendingBuild:
 
     __slots__ = (
         "route_db", "futures", "t_pipe0", "ksp2_timing",
-        "bytes_uploaded", "delegated",
+        "bytes_uploaded", "delegated", "dispatch_wall_ms",
     )
 
     def __init__(self, route_db, futures=None, t_pipe0=0.0,
@@ -1179,6 +1179,7 @@ class _PendingBuild:
         self.ksp2_timing: dict = {}
         self.bytes_uploaded = 0
         self.delegated = delegated
+        self.dispatch_wall_ms = 0.0
 
 
 _UCMP_ALGOS = (
@@ -1778,6 +1779,9 @@ class TpuSpfSolver:
         pending.ksp2_timing = self._ksp2_timing
         self._ksp2_timing = {}
         pending.bytes_uploaded = self._bytes_uploaded
+        # dispatch/collect boundary for the latency-budget ledger: how
+        # much of the pipeline wall was phase 1 (on-loop) vs phase 2
+        pending.dispatch_wall_ms = (_time.perf_counter() - t_pipe0) * 1e3
         return pending
 
     @affinity.executor_safe
@@ -1795,6 +1799,7 @@ class TpuSpfSolver:
             return route_db
         import time as _time
 
+        t_collect0 = _time.perf_counter()
         views = []
         stages = {"sync_ms": 0.0, "exec_ms": 0.0, "mat_ms": 0.0}
         area_timing: dict[str, dict] = {}
@@ -1881,6 +1886,8 @@ class TpuSpfSolver:
             **stages,
             "pipeline_wall_ms": wall,
             "pipeline_stages_ms": sum(stages.values()),
+            "dispatch_wall_ms": pending.dispatch_wall_ms,
+            "collect_wall_ms": (_time.perf_counter() - t_collect0) * 1e3,
             "areas": area_timing,
             "bytes_uploaded": float(pending.bytes_uploaded),
             "bytes_downloaded": float(bytes_downloaded),

@@ -43,6 +43,7 @@ from openr_tpu.messaging import RQueue, ReplicateQueue
 from openr_tpu.runtime.actor import Actor
 from openr_tpu.runtime.counters import counters
 from openr_tpu.runtime.faults import maybe_fail
+from openr_tpu.runtime.latency_budget import latency_budget
 from openr_tpu.runtime.lifecycle import boot_tracer
 from openr_tpu.runtime.throttle import ExponentialBackoff
 from openr_tpu.runtime.tracing import TraceContext, tracer
@@ -215,7 +216,11 @@ class Fib(Actor):
                 # folded into Decision's initial snapshot; not a
                 # convergence event of its own
                 tracer.end_trace(ctx, status="pre_sync")
+                latency_budget.discard_trace(ctx)
                 return  # wait for Decision's initial snapshot
+            bud = latency_budget.of_trace(ctx)
+            if bud is not None:
+                bud.advance("payload_apply")
             rs.state = FibState.SYNCING
             await self._sync_routes(upd.perf_events, trace=ctx)
             return
@@ -232,12 +237,17 @@ class Fib(Actor):
         for label in upd.mpls_routes_to_delete:
             rs.dirty_labels[label] = now + delete_delay
         tracer.end_span(sp)
+        bud = latency_budget.of_trace(ctx)
+        if bud is not None:
+            # queue hop from Decision plus the fib diff / dirty-marking
+            bud.advance("payload_apply")
         self._pending_perf = upd.perf_events
         if ctx is not None:
             if self._pending_trace is None:
                 self._pending_trace = ctx
             else:
                 tracer.end_trace(ctx, status="coalesced")
+                latency_budget.discard_trace(ctx)
         self._retry_signal.set()
 
     # -- full sync (ref syncRoutes) ----------------------------------------
@@ -256,6 +266,7 @@ class Fib(Actor):
             trace, "platform.program", node=self.node_name, mode="full_sync"
         )
         t_prog = time.monotonic()
+        prog0 = self._service_program_ms()
         # both tables are always attempted — a partial unicast failure must
         # not leave pending MPLS routes unprogrammed (ref syncRoutes covers
         # both with retry)
@@ -288,7 +299,7 @@ class Fib(Actor):
         except Exception as e:
             log.warning("%s: syncFib failed: %s", self.name, e)
             counters.increment("fib.sync_fib_failure")
-            self._end_program(sp, t_prog, ok=False)
+            self._end_program(sp, t_prog, ok=False, trace=trace, prog0=prog0)
             self._park_trace(trace)
             self._schedule_retry()
             return
@@ -302,7 +313,7 @@ class Fib(Actor):
         except Exception as e:
             log.warning("%s: syncMplsFib failed: %s", self.name, e)
             counters.increment("fib.sync_fib_failure")
-            self._end_program(sp, t_prog, ok=False)
+            self._end_program(sp, t_prog, ok=False, trace=trace, prog0=prog0)
             self._park_trace(trace)
             # the unicast sync already ran: publish the unicast routes that
             # DID land as an INCREMENTAL delta (additive — it must not
@@ -330,7 +341,7 @@ class Fib(Actor):
         if failed_p or failed_l:
             # partial: only the failed subset stays dirty; publish ONLY what
             # actually landed (FIB-ACK must never claim unprogrammed routes)
-            self._end_program(sp, t_prog, ok=False)
+            self._end_program(sp, t_prog, ok=False, trace=trace, prog0=prog0)
             now = time.monotonic()
             for p in failed_p:
                 rs.dirty_prefixes[p] = now
@@ -352,7 +363,7 @@ class Fib(Actor):
             )
             self._schedule_retry()
             return
-        self._end_program(sp, t_prog, ok=True)
+        self._end_program(sp, t_prog, ok=True, trace=trace, prog0=prog0)
         rs.dirty_prefixes.clear()
         rs.dirty_labels.clear()
         self._retry_backoff.report_success()
@@ -363,11 +374,38 @@ class Fib(Actor):
             trace=trace,
         )
 
-    def _end_program(self, sp, t_prog: float, ok: bool) -> None:
+    def _end_program(
+        self,
+        sp,
+        t_prog: float,
+        ok: bool,
+        trace: Optional[TraceContext] = None,
+        prog0: Optional[float] = None,
+    ) -> None:
         tracer.end_span(sp, ok=ok)
         counters.add_stat_value(
             "fib.program_ms", (time.monotonic() - t_prog) * 1000.0
         )
+        bud = latency_budget.of_trace(trace)
+        if bud is None:
+            return
+        # budget: when the dataplane handlers self-report their write
+        # time (RemoteFibService.program_ms_total), split the segment
+        # into the netlink write proper vs RPC/ack overhead; otherwise
+        # the whole segment is programming
+        dp_ms = None
+        if prog0 is not None:
+            total = getattr(self.service, "program_ms_total", None)
+            if total is not None:
+                dp_ms = max(0.0, float(total) - prog0)
+        if dp_ms is not None:
+            bud.advance_split({"program": dp_ms}, primary="ack_rtt")
+        else:
+            bud.advance("program")
+
+    def _service_program_ms(self) -> Optional[float]:
+        total = getattr(self.service, "program_ms_total", None)
+        return float(total) if total is not None else None
 
     def _park_trace(self, trace: Optional[TraceContext]) -> None:
         """Hold the trace for the retry that eventually programs."""
@@ -377,6 +415,7 @@ class Fib(Actor):
             self._pending_trace = trace
         else:
             tracer.end_trace(trace, status="coalesced")
+            latency_budget.discard_trace(trace)
 
     def _finish_sync(
         self,
@@ -460,6 +499,7 @@ class Fib(Actor):
             ctx, "platform.program", node=self.node_name, mode="incremental"
         )
         t_prog = now
+        prog0 = self._service_program_ms()
 
         add_prefixes = [
             p
@@ -570,7 +610,7 @@ class Fib(Actor):
             log.warning("%s: delete_mpls failed: %s", self.name, e)
             ok = False
 
-        self._end_program(sp, t_prog, ok=ok)
+        self._end_program(sp, t_prog, ok=ok, trace=ctx, prog0=prog0)
         if not programmed.empty():
             self._publish_programmed(programmed, perf, trace=ctx)
         else:
@@ -620,6 +660,17 @@ class Fib(Actor):
             counters.set_counter("fib.solve_epoch", programmed.solve_epoch)
             self._pending_epoch = None
         self._fib_updates_q.push(programmed, trace=trace)
+        # latency budget: the ack is out — close the epoch's ledger with
+        # the tail attributed to ack_rtt, enforcing the conservation
+        # invariant; the dominant component rides the conv-ack and the
+        # trace so the fleet join can name the straggler STAGE
+        budget_row = latency_budget.close_trace(
+            trace, status="ok", final_component="ack_rtt"
+        )
+        top_comp, top_ms = "", 0.0
+        if budget_row is not None:
+            top_comp = budget_row["top_component"]
+            top_ms = budget_row["top_ms"]
         # fleet-convergence ack: a trace stitched to an origin event
         # reports (origin_event_id, this node, origin->ack latency) back
         # through the kvstore backchannel BEFORE the trace closes (the
@@ -640,6 +691,8 @@ class Fib(Actor):
                     origin_node=str(attrs.get("origin_node") or ""),
                     origin_event_id=str(event_id),
                     fleet_convergence_ms=fleet_ms,
+                    component=top_comp,
+                    component_ms=top_ms,
                 )
             # lint: allow(broad-except) the ack is telemetry — it must
             # never take down route programming
@@ -649,6 +702,9 @@ class Fib(Actor):
         end_attrs = {}
         if programmed.solve_epoch is not None:
             end_attrs["solve_epoch"] = programmed.solve_epoch
+        if top_comp:
+            end_attrs["budget_top"] = top_comp
+            end_attrs["budget_top_ms"] = round(top_ms, 3)
         tracer.end_trace(
             trace,
             status="ok",

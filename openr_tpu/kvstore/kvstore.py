@@ -1346,22 +1346,28 @@ class KvStore(Actor):
         origin_node: str,
         origin_event_id: str,
         fleet_convergence_ms: float,
+        component: str = "",
+        component_ms: float = 0.0,
     ) -> None:
         """Called by Fib when a programmed-routes publication closes a
         trace carrying a remote (or local) origin stamp: append the ack
         to this node's ring and flood it as a TTL'd
         `monitor:conv-ack:<node>` key, so ANY node can join origin
         events to the fleet-wide set of FIB acks and render per-event
-        fleet convergence (origin -> last ack anywhere)."""
-        self._conv_acks.append(
-            {
-                "event": origin_event_id,
-                "origin": origin_node,
-                "node": self.node_name,
-                "ms": round(float(fleet_convergence_ms), 3),
-                "ts_ms": int(time.time() * 1000),
-            }
-        )
+        fleet convergence (origin -> last ack anywhere). `component` is
+        the dominant latency-budget component of this node's epoch, so
+        the fleet join can name the straggler STAGE, not just the node."""
+        ack = {
+            "event": origin_event_id,
+            "origin": origin_node,
+            "node": self.node_name,
+            "ms": round(float(fleet_convergence_ms), 3),
+            "ts_ms": int(time.time() * 1000),
+        }
+        if component:
+            ack["comp"] = component
+            ack["comp_ms"] = round(float(component_ms), 3)
+        self._conv_acks.append(ack)
         counters.increment(f"kvstore.{self.node_name}.conv_acks")
         st = self.areas.get(area) or next(iter(self.areas.values()), None)
         if st is None:

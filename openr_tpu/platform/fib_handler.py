@@ -781,28 +781,27 @@ class FibPlatformServer:
     async def _add_unicast(self, client_id: int, routes: dict) -> dict:
         t0 = time.monotonic()
         failed = await self.dataplane.add_unicast(routes)
-        counters.add_stat_value(
-            "platform.fib.update_ms", (time.monotonic() - t0) * 1e3
-        )
+        dp_ms = (time.monotonic() - t0) * 1e3
+        counters.add_stat_value("platform.fib.update_ms", dp_ms)
         counters.increment("platform.fib.routes_added", len(routes))
-        return {"failed_prefixes": failed}
+        # program_ms rides every response: the client folds it into the
+        # latency-budget ledger's program/ack_rtt split
+        return {"failed_prefixes": failed, "program_ms": round(dp_ms, 3)}
 
     async def _del_unicast(self, client_id: int, prefixes: list) -> dict:
         t0 = time.monotonic()
         failed = await self.dataplane.delete_unicast(prefixes)
-        counters.add_stat_value(
-            "platform.fib.update_ms", (time.monotonic() - t0) * 1e3
-        )
+        dp_ms = (time.monotonic() - t0) * 1e3
+        counters.add_stat_value("platform.fib.update_ms", dp_ms)
         counters.increment("platform.fib.routes_deleted", len(prefixes))
-        return {"failed_prefixes": failed}
+        return {"failed_prefixes": failed, "program_ms": round(dp_ms, 3)}
 
     async def _sync_fib(self, client_id: int, routes: dict) -> dict:
         t0 = time.monotonic()
         failed = await self.dataplane.sync_unicast(routes)
-        counters.add_stat_value(
-            "platform.fib.sync_ms", (time.monotonic() - t0) * 1e3
-        )
-        return {"failed_prefixes": failed}
+        dp_ms = (time.monotonic() - t0) * 1e3
+        counters.add_stat_value("platform.fib.sync_ms", dp_ms)
+        return {"failed_prefixes": failed, "program_ms": round(dp_ms, 3)}
 
     async def _sync_fib_columns(self, client_id: int, batch) -> dict:
         from openr_tpu.decision.column_delta import RouteColumnBatch
@@ -815,27 +814,35 @@ class FibPlatformServer:
         else:
             # dataplane predates the columnar seam — decode to dicts
             failed = await dp.sync_unicast(b.as_route_dicts())
-        counters.add_stat_value(
-            "platform.fib.sync_ms", (time.monotonic() - t0) * 1e3
-        )
+        dp_ms = (time.monotonic() - t0) * 1e3
+        counters.add_stat_value("platform.fib.sync_ms", dp_ms)
         counters.increment("platform.fib.column_syncs")
-        return {"failed_prefixes": failed}
+        return {"failed_prefixes": failed, "program_ms": round(dp_ms, 3)}
 
     async def _add_mpls(self, client_id: int, routes: dict) -> dict:
+        t0 = time.monotonic()
         failed = await self.dataplane.add_mpls(
             {int(k): v for k, v in routes.items()}
         )
-        return {"failed_labels": failed}
+        dp_ms = (time.monotonic() - t0) * 1e3
+        counters.add_stat_value("platform.fib.update_ms", dp_ms)
+        return {"failed_labels": failed, "program_ms": round(dp_ms, 3)}
 
     async def _del_mpls(self, client_id: int, labels: list) -> dict:
+        t0 = time.monotonic()
         failed = await self.dataplane.delete_mpls([int(x) for x in labels])
-        return {"failed_labels": failed or []}
+        dp_ms = (time.monotonic() - t0) * 1e3
+        counters.add_stat_value("platform.fib.update_ms", dp_ms)
+        return {"failed_labels": failed or [], "program_ms": round(dp_ms, 3)}
 
     async def _sync_mpls(self, client_id: int, routes: dict) -> dict:
+        t0 = time.monotonic()
         failed = await self.dataplane.sync_mpls(
             {int(k): v for k, v in routes.items()}
         )
-        return {"failed_labels": failed}
+        dp_ms = (time.monotonic() - t0) * 1e3
+        counters.add_stat_value("platform.fib.sync_ms", dp_ms)
+        return {"failed_labels": failed, "program_ms": round(dp_ms, 3)}
 
     async def _alive_since(self) -> float:
         return self.started_at
@@ -861,9 +868,17 @@ class RemoteFibService(FibServiceBase):
 
     def __init__(self, host: str = "127.0.0.1", port: int = 60100):
         self.client = RpcClient(host, port, name="fib-service")
+        # monotonically accumulated agent-reported dataplane write time;
+        # the Fib actor diffs it around a programming pass to split the
+        # latency budget's program component from RPC/ack overhead
+        self.program_ms_total = 0.0
 
     async def close(self) -> None:
         await self.client.close()
+
+    def _note_program(self, res: Optional[dict]) -> None:
+        if res:
+            self.program_ms_total += float(res.get("program_ms") or 0.0)
 
     @staticmethod
     def _unicast_payload(routes: list[RibUnicastEntry]) -> dict:
@@ -886,6 +901,7 @@ class RemoteFibService(FibServiceBase):
             "platform.fib.add_unicast_routes",
             {"client_id": client_id, "routes": self._unicast_payload(routes)},
         )
+        self._note_program(res)
         self._raise_failed(res)
 
     async def delete_unicast_routes(self, client_id, prefixes) -> None:
@@ -893,6 +909,7 @@ class RemoteFibService(FibServiceBase):
             "platform.fib.delete_unicast_routes",
             {"client_id": client_id, "prefixes": list(prefixes)},
         )
+        self._note_program(res)
         self._raise_failed(res or {})
 
     async def add_mpls_routes(self, client_id, routes) -> None:
@@ -900,6 +917,7 @@ class RemoteFibService(FibServiceBase):
             "platform.fib.add_mpls_routes",
             {"client_id": client_id, "routes": self._mpls_payload(routes)},
         )
+        self._note_program(res)
         self._raise_failed(res)
 
     async def delete_mpls_routes(self, client_id, labels) -> None:
@@ -907,6 +925,7 @@ class RemoteFibService(FibServiceBase):
             "platform.fib.delete_mpls_routes",
             {"client_id": client_id, "labels": list(labels)},
         )
+        self._note_program(res)
         self._raise_failed(res or {})
 
     async def sync_fib(self, client_id, routes) -> None:
@@ -914,6 +933,7 @@ class RemoteFibService(FibServiceBase):
             "platform.fib.sync_fib",
             {"client_id": client_id, "routes": self._unicast_payload(routes)},
         )
+        self._note_program(res)
         self._raise_failed(res)
 
     async def sync_fib_columns(self, client_id, batch) -> None:
@@ -921,6 +941,7 @@ class RemoteFibService(FibServiceBase):
             "platform.fib.sync_fib_columns",
             {"client_id": client_id, "batch": batch.to_wire()},
         )
+        self._note_program(res)
         self._raise_failed(res)
 
     async def sync_mpls_fib(self, client_id, routes) -> None:
@@ -928,6 +949,7 @@ class RemoteFibService(FibServiceBase):
             "platform.fib.sync_mpls_fib",
             {"client_id": client_id, "routes": self._mpls_payload(routes)},
         )
+        self._note_program(res)
         self._raise_failed(res)
 
     async def alive_since(self) -> float:

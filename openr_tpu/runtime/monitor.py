@@ -610,6 +610,14 @@ class Monitor(Actor):
         merged = dict(extra or {})
         if self.slo_engine is not None:
             merged["slo"] = self.slo_engine.report()
+        # latency-budget annex: which component owned the recent epochs'
+        # wall time (and whether conservation held) at trigger time —
+        # SLO-burn and perf-regression triage starts from the waterfall
+        from openr_tpu.runtime.latency_budget import latency_budget
+
+        budget = latency_budget.snapshot()
+        if budget.get("epochs"):
+            merged["budget"] = budget
         # the freeze walks lock-protected registries and the write hits
         # disk — worker thread, never the control-plane event loop
         return await asyncio.to_thread(
@@ -713,6 +721,22 @@ class Monitor(Actor):
         hbm, _ = device_stats.peak_hbm_mb(allow_import=False)
         if hbm:
             obs["peak_hbm_mb"] = float(hbm)
+        # per-component budget baselines: the perf ledger (and therefore
+        # tools/perf_diff.py and the CI gate) diffs the BREAKDOWN — a
+        # regression report names the component that moved, not just the
+        # headline
+        from openr_tpu.runtime.latency_budget import BUDGET_COMPONENTS
+
+        for comp in BUDGET_COMPONENTS:
+            bagg = agg(f"budget.{comp}_ms")
+            if bagg.get("count"):
+                obs[f"budget_{comp}_ms"] = bagg.get("p50", 0.0)
+        be2e = agg("budget.e2e_ms")
+        if be2e.get("count"):
+            obs["budget_e2e_ms"] = be2e.get("p50", 0.0)
+        bun = agg("budget.unattributed_ms")
+        if bun.get("count"):
+            obs["budget_unattributed_ms"] = bun.get("p50", 0.0)
         lg.record("solve", obs, signature="live", variant="live")
 
     async def _metrics_loop(self) -> None:

@@ -348,6 +348,16 @@ class Tracer:
                 return {}
             return dict(tr.spans[0].attributes)
 
+    def trace_start(self, ctx: Optional[TraceContext]) -> Optional[float]:
+        """Monotonic start of an ACTIVE trace, or None — anchors the
+        latency-budget ledger's ``ingest_wait`` at the KvStore receive
+        stamp the ingress passed to start_trace(start=...)."""
+        if ctx is None or not self.enabled:
+            return None
+        with self._lock:
+            tr = self._active.get(ctx.trace_id)
+            return tr.started if tr is not None else None
+
     def annotate(self, ctx: Optional[TraceContext], **attributes) -> None:
         """Stamp attributes onto an active trace's root span without
         closing it — e.g. degraded=True when the solver failed over

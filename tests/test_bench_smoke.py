@@ -78,6 +78,12 @@ def test_bench_config_smoke_device_path():
     # 1 Hz, far below that)
     assert res["flightrec_tick_ms"] >= 0, res
     assert res["flightrec_overhead_pct"] <= 1.0, res
+    # ISSUE 17: the churn loop emits per-component budget columns and
+    # its per-epoch waterfalls conserve — components + residual sum to
+    # the measured e2e, residual under 5%
+    assert res["budget_epochs"] == 2, res
+    assert res["budget_e2e_p99_ms"] > 0, res
+    assert res["budget_unattributed_frac"] < 0.05, res
 
 
 def test_bench_kernel_ab_lane_bucketed_engages_and_rounds_decrease():
@@ -330,3 +336,17 @@ def test_bench_flapstorm_lane_standstill_and_zero_retraces():
     assert res["retraces"] == 0, res
     assert res["ack_p99_ms"] > 0, res
     assert res["fib_routes"] > 0, res
+    # ISSUE 17 tier-1 conservation gate: the lane emits per-component
+    # budget columns and every epoch's waterfall must account for the
+    # measured end-to-end — unattributed residual under 5% of e2e
+    assert res["budget_epochs"] == res["events"], res
+    assert res["budget_e2e_p99_ms"] > 0, res
+    assert any(
+        k.startswith("budget_") and k.endswith("_p99_ms")
+        and not k.startswith(("budget_e2e", "budget_unattributed"))
+        for k in res
+    ), sorted(res)
+    assert res["budget_unattributed_frac"] < 0.05, res
+    tail = res["budget_tail"]
+    assert tail["ranked"], tail
+    assert 0.0 <= tail["top2_coverage"] <= 1.0 + 1e-9, tail

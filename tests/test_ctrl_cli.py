@@ -50,6 +50,77 @@ async def start_two_node(enable_ctrl=True):
     return mesh, a, b
 
 
+class TestConvergenceIdleFallback:
+    @run_async
+    async def test_device_rows_fall_back_to_last_timing_when_aged_out(self):
+        """ISSUE 17 satellite: the windowed decision.device.* stats only
+        answer for the trailing windows, so during idle they age out and
+        `breeze decision convergence` rendered blank device rows.  The
+        handler must fall back to the solver's last_timing snapshot
+        (tagged with its source) instead of returning empty windows."""
+        from openr_tpu.ctrl.ctrl_server import CtrlServer
+        from openr_tpu.runtime.counters import counters
+
+        class _Solver:
+            last_timing = {
+                "spf_kernel": "bucketed",
+                "rounds": 12,
+                "bucket_epochs": 3,
+                "bytes_downloaded": 1308,
+            }
+
+        class _Decision:
+            solver = _Solver()
+
+        # simulate idle: every windowed device stat has aged out
+        for fam in ("rounds", "bucket_epochs", "halo_exchanges",
+                    "bytes_downloaded"):
+            counters.erase_prefix(f"decision.device.{fam}")
+        srv = CtrlServer("node-idle", decision=_Decision())
+        out = await srv._decision_convergence()
+        sol = out["solver"]
+        assert sol["last_solve"]["rounds"] == 12
+        for row, want in (("device_rounds", 12),
+                          ("device_bucket_epochs", 3),
+                          ("device_bytes_downloaded", 1308)):
+            assert sol[row] == {
+                "snapshot": want, "source": "last_timing"
+            }, (row, sol[row])
+        # halo_exchanges absent from last_timing: stays a (blank)
+        # windowed row rather than inventing a snapshot
+        assert "snapshot" not in (sol["device_halo_exchanges"] or {})
+
+        # fresh windowed samples win over the snapshot fallback
+        counters.add_stat_value("decision.device.rounds", 40.0)
+        out = await srv._decision_convergence()
+        rounds = out["solver"]["device_rounds"]
+        assert "snapshot" not in rounds
+        assert any(
+            (w or {}).get("count") for w in rounds.values()
+            if isinstance(w, dict)
+        ), rounds
+
+    @run_async
+    async def test_decision_budget_endpoint_reports_ledger(self):
+        """ctrl.decision.budget returns the latency-budget report with
+        the full taxonomy and conservation block (ISSUE 17)."""
+        from openr_tpu.ctrl.ctrl_server import CtrlServer
+        from openr_tpu.runtime.latency_budget import (
+            BUDGET_COMPONENTS,
+            latency_budget,
+        )
+
+        bud = latency_budget.begin(("ctrl-test", 0))
+        bud.advance("host_sync")
+        latency_budget.close(bud, final_component="ack_rtt")
+        srv = CtrlServer("node-b0", decision=None)
+        out = await srv._decision_budget()
+        assert out["node"] == "node-b0"
+        assert out["taxonomy"] == list(BUDGET_COMPONENTS)
+        assert out["conservation"]["epochs"], out["conservation"]
+        assert out["last_epochs"], out
+
+
 class TestCtrlServer:
     @run_async
     async def test_api_surface(self):
@@ -563,6 +634,16 @@ class TestBreezeCli:
             assert res.exit_code == 0, res.output
             assert "nodes_reporting" in res.output
             assert "fleet_ms" in res.output
+
+            # ISSUE 17 surface: the latency-budget waterfall renders
+            # with its conservation verdict and tail attribution
+            res = runner.invoke(
+                cli, base + ["decision", "budget", "--fleet"], obj={}
+            )
+            assert res.exit_code == 0, res.output
+            assert "latency budget" in res.output
+            assert "unattributed" in res.output
+            assert "conservation" in res.output
 
             res = runner.invoke(cli, base + ["monitor", "slo"], obj={})
             assert res.exit_code == 0, res.output

@@ -277,6 +277,23 @@ def test_metric_stat_families_expand(tmp_path):
     assert codes(metric_check.run(project)) == {"metric-collision"}
 
 
+def test_metric_budget_components_expand(tmp_path):
+    # ISSUE 17: the budget ledger emits `budget.<component>_ms` with a
+    # runtime component name — the checker expands the placeholder over
+    # the canonical taxonomy, so a concrete family colliding with one
+    # of the expanded per-component names is caught
+    project = make_project(tmp_path, {
+        "pkg/mod.py": """\
+            def f(counters, comp):
+                counters.add_stat_value(f"budget.{comp}_ms", 1)
+                counters.increment("budget.host_sync_ms_sum")
+        """,
+    })
+    findings = metric_check.run(project)
+    assert codes(findings) == {"metric-collision"}
+    assert "budget.host_sync_ms" in findings[0].message
+
+
 # -- allowlist round-trip --------------------------------------------------
 
 def test_allowlist_round_trip_and_unused(tmp_path):

@@ -302,6 +302,53 @@ class TestPerfDiff:
         flat = perf_diff._load_bench(str(slow))
         assert "rig_rtt_ms" not in flat
 
+    def test_vanished_lane_is_a_regression(self, tmp_path):
+        """ISSUE 17 satellite: a lane present in the baseline but
+        missing from the candidate is an explicit regression (exit 1),
+        never a neutral skip — a bench config silently not running must
+        not pass the CI gate."""
+        base = tmp_path / "base.json"
+        base.write_text(json.dumps({"configs": {
+            "mesh4": {"tpu_ms": 10.0},
+            "flapstorm_tg1k": {"ack_p99_ms": 20.0},
+        }}))
+        cand = tmp_path / "cand.json"
+        cand.write_text(json.dumps({"configs": {
+            "mesh4": {"tpu_ms": 10.0},
+        }}))
+        # default: EVERY baseline lane is expected -> exit 1 with a
+        # regressed MISSING row naming the lane
+        assert perf_diff.main([str(base), str(cand), "--json"]) == 1
+        rows = perf_diff.vanished_lane_rows(
+            perf_diff._load_bench(str(base)),
+            perf_diff._load_bench(str(cand)),
+        )
+        assert [r["metric"] for r in rows] == ["configs.flapstorm_tg1k"]
+        assert rows[0]["verdict"] == "regressed"
+        assert rows[0]["candidate"] == "MISSING"
+
+    def test_expect_lanes_narrows_the_vanished_check(self, tmp_path):
+        """--expect-lanes lets the smoke gate (which only runs mesh4)
+        pass against the full multi-lane baseline, while a listed lane
+        vanishing still fails."""
+        base = tmp_path / "base.json"
+        base.write_text(json.dumps({"configs": {
+            "mesh4": {"tpu_ms": 10.0},
+            "flapstorm_tg1k": {"ack_p99_ms": 20.0},
+        }}))
+        cand = tmp_path / "cand.json"
+        cand.write_text(json.dumps({"configs": {
+            "mesh4": {"tpu_ms": 10.0},
+        }}))
+        empty = tmp_path / "empty.json"
+        empty.write_text(json.dumps({"configs": {}}))
+        assert perf_diff.main(
+            [str(base), str(cand), "--json", "--expect-lanes", "mesh4"]
+        ) == 0
+        assert perf_diff.main(
+            [str(base), str(empty), "--json", "--expect-lanes", "mesh4"]
+        ) == 1
+
     def test_ledger_mode(self, tmp_path):
         lg = PerfLedger(str(tmp_path / "ledger"))
         for v in (10.0, 10.0, 10.0):

@@ -404,6 +404,96 @@ class TestEpochFence:
             registry.clear()
 
     @run_async
+    async def test_fenced_requeue_budget_accounts_fence_hold(self):
+        """ISSUE 17: a fenced stale finish must close its latency budget
+        as exactly ONE requeued epoch whose waterfall carries a non-zero
+        ``fence_hold`` component — and the requeued row still conserves
+        (components + unattributed == e2e).  The requeue detour is real
+        latency the taxonomy must own, not silently drop."""
+        from openr_tpu.runtime.latency_budget import latency_budget
+        from openr_tpu.runtime.tracing import tracer
+        from openr_tpu.types import Publication
+        from tests.test_decision import AREA
+
+        cfg = DecisionConfig(
+            debounce_min_ms=5, debounce_max_ms=20,
+            async_dispatch=True, streaming_pipeline=True,
+        )
+        registry.clear()
+        try:
+            async with DecisionHarness(config=cfg) as h:
+                two_node_mesh(h)
+                h.synced()
+                await h.next_route_update()
+                d = h.decision
+
+                gate = asyncio.Event()
+                hold = asyncio.ensure_future(gate.wait())
+                d._stream_finish = hold
+
+                f0 = _cnt("decision.stream.fenced")
+                rq0 = _cnt("budget.requeued_epochs")
+                g0 = d._fence_gen
+
+                # epoch A rides a convergence trace (as production
+                # publications from KvStore._merge_and_flood do), so the
+                # budget ledger tracks it end to end
+                ctx = tracer.start_trace("convergence", node="1")
+                h.kv_q.push(
+                    Publication(
+                        key_vals=dict([
+                            adj_db_kv("1", [adj("1", "2", metric=5)],
+                                      version=2),
+                            adj_db_kv("2", [adj("2", "1", metric=5)],
+                                      version=2),
+                        ]),
+                        area=AREA,
+                    ),
+                    trace=ctx,
+                )
+                await _wait(lambda: d._stream_finish is not hold)
+
+                # epoch B's dispatch-fiber crash restarts the fiber and
+                # bumps the fence over epoch A's still-queued finish
+                registry.arm("solver.dispatch", every_nth=1, max_fires=1)
+                h.publish(
+                    adj_db_kv("1", [adj("1", "2", metric=7)], version=3),
+                    adj_db_kv("2", [adj("2", "1", metric=7)], version=3),
+                )
+                await _wait(lambda: d._fence_gen > g0)
+                gate.set()
+
+                # recovery converges on metric 7; A's finish has fenced
+                while True:
+                    upd = await h.next_route_update(timeout=10)
+                    e = upd.unicast_routes_to_update.get("10.0.0.2/32")
+                    if e is not None and e.igp_cost == 7:
+                        break
+                await _wait(
+                    lambda: _cnt("decision.stream.fenced") == f0 + 1
+                )
+
+                # exactly one requeued epoch in the ledger
+                assert _cnt("budget.requeued_epochs") == rq0 + 1
+                rows = [
+                    r for r in latency_budget.last_epochs(64)
+                    if r["status"] == "requeued"
+                    and r["key"] == str(("trace", ctx.trace_id))
+                ]
+                assert len(rows) == 1, rows
+                row = rows[0]
+                # the fence detour is owned by fence_hold, non-zero
+                assert row["components"].get("fence_hold", 0.0) > 0.0, row
+                # and the requeued row still conserves
+                total = (
+                    sum(row["components"].values())
+                    + row["unattributed_ms"]
+                )
+                assert abs(total - row["e2e_ms"]) <= 0.05, row
+        finally:
+            registry.clear()
+
+    @run_async
     async def test_streaming_off_keeps_inline_finish(self):
         """Config gate: with streaming_pipeline=False (the PR 12 path)
         no finish is ever deferred — the bisection knob documented in

@@ -32,6 +32,7 @@ CODE_INVALID = "metric-invalid"
 
 sys.path.insert(0, str(REPO_ROOT))
 
+from openr_tpu.runtime.latency_budget import BUDGET_COMPONENTS  # noqa: E402
 from openr_tpu.runtime.lifecycle import BOOT_PHASES  # noqa: E402
 from openr_tpu.runtime.metrics_export import (  # noqa: E402
     is_valid_metric_name,
@@ -100,6 +101,15 @@ def run(project: Project) -> list[Finding]:
     if boot_site is not None:
         for phase in BOOT_PHASES:
             counter_names.setdefault(f"boot.phase.{phase}_ms", boot_site)
+    # Same closed-vocabulary expansion for the latency-budget ledger
+    # (runtime/latency_budget.py): `budget.<component>_ms` stats are
+    # emitted with a runtime component name drawn from the canonical
+    # BUDGET_COMPONENTS taxonomy — expand the placeholder so every
+    # concrete per-component family participates in collision checking.
+    budget_site = stat_names.pop(f"budget.{PLACEHOLDER}_ms", None)
+    if budget_site is not None:
+        for comp in BUDGET_COMPONENTS:
+            stat_names.setdefault(f"budget.{comp}_ms", budget_site)
     findings: list[Finding] = []
     # exposition family -> (raw name, site); stats expand to their
     # derived families so `a.b` (stat) vs `a.b_max` (counter) is caught

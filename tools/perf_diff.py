@@ -67,6 +67,47 @@ def flatten(doc, prefix: str = "") -> dict[str, float]:
     return out
 
 
+def lanes_of(flat: dict[str, float]) -> set[str]:
+    """Bench lane (config) names present in a flattened bench doc —
+    every `configs.<name>.*` path contributes <name>."""
+    lanes = set()
+    for key in flat:
+        if key.startswith("configs."):
+            rest = key[len("configs."):]
+            if "." in rest:
+                lanes.add(rest.split(".", 1)[0])
+    return lanes
+
+
+def vanished_lane_rows(
+    baseline: dict[str, float],
+    candidate: dict[str, float],
+    expect_lanes: set[str] | None = None,
+) -> list[dict]:
+    """A lane present in the baseline but absent from the candidate is
+    an explicit regression, not a neutral skip — a silently-skipped
+    bench config must not pass the CI gate. `expect_lanes` narrows the
+    check (a smoke gate that only runs mesh4 passes --expect-lanes
+    mesh4); None means every baseline lane is expected."""
+    base_lanes = lanes_of(baseline)
+    cand_lanes = lanes_of(candidate)
+    expected = base_lanes if expect_lanes is None else (
+        base_lanes & set(expect_lanes)
+    )
+    rows = []
+    for lane in sorted(expected - cand_lanes):
+        rows.append(
+            {
+                "metric": f"configs.{lane}",
+                "baseline": "present",
+                "candidate": "MISSING",
+                "delta_pct": None,
+                "verdict": "regressed",
+            }
+        )
+    return rows
+
+
 def compare(
     baseline: dict[str, float],
     candidate: dict[str, float],
@@ -160,6 +201,11 @@ def main(argv=None) -> int:
     p.add_argument("--min-value", type=float, default=1.0,
                    help="skip metrics below this on both sides — "
                    "sub-threshold timings are runner noise (default 1)")
+    p.add_argument("--expect-lanes", default=None, metavar="NAMES",
+                   help="comma-separated bench lanes the candidate must "
+                   "contain; a listed (or, without this flag, ANY "
+                   "baseline) lane missing from the candidate is a "
+                   "regression — a skipped config can't pass the gate")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable verdict rows")
     args = p.parse_args(argv)
@@ -173,7 +219,13 @@ def main(argv=None) -> int:
         base = _load_bench(args.baseline)
         cand = _load_bench(args.candidate)
 
-    rows = compare(base, cand, args.threshold, args.min_value)
+    expect = (
+        {s for s in args.expect_lanes.split(",") if s}
+        if args.expect_lanes is not None
+        else None
+    )
+    rows = vanished_lane_rows(base, cand, expect)
+    rows += compare(base, cand, args.threshold, args.min_value)
     regressed = [r for r in rows if r["verdict"] == "regressed"]
     if args.as_json:
         print(json.dumps({"rows": rows, "regressed": len(regressed)}))
