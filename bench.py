@@ -594,12 +594,14 @@ def bench_flapstorm(name, gen, me, events=100, rate_hz=100.0,
     victims = list(range(1, flap_victims + 1))
     interval = 1.0 / rate_hz
 
+    from openr_tpu.decision.rib_digest import GENESIS, delta_digest, roll
     from openr_tpu.runtime.latency_budget import latency_budget
 
     async def _storm():
         nonlocal db
         acks, dl_bytes, rows, engaged, overflows = [], [], [], 0, 0
-        budget_rows = []
+        budget_rows, dig_ms = [], []
+        rolling = GENESIS
         dispatch = getattr(tpu, "dispatch_route_db", None)
         start = time.perf_counter()
         for i in range(events):
@@ -650,6 +652,15 @@ def bench_flapstorm(name, gen, me, events=100, rate_hz=100.0,
                 latency_budget.close(bud, final_component="ack_rtt")
             )
             acks.append((time.perf_counter() - t_ev) * 1e3)
+            # per-epoch RIB digest (ISSUE 18 replay recorder): the same
+            # delta_digest the Decision actor stamps on every solve —
+            # timed OUTSIDE the ack window so the headline churn-to-ack
+            # keys stay comparable against pre-recorder baselines, with
+            # the cost reported as its own columns (the ≤1% steady-state
+            # overhead demonstration)
+            t_dig = time.perf_counter()
+            rolling = roll(rolling, delta_digest(update))
+            dig_ms.append((time.perf_counter() - t_dig) * 1e3)
             db = new_db
             tm = getattr(tpu, "last_timing", {})
             dl_bytes.append(int(tm.get("bytes_downloaded") or 0))
@@ -660,12 +671,12 @@ def bench_flapstorm(name, gen, me, events=100, rate_hz=100.0,
             rows.append(int(st.get("changed_rows") or 0))
         wall_s = time.perf_counter() - start
         return (
-            acks, dl_bytes, rows, engaged, overflows, wall_s, budget_rows
+            acks, dl_bytes, rows, engaged, overflows, wall_s,
+            budget_rows, dig_ms,
         )
 
-    acks, dl_bytes, rows, engaged, overflows, wall_s, budget_rows = (
-        _asyncio.run(_storm())
-    )
+    (acks, dl_bytes, rows, engaged, overflows, wall_s, budget_rows,
+     dig_ms) = _asyncio.run(_storm())
     # idle epoch: nothing changed since the last solve — the streaming
     # payload still ships (count=0), so the download stands still at
     # exactly one within-budget payload
@@ -696,12 +707,25 @@ def bench_flapstorm(name, gen, me, events=100, rate_hz=100.0,
             - retrace0
         ),
     }
+    if dig_ms:
+        sd = sorted(dig_ms)
+        res["rib_digest_p50_ms"] = round(_percentile(sd, 50.0), 3)
+        res["rib_digest_p99_ms"] = round(_percentile(sd, 99.0), 3)
+        # steady-state recorder overhead: digest time as a fraction of
+        # the churn-to-ack interval it would ride inside in production
+        res["rib_digest_overhead_pct"] = round(
+            100.0 * sum(dig_ms) / max(sum(acks), 1e-9), 2
+        )
     res.update(_budget_summary(budget_rows))
     log(f"[{name}] flapstorm: ack p50 {res['ack_p50_ms']} / p99 "
         f"{res['ack_p99_ms']} ms at {res['achieved_rate_hz']} ev/s "
         f"(asked {rate_hz}) / dl {res['bytes_downloaded_per_epoch']} B "
         f"per epoch (full {full_bytes} B) / idle {idle_bytes} B "
         f"/ engaged {engaged}/{events}")
+    if dig_ms:
+        log(f"[{name}] rib digest: p50 {res['rib_digest_p50_ms']} / p99 "
+            f"{res['rib_digest_p99_ms']} ms "
+            f"({res['rib_digest_overhead_pct']}% of churn-to-ack)")
     tail = (res.get("budget_tail") or {}).get("ranked") or []
     log(f"[{name}] budget: e2e p99 {res.get('budget_e2e_p99_ms')} ms, "
         f"unattributed frac {res.get('budget_unattributed_frac')}, "

@@ -487,6 +487,19 @@ def decision_convergence(ctx, fleet) -> None:
     _print(_call(ctx, "ctrl.decision.convergence", {"fleet": fleet}))
 
 
+@decision.command("replay")
+@click.pass_context
+def decision_replay(ctx) -> None:
+    """Input black-box recorder + RIB-digest status: current solve
+    epoch, per-epoch and rolling RIB digests, recorder ring fill,
+    snapshot anchor (cursor + base epoch), and the digest-ledger tail.
+    Bit-compare the rolling digest across replicas to localize a
+    RIB-level divergence; replay a recorded bundle offline with
+    `python -m tools.replay` (docs/Observability.md § Record &
+    replay)."""
+    _print(_call(ctx, "ctrl.decision.replay"))
+
+
 @decision.command("budget")
 @click.option(
     "--fleet",
@@ -1094,6 +1107,28 @@ def monitor_dump(ctx, reason) -> None:
     (bundle.json + Chrome trace.json) and prints its path. Bypasses
     the automatic-trigger rate limit."""
     _print(_call(ctx, "ctrl.monitor.dump", {"reason": reason}))
+
+
+@monitor.command("bundles")
+@click.pass_context
+def monitor_bundles(ctx) -> None:
+    """List flight-recorder bundles: what survives on disk after
+    retention (monitor_config.flight_recorder_keep newest) plus the
+    in-memory record ring, with each bundle's trigger reason and
+    whether it carries a replayable `inputs` annex."""
+    _print(_call(ctx, "ctrl.monitor.bundles"))
+
+
+@monitor.command("record")
+@click.option("--reason", default="record", help="trigger attribution "
+              "recorded in the bundle")
+@click.pass_context
+def monitor_record(ctx, reason) -> None:
+    """Freeze a REPLAYABLE bundle: asks the input black-box recorder
+    to re-anchor its LSDB snapshot at the next solve, then writes a
+    bundle carrying the `inputs` annex (snapshot + event ring + digest
+    ledger). Feed the printed path to `python -m tools.replay`."""
+    _print(_call(ctx, "ctrl.monitor.record", {"reason": reason}))
 
 
 @monitor.command("statistics")

@@ -224,6 +224,21 @@ class DecisionConfig:
     # — the first bisection step for a streaming regression
     # (docs/Operations.md).
     streaming_pipeline: bool = False
+    # input black-box recorder (runtime/replay_log.py): always-on
+    # bounded ring of every publication delta Decision consumes +
+    # periodic LSDB snapshot anchors + the per-epoch RIB digest ledger,
+    # exported as the flight-recorder `inputs` annex so any incident
+    # bundle replays offline through tools/replay.py
+    # (docs/Observability.md § Record & replay). replay_ring bounds the
+    # event ring in EVENTS (a steady-state churn event is a few hundred
+    # bytes: one serialized adj/prefix db + key strings);
+    # replay_snapshot_every_epochs re-anchors the snapshot so the ring
+    # only ever needs to span that many solve epochs' events — size the
+    # pair so ring >= snapshot_every * typical events-per-epoch or the
+    # recorder counts replay.ring_gaps and re-anchors early.
+    replay_recorder: bool = True
+    replay_ring: int = 8192
+    replay_snapshot_every_epochs: int = 1024
 
 
 @dataclass
@@ -364,6 +379,12 @@ class MonitorConfig:
     flight_recorder_ring: int = 32
     # auto-trigger rate limit: a flapping trigger must not fill the disk
     flight_recorder_min_interval_s: float = 30.0
+    # on-disk retention: after each bundle write, prune this node's
+    # bundle directories down to the newest N (the in-memory deque was
+    # always capped at 8; the DISK was unbounded before this). 0 keeps
+    # everything — prunes count in monitor.flight_recorder.pruned and
+    # `breeze monitor bundles` lists what's on disk.
+    flight_recorder_keep: int = 16
     # --- perf-baseline ledger (docs/Observability.md § Perf baselines) ---
     # directory for the persistent perf ledger (runtime/perf_ledger.py):
     # rolling per-kernel timing baselines the `baseline_drift` SLO kind
@@ -731,6 +752,17 @@ class Config:
                 f"decision streaming_pipeline must be a bool, got "
                 f"{dc.streaming_pipeline!r}"
             )
+        if not isinstance(dc.replay_recorder, bool):
+            raise ConfigError(
+                f"decision replay_recorder must be a bool, got "
+                f"{dc.replay_recorder!r}"
+            )
+        if dc.replay_ring < 1:
+            raise ConfigError("decision replay_ring must be >= 1")
+        if dc.replay_snapshot_every_epochs < 1:
+            raise ConfigError(
+                "decision replay_snapshot_every_epochs must be >= 1"
+            )
         pc = cfg.platform_config
         if pc.bulk_threshold < 1:
             raise ConfigError("platform bulk_threshold must be >= 1")
@@ -794,6 +826,10 @@ class Config:
                 raise ConfigError(f"monitor slos[{name!r}] needs a 'threshold'")
         if mc.flight_recorder_ring < 1:
             raise ConfigError("monitor flight_recorder_ring must be >= 1")
+        if mc.flight_recorder_keep < 0:
+            raise ConfigError(
+                "monitor flight_recorder_keep must be >= 0 (0 = keep all)"
+            )
         if mc.perf_ledger_record_interval_s <= 0:
             raise ConfigError(
                 "monitor perf_ledger_record_interval_s must be positive"

@@ -88,6 +88,8 @@ class CtrlServer(Actor):
         s.register("ctrl.monitor.slo", self._monitor_slo)
         s.register("ctrl.monitor.boot", self._monitor_boot)
         s.register("ctrl.monitor.dump", self._monitor_dump)
+        s.register("ctrl.monitor.bundles", self._monitor_bundles)
+        s.register("ctrl.monitor.record", self._monitor_record)
         # fault-injection registry (runtime/faults.py): arm / disarm /
         # inspect chaos drills on the live daemon
         s.register("ctrl.fault.inject", self._fault_inject)
@@ -149,6 +151,7 @@ class CtrlServer(Actor):
                 "ctrl.decision.convergence", self._decision_convergence
             )
             s.register("ctrl.decision.budget", self._decision_budget)
+            s.register("ctrl.decision.replay", self._decision_replay)
             s.register("ctrl.decision.whatif.sweep", self._whatif_sweep)
             s.register("ctrl.decision.whatif.drain", self._whatif_drain)
             s.register(
@@ -485,6 +488,23 @@ class CtrlServer(Actor):
         if self.monitor is None:
             raise RuntimeError("no monitor wired to ctrl")
         return await self.monitor.dump_flight_recorder(reason=reason)
+
+    async def _monitor_bundles(self) -> dict:
+        """Flight-recorder bundle listing (disk + memory)."""
+        if self.monitor is None:
+            raise RuntimeError("no monitor wired to ctrl")
+        return await self.monitor.flight_recorder_bundles()
+
+    async def _monitor_record(self, reason: str = "record") -> dict:
+        """Operator-requested replayable bundle (inputs annex +
+        snapshot re-anchor request)."""
+        if self.monitor is None:
+            raise RuntimeError("no monitor wired to ctrl")
+        return await self.monitor.record_replay_bundle(reason=reason)
+
+    async def _decision_replay(self) -> dict:
+        """Input-recorder / RIB-digest status (runtime/replay_log.py)."""
+        return await self.decision.replay_status()
 
     async def _watch_initialization(self, queue: ReplicateQueue) -> None:
         reader = queue.get_reader(f"{self.name}.init")

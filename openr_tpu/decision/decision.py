@@ -24,6 +24,8 @@ Role of the reference's openr/decision/Decision.{h,cpp} (:130):
 from __future__ import annotations
 
 import asyncio
+import hashlib
+import json
 import logging
 import time
 from dataclasses import dataclass, field
@@ -39,6 +41,12 @@ from openr_tpu.decision.rib import (
     RouteProvenance,
     RouteUpdateType,
 )
+from openr_tpu.decision.rib_digest import (
+    GENESIS,
+    as_counter_value,
+    delta_digest,
+    roll,
+)
 from openr_tpu.decision.rib_policy import RibPolicy
 from openr_tpu.decision.spf_solver import SpfSolver
 from openr_tpu.messaging import RQueue, ReplicateQueue
@@ -48,9 +56,11 @@ from openr_tpu.runtime.lifecycle import boot_tracer
 from openr_tpu.serde import from_plain, to_plain
 from openr_tpu.runtime.counters import counters
 from openr_tpu.runtime.latency_budget import latency_budget
+from openr_tpu.runtime.replay_log import ReplayRecorder
+from openr_tpu.runtime.replay_log import register as replay_register
 from openr_tpu.runtime.throttle import AsyncDebounce, ExponentialBackoff
 from openr_tpu.runtime.tracing import TraceContext, tracer
-from openr_tpu.serde import deserialize
+from openr_tpu.serde import deserialize, serialize
 from openr_tpu.types import (
     Adjacency,
     AdjacencyDatabase,
@@ -60,8 +70,10 @@ from openr_tpu.types import (
     PrefixEntry,
     Publication,
     add_perf_event,
+    adj_key,
     parse_adj_key,
     parse_prefix_key,
+    prefix_key,
     replace,
 )
 
@@ -87,6 +99,13 @@ class PendingUpdates:
     # routes with the event that actually changed them
     provenance_tags: dict[str, tuple] = field(default_factory=dict)
     topo_tag: Optional[tuple] = None
+    # replay recorder (runtime/replay_log.py): the event-ring cursor at
+    # this batch's solve-read and, when a snapshot anchor came due, the
+    # pending anchor — both captured in _begin_rebuild and committed in
+    # _finish_rebuild, riding the batch so overlapped streaming epochs
+    # keep their own boundaries
+    replay_cursor: int = 0
+    replay_snapshot: Optional[dict] = None
 
     def apply_link_state_change(
         self, change: LinkStateChange, node_name: str
@@ -108,6 +127,8 @@ class PendingUpdates:
         self.trace = None
         self.provenance_tags = {}
         self.topo_tag = None
+        self.replay_cursor = 0
+        self.replay_snapshot = None
 
 
 def make_solver(
@@ -254,6 +275,24 @@ class Decision(Actor):
         self._provenance = ProvenanceLedger()
         self._ingest_tags: dict[str, tuple] = {}
         self._solve_epoch = 0
+        # per-epoch RIB digests (decision/rib_digest.py): the delta
+        # digest of the last finish plus the rolling session chain —
+        # stamped on every convergence trace and exported through the
+        # counter fabric as the RIB-level divergence beacon
+        self.last_rib_digest = GENESIS
+        self._rib_rolling = GENESIS
+        # input black-box recorder (runtime/replay_log.py): every
+        # consumed publication delta + periodic LSDB snapshot anchors +
+        # the per-epoch digest ledger, exported as the flight-recorder
+        # `inputs` annex so incidents replay offline (tools/replay.py)
+        self._replay: Optional[ReplayRecorder] = None
+        if config.replay_recorder:
+            self._replay = replay_register(ReplayRecorder(
+                node_name,
+                ring=config.replay_ring,
+                snapshot_every=config.replay_snapshot_every_epochs,
+                meta=self._replay_meta(backend),
+            ))
         # streaming-pipeline epoch overlap: with
         # cfg.streaming_pipeline + async_dispatch, epoch N's finish
         # (RIB diff, provenance stamp, FIB push) runs as a deferred
@@ -366,15 +405,24 @@ class Decision(Actor):
         area = pub.area
         ctx = tracer.context_of(pub)
         before = self.pending.count
+        rec = self._replay
+        recv_t = pub.recv_t
         with tracer.span(ctx, "decision.lsdb_apply", node=self.node_name):
             for key, value in pub.key_vals.items():
                 if value.value is None:
                     continue  # ttl refresh only
                 self._update_key_in_lsdb(area, key, value.value)
                 self._note_ingest(area, key, value.originator_id)
+                if rec is not None:
+                    rec.record_kv(
+                        area, key, value.version, value.originator_id,
+                        value.value, recv_t,
+                    )
             for key in pub.expired_keys:
                 self._delete_key_from_lsdb(area, key)
                 self._note_ingest(area, key, "<expired>")
+                if rec is not None:
+                    rec.record_expired(area, key, recv_t)
         if ctx is not None:
             if self.pending.count == before:
                 # nothing route-relevant changed; close so the trace
@@ -562,6 +610,16 @@ class Decision(Actor):
             or self._degraded
         )
         t0 = time.perf_counter()
+        if self._replay is not None:
+            # this is the one point where LSDB state and event cursor
+            # are exactly the solve's input (no await between here and
+            # the solver's LSDB read) — capture the epoch boundary, and
+            # the snapshot anchor when one is due
+            pending.replay_cursor = self._replay.cursor()
+            if self._replay.snapshot_due():
+                pending.replay_snapshot = self._replay.take_snapshot(
+                    self.replay_snapshot_kv()
+                )
         spf_sp = tracer.start_span(
             ctx, "decision.spf", node=self.node_name, full=full
         )
@@ -709,6 +767,10 @@ class Decision(Actor):
             # keep the batch's advertisement memory: these events must
             # still attribute routes once we do appear in the LSDB
             self._ingest_tags.update(pending.provenance_tags)
+            if self._replay is not None:
+                # no epoch finished: a snapshot anchor captured for this
+                # solve has no base epoch — re-arm instead of committing
+                self._replay.abort_snapshot(pending.replay_snapshot)
             return  # we are not yet in the LSDB
         tracer.end_span(spf_sp)
         counters.add_stat_value(
@@ -741,6 +803,46 @@ class Decision(Actor):
         self._solve_epoch += 1
         counters.set_counter("decision.solve_epoch", self._solve_epoch)
         update.solve_epoch = self._solve_epoch
+        # per-epoch RIB digest: semantic fingerprint of this delta,
+        # chained into the rolling session digest — the RIB-level
+        # divergence beacon (counter fabric) and the replay harness's
+        # bit-identity oracle (trace stamp + recorder ledger)
+        t_dig = time.perf_counter()
+        digest = delta_digest(update)
+        self.last_rib_digest = digest
+        self._rib_rolling = roll(self._rib_rolling, digest)
+        counters.add_stat_value(
+            "decision.rib_digest.compute_ms",
+            (time.perf_counter() - t_dig) * 1e3,
+        )
+        counters.set_counter(
+            "decision.rib_digest.epoch", self._solve_epoch
+        )
+        counters.set_counter(
+            "decision.rib_digest.value", as_counter_value(digest)
+        )
+        counters.set_counter(
+            "decision.rib_digest.rolling",
+            as_counter_value(self._rib_rolling),
+        )
+        if spf_sp is not None:
+            spf_sp.attributes["rib_digest"] = digest
+        tracer.annotate(ctx, rib_digest=digest)
+        if self._replay is not None:
+            tm = getattr(self.solver, "last_timing", None)
+            self._replay.record_epoch(
+                epoch=self._solve_epoch,
+                cursor=pending.replay_cursor,
+                digest=digest,
+                rolling=self._rib_rolling,
+                solver_kind=self._solver_kind(full),
+                spf_kernel=self.cfg.spf_kernel,
+                full=full,
+                stream=(
+                    tm.get("stream") if isinstance(tm, dict) else None
+                ),
+                snapshot=pending.replay_snapshot,
+            )
         self._stamp_provenance(update, pending, full)
 
         if not self._first_build_done:
@@ -840,6 +942,74 @@ class Decision(Actor):
         # (after stamping: a delete+re-advertise in one batch must tag
         # with the new event, not the popped one)
         self._ingest_tags.update(pending.provenance_tags)
+
+    # -- incident replay (runtime/replay_log.py, tools/replay.py) ----------
+
+    def _replay_meta(self, backend: str) -> dict:
+        """Recorder annex metadata: config fingerprint + capacity
+        signature — enough for the replay harness to flag a bundle
+        whose recording config differs from the replaying one."""
+        cfg = self.cfg
+        fingerprint = hashlib.blake2b(
+            json.dumps(
+                to_plain(cfg), sort_keys=True, default=str
+            ).encode(),
+            digest_size=8,
+        ).hexdigest()
+        return {
+            "config_fingerprint": fingerprint,
+            "capacity": {
+                "fuse_n_cap": cfg.fuse_n_cap,
+                "auto_small_graph_nodes": cfg.auto_small_graph_nodes,
+                "multichip_n_cap_threshold": (
+                    cfg.multichip_n_cap_threshold
+                ),
+                "multichip_batch": cfg.multichip_batch,
+            },
+            "solver_backend": backend,
+            "spf_kernel": cfg.spf_kernel,
+            "streaming_pipeline": cfg.streaming_pipeline,
+            "incremental_spf": cfg.incremental_spf,
+        }
+
+    def replay_snapshot_kv(self) -> dict:
+        """Raw kv form of the parsed LSDB for the recorder's snapshot
+        anchor: adjacency/prefix databases re-serialized under exactly
+        the keys KvStore publishes, so replay ingests the anchor
+        through the same deserialize/apply path as live events.
+        Versions are synthetic (replay feeds Decision directly — no
+        CRDT merge to win)."""
+        out: dict[str, dict] = {}
+        for area, ls in self.area_link_states.items():
+            kvs = out.setdefault(area, {})
+            for node, db in ls.get_adjacency_databases().items():
+                kvs[adj_key(node)] = (1, node, serialize(db))
+        for prefix, entries in self.prefix_state.prefixes().items():
+            for (node, p_area), entry in entries.items():
+                db = PrefixDatabase(
+                    this_node_name=node,
+                    prefix_entries=(entry,),
+                    area=p_area,
+                )
+                out.setdefault(p_area, {})[
+                    prefix_key(node, p_area, prefix)
+                ] = (1, node, serialize(db))
+        return out
+
+    async def replay_status(self) -> dict:
+        """ctrl.decision.replay payload: digest state + recorder
+        health."""
+        out = {
+            "node": self.node_name,
+            "solve_epoch": self._solve_epoch,
+            "rib_digest": self.last_rib_digest,
+            "rolling_digest": self._rib_rolling,
+        }
+        if self._replay is not None:
+            out["recorder"] = self._replay.status()
+        else:
+            out["recorder"] = {"enabled": False}
+        return out
 
     # -- mid-flight solver failover ----------------------------------------
 

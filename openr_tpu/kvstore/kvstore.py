@@ -570,6 +570,10 @@ class KvStore(Actor):
             self._flood(st, out, sender_id=sender_id)
 
     def _publish_local(self, pub: Publication, trace=None) -> None:
+        # receive stamp for the input black-box recorder: Decision logs
+        # each event at the time THIS store handed it over, so replay
+        # timelines show kvstore-merge time, not ingest-dequeue time
+        pub.recv_t = time.monotonic()
         self._updates_q.push(pub, trace=trace)
 
     def _flood(self, st: KvStoreArea, pub: Publication, sender_id: str) -> None:

@@ -20,6 +20,7 @@ import json
 import logging
 import os
 import resource
+import shutil
 import sys
 import tempfile
 import time
@@ -455,7 +456,74 @@ class FlightRecorder:
             json.dump(bundle, f, indent=1, sort_keys=True, default=str)
         with open(os.path.join(path, "trace.json"), "w") as f:
             f.write(tracer.export_chrome_json(limit=self._ring))
+        self._prune()
         return path
+
+    def _disk_bundles(self) -> list[dict]:
+        """This node's bundle directories on disk, newest first. Only
+        OUR prefix: several in-process nodes may share the directory."""
+        prefix = f"{self.node_name}-"
+        out = []
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return out
+        for name in names:
+            if not name.startswith(prefix):
+                continue
+            path = os.path.join(self.dir, name)
+            if not os.path.isdir(path):
+                continue
+            try:
+                mtime = os.path.getmtime(path)
+            except OSError:
+                continue
+            rest = name[len(prefix):]
+            ts_ms, _, reason = rest.partition("-")
+            out.append({
+                "path": path,
+                "reason": reason,
+                "ts_ms": int(ts_ms) if ts_ms.isdigit() else 0,
+                "mtime": mtime,
+                "replayable": os.path.exists(
+                    os.path.join(path, "bundle.json")
+                ),
+            })
+        out.sort(key=lambda b: (b["mtime"], b["path"]), reverse=True)
+        return out
+
+    def _prune(self) -> None:
+        """On-disk retention: keep the newest flight_recorder_keep of
+        this node's bundle directories (0 = unbounded, the pre-retention
+        behavior). The in-memory deque was always capped; the disk was
+        not — a flapping trigger must not fill the partition."""
+        keep = int(getattr(self.cfg, "flight_recorder_keep", 0))
+        if keep <= 0:
+            return
+        for stale in self._disk_bundles()[keep:]:
+            try:
+                shutil.rmtree(stale["path"])
+            except OSError:
+                counters.increment("monitor.flight_recorder.write_errors")
+                log.warning(
+                    "flight recorder: prune failed for %s",
+                    stale["path"], exc_info=True,
+                )
+                continue
+            counters.increment("monitor.flight_recorder.pruned")
+
+    def list_bundles(self) -> dict:
+        """`breeze monitor bundles` payload: what is on disk (post
+        retention) and what the in-memory record ring remembers."""
+        disk = self._disk_bundles()
+        for b in disk:
+            b.pop("mtime", None)
+        return {
+            "dir": self.dir,
+            "keep": int(getattr(self.cfg, "flight_recorder_keep", 0)),
+            "disk": disk,
+            "memory": list(self.bundles),
+        }
 
 
 class Monitor(Actor):
@@ -618,6 +686,17 @@ class Monitor(Actor):
         budget = latency_budget.snapshot()
         if budget.get("epochs"):
             merged["budget"] = budget
+        # inputs annex: the black-box recorder's LSDB snapshot + event
+        # ring + per-epoch digest ledger — what makes this bundle
+        # replayable offline (tools/replay.py). Built here on the loop
+        # (the recorder is loop-owned Decision state), cheap copy.
+        from openr_tpu.runtime.replay_log import get_recorder
+
+        replay_rec = get_recorder(self.node_name)
+        if replay_rec is not None:
+            inputs = replay_rec.export()
+            if inputs is not None:
+                merged["inputs"] = inputs
         # the freeze walks lock-protected registries and the write hits
         # disk — worker thread, never the control-plane event loop
         return await asyncio.to_thread(
@@ -924,6 +1003,26 @@ class Monitor(Actor):
         if record is None:
             return {"ok": False, "error": "bundle write failed"}
         return {"ok": True, **record}
+
+    async def flight_recorder_bundles(self) -> dict:
+        """ctrl.monitor.bundles — on-disk + in-memory bundle listing."""
+        if self.flight_recorder is None:
+            return {"ok": False, "error": "flight recorder disabled"}
+        return {"ok": True, **self.flight_recorder.list_bundles()}
+
+    async def record_replay_bundle(self, reason: str = "record") -> dict:
+        """ctrl.monitor.record — operator-requested REPLAYABLE bundle:
+        asks the input recorder to re-anchor its LSDB snapshot at the
+        next solve (tightening future bundles' replay window), then
+        freezes a bundle carrying the current `inputs` annex."""
+        from openr_tpu.runtime.replay_log import get_recorder
+
+        rec = get_recorder(self.node_name)
+        if rec is not None:
+            rec.request_snapshot()
+        out = await self.dump_flight_recorder(reason=reason)
+        out["replayable"] = rec is not None and rec.export() is not None
+        return out
 
 # -- heap profiling (role of MonitorBase::dumpHeapProfile,
 # MonitorBase.h:54 — the reference hooks jemalloc; the Python runtime's

@@ -219,6 +219,12 @@ class Publication:
     # Keys the sender has a newer hash for than us (full-sync delta request).
     to_be_updated_keys: list[str] = field(default_factory=list)
     area: str = "0"
+    # local-process telemetry, stamped by the receiving KvStore when it
+    # hands the merged publication to Decision: the monotonic receive
+    # time the input black-box recorder (runtime/replay_log.py) logs
+    # for each event. Meaningless across hosts — a deserialized value
+    # is always overwritten by the local re-stamp before local use.
+    recv_t: Optional[float] = None
 
     def empty(self) -> bool:
         return not self.key_vals and not self.expired_keys

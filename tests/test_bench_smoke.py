@@ -350,3 +350,10 @@ def test_bench_flapstorm_lane_standstill_and_zero_retraces():
     tail = res["budget_tail"]
     assert tail["ranked"], tail
     assert 0.0 <= tail["top2_coverage"] <= 1.0 + 1e-9, tail
+    # ISSUE 18: the lane reports the per-epoch RIB digest cost (the
+    # replay recorder's only hot-path compute) as its own columns; the
+    # ≤1% steady-state claim is gated on the full CI lane, here we pin
+    # presence and a sane magnitude on the tiny smoke config
+    assert res["rib_digest_p99_ms"] >= 0, res
+    assert res["rib_digest_p50_ms"] <= res["rib_digest_p99_ms"], res
+    assert res["rib_digest_overhead_pct"] >= 0, res

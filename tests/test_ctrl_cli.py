@@ -120,6 +120,45 @@ class TestConvergenceIdleFallback:
         assert out["conservation"]["epochs"], out["conservation"]
         assert out["last_epochs"], out
 
+    @run_async
+    async def test_decision_replay_endpoint_reports_recorder(self):
+        """ctrl.decision.replay surfaces live recorder health + the
+        current RIB digest chain (ISSUE 18)."""
+        from openr_tpu.config import DecisionConfig
+        from openr_tpu.ctrl.ctrl_server import CtrlServer
+        from openr_tpu.decision.decision import Decision
+        from openr_tpu.decision.rib_digest import GENESIS
+        from openr_tpu.messaging import ReplicateQueue
+
+        d = Decision(
+            node_name="node-rp",
+            config=DecisionConfig(),
+            kvstore_updates_queue=None,
+            static_routes_queue=None,
+            route_updates_queue=ReplicateQueue("ctrl-replay.routes"),
+        )
+        srv = CtrlServer("node-rp", decision=d)
+        out = await srv._decision_replay()
+        assert out["node"] == "node-rp"
+        assert out["rib_digest"] == GENESIS  # no solve yet
+        rec = out["recorder"]
+        assert rec["enabled"] is True
+        assert rec["cursor"] == 0 and rec["ring_fill"] == 0
+        assert rec["snapshot_cursor"] is None  # first solve anchors
+
+        # recorder off: the endpoint says so instead of erroring
+        d2 = Decision(
+            node_name="node-rp-off",
+            config=DecisionConfig(replay_recorder=False),
+            kvstore_updates_queue=None,
+            static_routes_queue=None,
+            route_updates_queue=ReplicateQueue("ctrl-replay2.routes"),
+        )
+        out2 = await CtrlServer(
+            "node-rp-off", decision=d2
+        )._decision_replay()
+        assert out2["recorder"] == {"enabled": False}
+
 
 class TestCtrlServer:
     @run_async

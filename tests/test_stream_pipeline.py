@@ -494,6 +494,51 @@ class TestEpochFence:
             registry.clear()
 
     @run_async
+    async def test_recorded_streaming_session_replays_both_ways(self):
+        """ISSUE 18 replay determinism over the parity trio: record one
+        randomized churn session through the streaming device pipeline,
+        then replay the SAME recording with the streaming pipeline on
+        AND off (and on the CPU oracle) — per-epoch RIB digests must be
+        bit-identical to the recording every way. The streamed epoch's
+        bit-identical parity promise, restated over recorded incident
+        data instead of a live side-by-side."""
+        from tools.replay import replay_bundle
+
+        cfg = DecisionConfig(
+            debounce_min_ms=5, debounce_max_ms=20,
+            streaming_pipeline=True,
+        )
+        async with DecisionHarness(backend="tpu", config=cfg) as h:
+            two_node_mesh(h)
+            h.synced()
+            await h.next_route_update()
+            rng = np.random.default_rng(18)
+            version = 1
+            for _ in range(5):
+                version += 1
+                m = int(rng.integers(1, 100))
+                h.publish(
+                    adj_db_kv("1", [adj("1", "2", metric=m)],
+                              version=version),
+                    adj_db_kv("2", [adj("2", "1", metric=m)],
+                              version=version),
+                )
+                await h.next_route_update()
+            annex = h.decision._replay.export()
+        assert annex is not None and not annex["gap"], annex
+        bundle = {"node": "1", "inputs": annex}
+        for solver, streaming in (
+            ("tpu", True), ("tpu", False), ("cpu", False),
+        ):
+            report = replay_bundle(
+                bundle, solver=solver, streaming=streaming
+            )
+            assert report["status"] == "identical", (
+                solver, streaming, report,
+            )
+            assert report["epochs_compared"] >= 4, (solver, report)
+
+    @run_async
     async def test_streaming_off_keeps_inline_finish(self):
         """Config gate: with streaming_pipeline=False (the PR 12 path)
         no finish is ever deferred — the bisection knob documented in

@@ -34,6 +34,7 @@ sys.path.insert(0, str(REPO_ROOT))
 
 from openr_tpu.runtime.latency_budget import BUDGET_COMPONENTS  # noqa: E402
 from openr_tpu.runtime.lifecycle import BOOT_PHASES  # noqa: E402
+from openr_tpu.runtime.replay_log import REPLAY_COUNTER_FIELDS  # noqa: E402
 from openr_tpu.runtime.metrics_export import (  # noqa: E402
     is_valid_metric_name,
     normalize_metric_name,
@@ -110,6 +111,17 @@ def run(project: Project) -> list[Finding]:
     if budget_site is not None:
         for comp in BUDGET_COMPONENTS:
             stat_names.setdefault(f"budget.{comp}_ms", budget_site)
+    # And for the input black-box recorder (runtime/replay_log.py):
+    # `replay.<field>` counters are exported once per solve epoch with
+    # a field name drawn from the closed REPLAY_COUNTER_FIELDS
+    # vocabulary — expand the placeholder so every concrete family
+    # (replay.events, replay.snapshots, replay.ring_gaps,
+    # replay.epochs) participates in collision checking alongside the
+    # static decision.rib_digest.* gauges.
+    replay_site = counter_names.pop(f"replay.{PLACEHOLDER}", None)
+    if replay_site is not None:
+        for field in REPLAY_COUNTER_FIELDS:
+            counter_names.setdefault(f"replay.{field}", replay_site)
     findings: list[Finding] = []
     # exposition family -> (raw name, site); stats expand to their
     # derived families so `a.b` (stat) vs `a.b_max` (counter) is caught
