@@ -596,11 +596,26 @@ def bench_flapstorm(name, gen, me, events=100, rate_hz=100.0,
 
     from openr_tpu.decision.rib_digest import GENESIS, delta_digest, roll
     from openr_tpu.runtime.latency_budget import latency_budget
+    from openr_tpu.runtime.overload import FlapDamper, OverloadController
+
+    # overload soak instrumentation (ISSUE 19): the paced rotation runs
+    # through a live controller + damper so the lane's headline proves
+    # the steady-state property the smoke test gates on — bounded queue
+    # depth, ZERO damping, zero shed. Damper tuned for the lane's pace:
+    # an 8-victim rotation is steady churn, not a flap storm, and the
+    # equilibrium figure of merit must sit well under suppress.
+    octl = OverloadController(
+        f"bench-{name}", queue_watermark=8,
+        damper=FlapDamper(
+            half_life_s=0.5, penalty=1.0, suppress_threshold=50.0,
+            reuse_threshold=1.0, max_penalty=100.0,
+        ),
+    )
 
     async def _storm():
         nonlocal db
         acks, dl_bytes, rows, engaged, overflows = [], [], [], 0, 0
-        budget_rows, dig_ms = [], []
+        budget_rows, dig_ms, depths = [], [], []
         rolling = GENESIS
         dispatch = getattr(tpu, "dispatch_route_db", None)
         start = time.perf_counter()
@@ -609,8 +624,19 @@ def bench_flapstorm(name, gen, me, events=100, rate_hz=100.0,
             delay = target - time.perf_counter()
             if delay > 0:
                 await _asyncio.sleep(delay)
-            _flap(states, adj_dbs, [victims[i % len(victims)]], i, area)
+            victim = victims[i % len(victims)]
+            _flap(states, adj_dbs, [victim], i, area)
             t_ev = time.perf_counter()
+            # dispatch-queue-depth proxy for this synchronous rig: how
+            # many paced events are already due but not yet solved —
+            # exactly what Decision's solve queue would hold. Capped at
+            # the events that remain: pacing debt past the end of the
+            # storm cannot queue anything
+            backlog = max(0, min(events - 1, int((t_ev - start) / interval)) - i)
+            octl.damper.record_change(area, f"adj:{victim}")
+            octl.observe(queue_depth=backlog)
+            octl.shed(backlog)
+            depths.append(backlog)
             # per-event latency budget: the storm drives the explicit
             # dispatch/collect split so every churn-to-ack interval
             # decomposes into the canonical component taxonomy with the
@@ -672,11 +698,11 @@ def bench_flapstorm(name, gen, me, events=100, rate_hz=100.0,
         wall_s = time.perf_counter() - start
         return (
             acks, dl_bytes, rows, engaged, overflows, wall_s,
-            budget_rows, dig_ms,
+            budget_rows, dig_ms, depths,
         )
 
     (acks, dl_bytes, rows, engaged, overflows, wall_s, budget_rows,
-     dig_ms) = _asyncio.run(_storm())
+     dig_ms, depths) = _asyncio.run(_storm())
     # idle epoch: nothing changed since the last solve — the streaming
     # payload still ships (count=0), so the download stands still at
     # exactly one within-budget payload
@@ -706,6 +732,16 @@ def bench_flapstorm(name, gen, me, events=100, rate_hz=100.0,
             sum(_counters.get_counters("xla_cache.retraces.").values())
             - retrace0
         ),
+        # overload soak headline (ISSUE 19): under the steady paced
+        # rotation these must read bounded-depth / zero-damped /
+        # zero-shed — the smoke test and perf_diff gate hold the line
+        "dispatch_queue_depth_p99": int(
+            _percentile(sorted(depths), 99.0)
+        ) if depths else 0,
+        "dispatch_queue_depth_max": max(depths) if depths else 0,
+        "damped_keys": octl.damper.damped_count(),
+        "shed_epochs": octl.shed_epochs,
+        "overload_state": octl.state,
     }
     if dig_ms:
         sd = sorted(dig_ms)
@@ -731,6 +767,10 @@ def bench_flapstorm(name, gen, me, events=100, rate_hz=100.0,
         f"unattributed frac {res.get('budget_unattributed_frac')}, "
         f"tail owners "
         f"{[(t['component'], t['gap_ms']) for t in tail[:2]]}")
+    log(f"[{name}] overload soak: state {res['overload_state']} / "
+        f"queue depth p99 {res['dispatch_queue_depth_p99']} "
+        f"(max {res['dispatch_queue_depth_max']}) / "
+        f"damped {res['damped_keys']} / shed {res['shed_epochs']}")
     return res
 
 

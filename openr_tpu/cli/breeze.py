@@ -500,6 +500,17 @@ def decision_replay(ctx) -> None:
     _print(_call(ctx, "ctrl.decision.replay"))
 
 
+@decision.command("overload")
+@click.pass_context
+def decision_overload(ctx) -> None:
+    """Overload ladder + flap damper: current state
+    (ok/backpressure/brownout/shedding), the signals driving it (queue
+    depth, HBM fraction, RSS, SLO burn), suppressed keys with their
+    decayed figures of merit, shed/rejection counts, and the recent
+    transition history (docs/Operations.md § Overload control)."""
+    _print(_call(ctx, "ctrl.decision.overload"))
+
+
 @decision.command("budget")
 @click.option(
     "--fleet",
@@ -1227,10 +1238,14 @@ def fault() -> None:
 @click.option("--delay-ms", default=0.0, type=float,
               help="latency fault: firings SLEEP this long instead of "
               "raising (perf-regression drills)")
+@click.option("--rate", default=0.0, type=float,
+              help="sustained storm: fire at this target rate in "
+              "events/s (token bucket — paced, not a coin flip; "
+              "combine with --window for a bounded overload drill)")
 @click.pass_context
 def fault_inject(
     ctx, site, probability, every_nth, one_shot, window_s, max_fires,
-    seed, delay_ms,
+    seed, delay_ms, rate,
 ) -> None:
     """Arm SITE (e.g. solver.exec, kvstore.flood, rpc.send,
     fib.program, queue.push, decision.ingest). With no schedule options
@@ -1238,7 +1253,7 @@ def fault_inject(
     _print(_call(ctx, "ctrl.fault.inject", {
         "site": site, "probability": probability, "every_nth": every_nth,
         "one_shot": one_shot, "window_s": window_s, "max_fires": max_fires,
-        "seed": seed, "delay_ms": delay_ms,
+        "seed": seed, "delay_ms": delay_ms, "rate": rate,
     }))
 
 

@@ -239,6 +239,48 @@ class DecisionConfig:
     replay_recorder: bool = True
     replay_ring: int = 8192
     replay_snapshot_every_epochs: int = 1024
+    # --- overload control (runtime/overload.py) ---
+    # process-wide overload state ladder ok -> backpressure -> brownout
+    # -> shedding driving adaptive admission control on the dispatch
+    # fiber, per-key flap damping at ingest, and the resource-pressure
+    # brownout rungs (docs/Operations.md § Overload control). The
+    # kill-switch disables the whole layer: no damping, no admission
+    # gating, no ladder — the first bisection step for a suppression
+    # regression.
+    overload_control: bool = True
+    # pending-solve queue depth at which the ladder reaches brownout;
+    # 2x this is shedding (new requests fold into the held overflow
+    # batch instead of growing the queue), half is backpressure.
+    overload_queue_watermark: int = 8
+    # ceiling for the adaptively widened dispatch coalescing window
+    overload_coalesce_max_ms: int = 250
+    # HBM pressure watermarks (fraction of bytes_limit, highest device):
+    # at/above high enters brownout; must fall below clear to release.
+    overload_hbm_high_frac: float = 0.9
+    overload_hbm_clear_frac: float = 0.75
+    # host-RSS watermarks in MB (0 = RSS does not drive the ladder)
+    overload_rss_high_mb: float = 0.0
+    overload_rss_clear_mb: float = 0.0
+    # minimum time at a level before a downshift rung can release
+    overload_dwell_s: float = 5.0
+    # flap damping (RFC 2439 transplanted onto LSDB keys): each ingest
+    # change adds `penalty` to the key's figure of merit, which decays
+    # with `half_life_s`; a key crossing `suppress` stops perturbing
+    # the LSDB (latest value held, re-ingested on release) until decay
+    # brings it under `reuse`. damping=False disables only the damper,
+    # leaving the ladder up (the runbook's bisection order). The
+    # defaults target sustained storms only: with penalty 1 and a 10 s
+    # half-life a key must sustain well over 2 changes/s to reach the
+    # suppress threshold — ordinary reconvergence churn (a handful of
+    # updates to one key in seconds) never trips it.
+    overload_damping: bool = True
+    overload_damping_half_life_s: float = 10.0
+    overload_damping_penalty: float = 1.0
+    overload_damping_suppress: float = 25.0
+    overload_damping_reuse: float = 1.0
+    overload_damping_max_penalty: float = 50.0
+    # damper/ladder maintenance tick (decay sweep + release re-ingest)
+    overload_tick_s: float = 1.0
 
 
 @dataclass
@@ -352,6 +394,16 @@ class MonitorConfig:
                 "kind": "gauge_duration",
                 "source": "decision.solver.degraded",
                 "threshold": 5.0,
+            },
+            # sustained brownout: the overload ladder (runtime/
+            # overload.py) is SUPPOSED to visit brownout under a storm
+            # and come back — staying there past the threshold means
+            # the downshift rungs are not releasing (docs/Operations.md
+            # § Overload control)
+            "overload_brownout_s": {
+                "kind": "gauge_duration",
+                "source": "overload.brownout",
+                "threshold": 30.0,
             },
             # conservation drift of the latency-budget ledger: a growing
             # unattributed residual means the component taxonomy rotted
@@ -762,6 +814,57 @@ class Config:
         if dc.replay_snapshot_every_epochs < 1:
             raise ConfigError(
                 "decision replay_snapshot_every_epochs must be >= 1"
+            )
+        if dc.overload_queue_watermark < 1:
+            raise ConfigError(
+                "decision overload_queue_watermark must be >= 1"
+            )
+        if dc.overload_coalesce_max_ms < 1:
+            raise ConfigError(
+                "decision overload_coalesce_max_ms must be >= 1"
+            )
+        if not (
+            0.0 < dc.overload_hbm_clear_frac
+            <= dc.overload_hbm_high_frac <= 1.0
+        ):
+            raise ConfigError(
+                "decision overload HBM watermarks must satisfy "
+                "0 < clear <= high <= 1"
+            )
+        if dc.overload_rss_high_mb < 0 or dc.overload_rss_clear_mb < 0:
+            raise ConfigError(
+                "decision overload RSS watermarks must be >= 0"
+            )
+        if (
+            dc.overload_rss_high_mb > 0
+            and dc.overload_rss_clear_mb > dc.overload_rss_high_mb
+        ):
+            raise ConfigError(
+                "decision overload_rss_clear_mb must not exceed "
+                "overload_rss_high_mb"
+            )
+        if dc.overload_dwell_s < 0 or dc.overload_tick_s <= 0:
+            raise ConfigError(
+                "decision overload_dwell_s must be >= 0 and "
+                "overload_tick_s positive"
+            )
+        if not (
+            0.0
+            < dc.overload_damping_reuse
+            < dc.overload_damping_suppress
+            <= dc.overload_damping_max_penalty
+        ):
+            raise ConfigError(
+                "decision overload damping thresholds must satisfy "
+                "0 < reuse < suppress <= max_penalty"
+            )
+        if (
+            dc.overload_damping_half_life_s <= 0
+            or dc.overload_damping_penalty <= 0
+        ):
+            raise ConfigError(
+                "decision overload damping half-life and penalty must "
+                "be positive"
             )
         pc = cfg.platform_config
         if pc.bulk_threshold < 1:

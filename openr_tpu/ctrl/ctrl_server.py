@@ -152,6 +152,7 @@ class CtrlServer(Actor):
             )
             s.register("ctrl.decision.budget", self._decision_budget)
             s.register("ctrl.decision.replay", self._decision_replay)
+            s.register("ctrl.decision.overload", self._decision_overload)
             s.register("ctrl.decision.whatif.sweep", self._whatif_sweep)
             s.register("ctrl.decision.whatif.drain", self._whatif_drain)
             s.register(
@@ -506,6 +507,10 @@ class CtrlServer(Actor):
         """Input-recorder / RIB-digest status (runtime/replay_log.py)."""
         return await self.decision.replay_status()
 
+    async def _decision_overload(self) -> dict:
+        """Overload ladder / flap-damper state (runtime/overload.py)."""
+        return await self.decision.overload_report()
+
     async def _watch_initialization(self, queue: ReplicateQueue) -> None:
         reader = queue.get_reader(f"{self.name}.init")
         try:
@@ -585,6 +590,7 @@ class CtrlServer(Actor):
         max_fires: int = 0,
         seed: Optional[int] = None,
         delay_ms: float = 0.0,
+        rate: float = 0.0,
     ) -> dict:
         from openr_tpu.runtime.faults import registry
 
@@ -597,6 +603,7 @@ class CtrlServer(Actor):
             max_fires=int(max_fires),
             seed=seed if seed is None else int(seed),
             delay_ms=float(delay_ms),
+            rate=float(rate),
         )
 
     async def _fault_clear(self, site: Optional[str] = None) -> dict:

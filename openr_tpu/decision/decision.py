@@ -56,6 +56,9 @@ from openr_tpu.runtime.lifecycle import boot_tracer
 from openr_tpu.serde import from_plain, to_plain
 from openr_tpu.runtime.counters import counters
 from openr_tpu.runtime.latency_budget import latency_budget
+from openr_tpu.runtime.overload import FlapDamper, OverloadController
+from openr_tpu.runtime.overload import register as overload_register
+from openr_tpu.runtime.overload import unregister as overload_unregister
 from openr_tpu.runtime.replay_log import ReplayRecorder
 from openr_tpu.runtime.replay_log import register as replay_register
 from openr_tpu.runtime.throttle import AsyncDebounce, ExponentialBackoff
@@ -293,6 +296,38 @@ class Decision(Actor):
                 snapshot_every=config.replay_snapshot_every_epochs,
                 meta=self._replay_meta(backend),
             ))
+        # overload control (runtime/overload.py): the process-wide
+        # state ladder + per-key flap damper. Decision owns the
+        # controller (it watches Decision's queue and enacts the
+        # solver rungs); the Monitor and KvStore reach it through the
+        # per-node registry to feed memory/SLO signals and defer
+        # probes. None = the whole layer is off (bisection
+        # kill-switch).
+        self._overload: Optional[OverloadController] = None
+        if config.overload_control:
+            self._overload = overload_register(OverloadController(
+                node_name,
+                queue_watermark=config.overload_queue_watermark,
+                coalesce_max_ms=config.overload_coalesce_max_ms,
+                hbm_high_frac=config.overload_hbm_high_frac,
+                hbm_clear_frac=config.overload_hbm_clear_frac,
+                rss_high_mb=config.overload_rss_high_mb,
+                rss_clear_mb=config.overload_rss_clear_mb,
+                dwell_s=config.overload_dwell_s,
+                damper=FlapDamper(
+                    half_life_s=config.overload_damping_half_life_s,
+                    penalty=config.overload_damping_penalty,
+                    suppress_threshold=config.overload_damping_suppress,
+                    reuse_threshold=config.overload_damping_reuse,
+                    max_penalty=config.overload_damping_max_penalty,
+                ),
+                on_transition=self._on_overload_transition,
+            ))
+        # shedding overflow: while the ladder sheds, new solve
+        # requests merge here instead of growing the dispatch queue
+        # past the watermark; the batch re-enqueues after the next
+        # solve completes (work is folded, never dropped)
+        self._shed_overflow: Optional[PendingUpdates] = None
         # streaming-pipeline epoch overlap: with
         # cfg.streaming_pipeline + async_dispatch, epoch N's finish
         # (RIB diff, provenance stamp, FIB push) runs as a deferred
@@ -327,6 +362,10 @@ class Decision(Actor):
             self.add_supervised_task(
                 self._static_loop, name=f"{self.name}.static"
             )
+        if self._overload is not None:
+            self.add_supervised_task(
+                self._overload_tick_loop, name=f"{self.name}.overload"
+            )
         self._load_saved_rib_policy()
 
     async def on_fiber_restart(self, task_name: str) -> None:
@@ -350,6 +389,14 @@ class Decision(Actor):
             self._rebuild_debounced.cancel()
         if self._stream_finish is not None:
             self._stream_finish.cancel()
+        if self._degraded:
+            # the device-probe timer dies with the actor's loop, so a
+            # stopped Decision can never promote — don't leave the
+            # process-wide degraded gauge latched at 1
+            self._degraded = False
+            counters.set_counter("decision.solver.degraded", 0)
+        if self._overload is not None:
+            overload_unregister(self.node_name)
 
     # -- queue consumption -------------------------------------------------
 
@@ -407,10 +454,34 @@ class Decision(Actor):
         before = self.pending.count
         rec = self._replay
         recv_t = pub.recv_t
+        # per-key flap damping (runtime/overload.py): every change of
+        # an (area, key) pays into its figure of merit BEFORE touching
+        # the LSDB; a suppressed key's events are withheld — latest
+        # value held for re-ingest at release, recorded with the
+        # `suppressed` marker so replay stays bit-identical — while
+        # every other key converges at full speed
+        damper = (
+            self._overload.damper
+            if self._overload is not None and self.cfg.overload_damping
+            else None
+        )
+        damped = False
         with tracer.span(ctx, "decision.lsdb_apply", node=self.node_name):
             for key, value in pub.key_vals.items():
                 if value.value is None:
                     continue  # ttl refresh only
+                if damper is not None and damper.record_change(area, key):
+                    damper.hold(area, key, (
+                        "kv", value.version, value.originator_id,
+                        value.value,
+                    ))
+                    if rec is not None:
+                        rec.record_kv(
+                            area, key, value.version, value.originator_id,
+                            value.value, recv_t, suppressed=True,
+                        )
+                    damped = True
+                    continue
                 self._update_key_in_lsdb(area, key, value.value)
                 self._note_ingest(area, key, value.originator_id)
                 if rec is not None:
@@ -419,6 +490,17 @@ class Decision(Actor):
                         value.value, recv_t,
                     )
             for key in pub.expired_keys:
+                # a withdrawal is a flap too (RFC 2439 counts both
+                # directions); a suppressed key's expiry is held as the
+                # latest state, not applied
+                if damper is not None and damper.record_change(area, key):
+                    damper.hold(area, key, ("expire",))
+                    if rec is not None:
+                        rec.record_expired(
+                            area, key, recv_t, suppressed=True
+                        )
+                    damped = True
+                    continue
                 self._delete_key_from_lsdb(area, key)
                 self._note_ingest(area, key, "<expired>")
                 if rec is not None:
@@ -426,8 +508,13 @@ class Decision(Actor):
         if ctx is not None:
             if self.pending.count == before:
                 # nothing route-relevant changed; close so the trace
-                # doesn't linger until eviction
-                tracer.end_trace(ctx, status="ignored")
+                # doesn't linger until eviction. A damped event closes
+                # with its own status: suppressed churn must not count
+                # as either converged or ignored (convergence_ms stays
+                # clean)
+                tracer.end_trace(
+                    ctx, status="damped" if damped else "ignored"
+                )
             elif self.pending.trace is None:
                 self.pending.trace = ctx
             else:
@@ -543,6 +630,28 @@ class Decision(Actor):
             # async dispatch: hand the snapshot to the dispatch fiber
             # and return immediately — the actor loop stays free to
             # ingest LSDB events while the solve is in flight
+            ctl = self._overload
+            if ctl is not None:
+                depth = self._solve_q.qsize()
+                ctl.observe(queue_depth=depth)
+                if ctl.shed(depth):
+                    # shedding rung: past the watermark the snapshot
+                    # folds into one overflow batch instead of growing
+                    # the queue — bounded depth, and the folded work
+                    # still solves (as one epoch) once pressure clears.
+                    # The trace closes as "shed" so convergence_ms
+                    # never averages in an epoch we chose not to run
+                    if pending.trace is not None:
+                        latency_budget.discard_trace(pending.trace)
+                        tracer.end_trace(pending.trace, status="shed")
+                        pending.trace = None
+                    if self._shed_overflow is None:
+                        self._shed_overflow = pending
+                    else:
+                        self._shed_overflow = self._merge_pending(
+                            self._shed_overflow, pending
+                        )
+                    return
             self._solve_q.put_nowait(pending)
             counters.set_counter(
                 "decision.dispatch.depth", self._solve_q.qsize()
@@ -558,8 +667,16 @@ class Decision(Actor):
         while True:
             pending = await self._solve_q.get()
             t_pickup = time.monotonic()
-            if self.cfg.dispatch_coalesce_ms > 0:
-                await asyncio.sleep(self.cfg.dispatch_coalesce_ms / 1e3)
+            coalesce_ms = float(self.cfg.dispatch_coalesce_ms)
+            ctl = self._overload
+            if ctl is not None:
+                # adaptive admission: the controller scales the window
+                # with queue depth and ladder level — under pressure one
+                # solve absorbs more churn, capped at coalesce_max_ms
+                ctl.observe(queue_depth=self._solve_q.qsize() + 1)
+                coalesce_ms = ctl.coalesce_ms(coalesce_ms)
+            if coalesce_ms > 0:
+                await asyncio.sleep(coalesce_ms / 1e3)
             while not self._solve_q.empty():
                 pending = self._merge_pending(
                     pending, self._solve_q.get_nowait()
@@ -582,6 +699,13 @@ class Decision(Actor):
             maybe_fail("solver.dispatch")
             counters.increment("decision.dispatch.solves")
             await self._rebuild_async(pending)
+            if self._shed_overflow is not None and (
+                ctl is None or not ctl.still_shedding(self._solve_q.qsize())
+            ):
+                # pressure eased: the folded shed batch re-enters the
+                # queue as one epoch so no churn is ever lost
+                overflow, self._shed_overflow = self._shed_overflow, None
+                self._solve_q.put_nowait(overflow)
 
     @staticmethod
     def _merge_pending(a: PendingUpdates, b: PendingUpdates) -> PendingUpdates:
@@ -677,6 +801,10 @@ class Decision(Actor):
             and full
             and not self._degraded
             and new_db is not None
+            # brownout rung: past brownout the epoch-finish overlap is
+            # surrendered — each finish lands before the next dispatch,
+            # trading throughput for a bounded in-flight footprint
+            and (self._overload is None or self._overload.streaming_allowed())
         ):
             self._defer_finish(pending, ctx, spf_sp, t0, new_db, full)
             return
@@ -1010,6 +1138,107 @@ class Decision(Actor):
         else:
             out["recorder"] = {"enabled": False}
         return out
+
+    # -- overload control (runtime/overload.py) ----------------------------
+
+    async def overload_report(self) -> dict:
+        """ctrl.decision.overload payload: ladder state, damper report,
+        transition history."""
+        if self._overload is None:
+            return {"node": self.node_name, "enabled": False}
+        out = self._overload.report()
+        out["enabled"] = True
+        out["damping_enabled"] = bool(self.cfg.overload_damping)
+        out["shed_held"] = (
+            0 if self._shed_overflow is None else self._shed_overflow.count
+        )
+        return out
+
+    def _on_overload_transition(self, entry: dict) -> None:
+        """Ladder transition hook: log it, enact the solver-tier rung,
+        and emit the LogSample the Monitor's trigger table maps to a
+        flight-recorder bundle — every transition leaves evidence."""
+        log.warning(
+            "[%s] overload %s -> %s (depth=%s hbm=%s rss=%s slo=%s)",
+            self.name, entry["from"], entry["to"], entry["queue_depth"],
+            entry["hbm_frac"], entry["rss_mb"], entry["slo_burning"],
+        )
+        ctl = self._overload
+        if ctl is not None and hasattr(self.solver, "force_single_chip"):
+            # shedding rung: pin the solver to the single-chip tier
+            # (releases the mesh's HBM); reverses with the ladder —
+            # _sync_area re-puts the mirrors on the next tier flip
+            self.solver.force_single_chip = not ctl.multichip_allowed()
+        self._emit_overload_sample(entry)
+
+    def _emit_overload_sample(self, entry: dict) -> None:
+        if self._log_samples is None:
+            return
+        try:
+            from openr_tpu.runtime.monitor import LogSample
+
+            self._log_samples.push(LogSample(
+                event="OVERLOAD_STATE_CHANGE",
+                node_name=self.node_name,
+                values={
+                    "category": "overload",
+                    "from": entry["from"],
+                    "to": entry["to"],
+                    "queue_depth": entry["queue_depth"],
+                    "hbm_frac": entry["hbm_frac"],
+                    "rss_mb": entry["rss_mb"],
+                    "slo_burning": entry["slo_burning"],
+                },
+            ))
+        # lint: allow(broad-except) telemetry must not wedge the ladder
+        except Exception:  # pragma: no cover - sampler unavailable
+            log.debug("%s: overload log sample failed", self.name)
+
+    async def _overload_tick_loop(self) -> None:
+        """Housekeeping fiber: re-evaluate the ladder on a clock (decay
+        and dwell must progress even when no publication arrives),
+        release calmed damped keys, and flush the shed overflow batch
+        once pressure clears."""
+        ctl = self._overload
+        while True:
+            await asyncio.sleep(self.cfg.overload_tick_s)
+            depth = 0 if self._solve_q is None else self._solve_q.qsize()
+            ctl.observe(queue_depth=depth)
+            if self.cfg.overload_damping:
+                self._release_damped()
+            if (
+                self._shed_overflow is not None
+                and not ctl.still_shedding(depth)
+                and self._solve_q is not None
+            ):
+                overflow, self._shed_overflow = self._shed_overflow, None
+                self._solve_q.put_nowait(overflow)
+
+    def _release_damped(self) -> None:
+        """Re-ingest the held latest event of every damped key whose
+        figure of merit has decayed below the reuse threshold: the LSDB
+        converges to the key's final state the moment it calms — no
+        stale-route window. Re-ingested events are recorded UNsuppressed
+        (they perturb the RIB now, so replay must apply them)."""
+        rec = self._replay
+        released = 0
+        for area, key, held in self._overload.damper.releasable():
+            if held is None:
+                continue  # suppressed but never saw another event
+            if held[0] == "kv":
+                _, version, originator, raw = held
+                self._update_key_in_lsdb(area, key, raw)
+                self._note_ingest(area, key, originator)
+                if rec is not None:
+                    rec.record_kv(area, key, version, originator, raw)
+            else:  # ("expire",)
+                self._delete_key_from_lsdb(area, key)
+                self._note_ingest(area, key, "<expired>")
+                if rec is not None:
+                    rec.record_expired(area, key)
+            released += 1
+        if released and self.pending.count > 0:
+            self._trigger_rebuild()
 
     # -- mid-flight solver failover ----------------------------------------
 
@@ -1553,12 +1782,25 @@ class Decision(Actor):
             self._whatif_engine = WhatIfEngine(self.solver, self.node_name)
         return self._whatif_engine
 
-    async def _whatif_gate(self) -> None:
-        """Yield until no live solve is queued — a sweep chunk never
-        races a topology event for the device."""
+    async def _whatif_gate(self) -> Optional[dict]:
+        """Admission gate for planning work. Returns a rejection payload
+        when the overload ladder has closed the what-if class (brownout
+        and above) — the caller returns it verbatim; otherwise yields
+        until no live solve is queued (a sweep chunk never races a
+        topology event for the device) and returns None."""
+        if self._overload is not None and not self._overload.admit("whatif"):
+            return {
+                "error": (
+                    "whatif rejected: overload state "
+                    f"{self._overload.state!r} (see breeze decision "
+                    "overload)"
+                ),
+                "overload_state": self._overload.state,
+            }
         while self._solve_q is not None and not self._solve_q.empty():
             counters.increment("whatif.deferrals")
             await asyncio.sleep(0.005)
+        return None
 
     async def whatif_sweep(
         self, order: int = 1, area: Optional[str] = None,
@@ -1583,7 +1825,11 @@ class Decision(Actor):
         try:
             rows: list[dict] = []
             for chunk in job.chunks:
-                await self._whatif_gate()
+                rejected = await self._whatif_gate()
+                if rejected is not None:
+                    job.fail()
+                    counters.increment("whatif.errors")
+                    return rejected
                 chunk.dispatch()
                 # chunk.collect blocks only on its own device output
                 # buffers; the LSDB snapshot was taken on-loop in
@@ -1608,7 +1854,10 @@ class Decision(Actor):
         eng = self._whatif()
         if eng is None:
             return {"error": "whatif requires the device solver backend"}
-        await self._whatif_gate()
+        rejected = await self._whatif_gate()
+        if rejected is not None:
+            counters.increment("whatif.errors")
+            return rejected
         try:
             return eng.drain(
                 self.area_link_states, self.prefix_state,
@@ -1629,7 +1878,10 @@ class Decision(Actor):
         eng = self._whatif()
         if eng is None:
             return {"error": "whatif requires the device solver backend"}
-        await self._whatif_gate()
+        rejected = await self._whatif_gate()
+        if rejected is not None:
+            counters.increment("whatif.errors")
+            return rejected
         try:
             job = eng.plan_optimize(
                 self.area_link_states, self.prefix_state, demands,

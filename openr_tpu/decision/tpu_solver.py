@@ -1446,6 +1446,9 @@ class TpuSpfSolver:
         # 0 disables the tier.
         self.multichip_n_cap_threshold = int(multichip_n_cap_threshold)
         self.multichip_batch = int(multichip_batch)
+        # overload shedding rung (runtime/overload.py): Decision toggles
+        # this post-construction; _mc_mesh_for returns None while set
+        self.force_single_chip = False
         # SSSP round-loop implementation (ops/relax.py): "bucketed"
         # selects the Δ-stepping kernel wherever the plan is eligible
         # (plan.delta_exp > 0, i.e. it has usable shift classes) and
@@ -2178,7 +2181,15 @@ class TpuSpfSolver:
         chosen tier). The shard_mapped SSSP needs the node axis to
         divide the graph axis; capacity classes are pow2 so this only
         trips on exotic meshes, and the tier then stays off rather
-        than fall over."""
+        than fall over.
+
+        `force_single_chip` is the overload ladder's shedding rung
+        (runtime/overload.py): while set, the tier stays off and the
+        next _sync_area tier flip re-puts the mirrors single-chip,
+        releasing the mesh's HBM; clearing it restores the tier by the
+        same flip path — reversible by construction."""
+        if self.force_single_chip:
+            return None
         thr = self.multichip_n_cap_threshold
         if thr <= 0 or n_cap <= thr:
             return None

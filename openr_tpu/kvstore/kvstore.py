@@ -49,6 +49,7 @@ from openr_tpu.runtime.actor import Actor
 from openr_tpu.runtime.counters import counters
 from openr_tpu.runtime.faults import maybe_fail
 from openr_tpu.runtime.lifecycle import boot_tracer
+from openr_tpu.runtime.overload import get_controller
 from openr_tpu.runtime.rpc import RpcClient, RpcServer
 from openr_tpu.runtime.throttle import ExponentialBackoff
 from openr_tpu.runtime.tracing import tracer
@@ -1135,6 +1136,8 @@ class KvStore(Actor):
         itself (same self-observation idiom as monitor:health)."""
         while True:
             await asyncio.sleep(self.cfg.digest_interval_s)
+            if not self._probe_admitted():
+                continue
             self._advertise_digests()
             self._check_divergence()
 
@@ -1301,7 +1304,18 @@ class KvStore(Actor):
         measurement of the fabric's flood latency."""
         while True:
             await asyncio.sleep(self.cfg.flood_probe_interval_s)
+            if not self._probe_admitted():
+                continue
             self._originate_flood_probe()
+
+    def _probe_admitted(self) -> bool:
+        """Overload admission for background anti-entropy traffic
+        (runtime/overload.py): digest beacons and flood probes are the
+        'probe' priority class — deferred (skip this interval, counted
+        as overload.deferred_probes) from backpressure up. Live
+        flooding is never gated here."""
+        ctl = get_controller(self.node_name)
+        return ctl is None or ctl.admit("probe")
 
     def _originate_flood_probe(self) -> None:
         ttl_ms = max(int(self.cfg.flood_probe_interval_s * 3000), 1000)

@@ -103,6 +103,12 @@ def collect_device_stats(allow_import: bool = False) -> dict:
                 ms.get("peak_bytes_in_use", 0) / _BYTES_PER_MB, 3
             )
             entry["num_allocs"] = int(ms.get("num_allocs", 0))
+            limit = ms.get("bytes_limit", 0)
+            if limit:
+                entry["hbm_limit_mb"] = round(limit / _BYTES_PER_MB, 3)
+                entry["hbm_frac"] = round(
+                    ms.get("bytes_in_use", 0) / limit, 4
+                )
         out["devices"].append(entry)
     return out
 
@@ -179,6 +185,8 @@ def export_device_gauges(allow_import: bool = False) -> dict:
         counters.set_counter(f"{base}.hbm_in_use_mb", entry["hbm_in_use_mb"])
         counters.set_counter(f"{base}.peak_mb", entry["peak_mb"])
         counters.set_counter(f"{base}.num_allocs", entry["num_allocs"])
+        if "hbm_frac" in entry:
+            counters.set_counter(f"{base}.hbm_frac", entry["hbm_frac"])
     census = live_buffer_census(allow_import)
     snap["live"] = census
     counters.set_counter("device.live_arrays.count", census["count"])
@@ -200,6 +208,19 @@ def export_device_gauges(allow_import: bool = False) -> dict:
                 round(db / _BYTES_PER_MB, 3),
             )
     return snap
+
+
+def hbm_pressure(allow_import: bool = False) -> Optional[float]:
+    """Worst-device HBM pressure: max over local devices of
+    bytes_in_use / bytes_limit. The overload controller's brownout
+    watermark input (runtime/overload.py). None where no backend keeps
+    both numbers (CPU) — the ladder then runs on queue/RSS signals
+    alone, it never guesses."""
+    snap = collect_device_stats(allow_import)
+    fracs = [
+        e["hbm_frac"] for e in snap["devices"] if "hbm_frac" in e
+    ]
+    return max(fracs) if fracs else None
 
 
 def peak_hbm_mb(allow_import: bool = True) -> tuple[Optional[float], str]:

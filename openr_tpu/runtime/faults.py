@@ -21,6 +21,11 @@ Arming a site attaches a schedule:
     drills: routing keeps converging while the site's wall-clock
     inflates, which is exactly the regression shape the
     ``baseline_drift`` SLO must catch
+  - rate         target firings per second: a token bucket (capacity
+    one) paces firings at the target rate no matter how often the
+    site is checked — a *calibrated sustained storm*, not a per-call
+    coin flip. The overload chaos drills key off this: "500 events/s
+    at decision.ingest for 60 s" is `rate=500, window_s=60`
 
 Schedules come from ``config.py`` (fault_injection_config, armed at daemon
 startup) or at runtime via the ``ctrl.fault.{inject,clear,list}`` endpoints
@@ -72,6 +77,7 @@ class FaultSchedule:
         max_fires: int = 0,
         seed: int = 0,
         delay_ms: float = 0.0,
+        rate: float = 0.0,
     ):
         self.site = site
         self.probability = probability
@@ -80,9 +86,14 @@ class FaultSchedule:
         self.max_fires = max_fires
         self.seed = seed
         self.delay_ms = delay_ms
+        self.rate = rate
         self.checks = 0
         self.fires = 0
         self.armed_at = time.monotonic()
+        # rate pacing: token bucket, capacity one token (no burst debt
+        # accumulates across a quiet stretch — the drill stays paced)
+        self._rate_tokens = 1.0 if rate > 0 else 0.0
+        self._rate_last = self.armed_at
         # string seeding hashes via sha512 — stable across processes,
         # unlike hash() which is salted per interpreter
         self.rng = Random(f"{seed}/{site}")
@@ -96,6 +107,7 @@ class FaultSchedule:
             "max_fires": self.max_fires,
             "seed": self.seed,
             "delay_ms": self.delay_ms,
+            "rate": self.rate,
             "checks": self.checks,
             "fires": self.fires,
         }
@@ -134,6 +146,7 @@ class FaultRegistry:
         max_fires: int = 0,
         seed: Optional[int] = None,
         delay_ms: float = 0.0,
+        rate: float = 0.0,
     ) -> dict:
         if not site:
             raise ValueError("fault site name must be non-empty")
@@ -144,6 +157,13 @@ class FaultRegistry:
             raise ValueError("every_nth/max_fires/window_s must be >= 0")
         if float(delay_ms) < 0:
             raise ValueError("delay_ms must be >= 0")
+        if float(rate) < 0:
+            raise ValueError("rate must be >= 0")
+        if float(rate) > 0 and (probability > 0 or int(every_nth) > 0):
+            raise ValueError(
+                "rate is its own schedule: combine with window_s/"
+                "max_fires/delay_ms, not probability/every_nth"
+            )
         if one_shot:
             max_fires = 1
         self._armed[site] = FaultSchedule(
@@ -154,6 +174,7 @@ class FaultRegistry:
             max_fires=int(max_fires),
             seed=self.seed if seed is None else int(seed),
             delay_ms=float(delay_ms),
+            rate=float(rate),
         )
         counters.increment("runtime.fault.armed")
         return self._armed[site].describe()
@@ -194,6 +215,15 @@ class FaultRegistry:
             fire = (s.checks % s.every_nth) == 0
         elif s.probability > 0.0:
             fire = s.rng.random() < s.probability
+        elif s.rate > 0.0:
+            now = time.monotonic()
+            s._rate_tokens = min(
+                1.0, s._rate_tokens + (now - s._rate_last) * s.rate
+            )
+            s._rate_last = now
+            fire = s._rate_tokens >= 1.0
+            if fire:
+                s._rate_tokens -= 1.0
         else:
             fire = True  # unconditional schedule (window/one-shot style)
         if not fire:

@@ -32,6 +32,9 @@ from openr_tpu.messaging import ReplicateQueue, RQueue
 from openr_tpu.runtime import device_stats
 from openr_tpu.runtime.actor import Actor
 from openr_tpu.runtime.counters import counters
+from openr_tpu.runtime.overload import (
+    get_controller as get_overload_controller,
+)
 from openr_tpu.runtime.perf_ledger import configure as configure_perf_ledger
 from openr_tpu.runtime.tracing import tracer
 
@@ -641,10 +644,14 @@ class Monitor(Actor):
         # recompile on a supposedly-warm kernel is a routing-stale
         # stall in the making — freeze the evidence
         "DEVICE_RETRACE": "device_retrace",
+        # every overload-ladder transition (runtime/overload.py) freezes
+        # a bundle: the state the system was in when it downshifted IS
+        # the incident evidence
+        "OVERLOAD_STATE_CHANGE": "overload",
     }
     # LogSample categories worth keeping in the recorder's event ring
     # even when they don't trigger (the bundle shows the lead-up)
-    _NOTE_CATEGORIES = {"sentinel", "supervisor", "slo", "spark"}
+    _NOTE_CATEGORIES = {"sentinel", "supervisor", "slo", "spark", "overload"}
 
     async def _observe_sample(self, sample: LogSample) -> None:
         recorder = self.flight_recorder
@@ -758,9 +765,28 @@ class Monitor(Actor):
                     await self._trigger_recorder(
                         f"slo_burn:{alert['slo']}", alert
                     )
+        self._feed_overload_controller()
         self._maybe_record_live_perf()
         if recorder is not None:
             recorder.record_tick()
+
+    def _feed_overload_controller(self) -> None:
+        """Feed the node's overload controller (runtime/overload.py) the
+        signals only the Monitor sees: host RSS, worst-device HBM
+        pressure, and whether any SLO track is burning. Decision feeds
+        queue depth from its own fiber; both run on this loop, so the
+        controller needs no locking."""
+        ctl = get_overload_controller(self.node_name)
+        if ctl is None:
+            return
+        burning = self.slo_engine is not None and any(
+            t.state != "ok" for t in self.slo_engine._tracks.values()
+        )
+        ctl.observe(
+            hbm_frac=device_stats.hbm_pressure(allow_import=False),
+            rss_mb=current_rss_mb(),
+            slo_burning=burning,
+        )
 
     def _maybe_record_live_perf(self) -> None:
         """Append a live solve observation (kernel "solve", signature/
@@ -920,6 +946,15 @@ class Monitor(Actor):
             # any peer's advertised digest disagrees with ours
             "lsdb_diverged": bool(
                 counters.get_counter("kvstore.divergence.detected") or 0
+            ),
+            # overload ladder state (runtime/overload.py) — "ok" when no
+            # controller is registered, so fleet triage sorts the browned
+            # -out nodes to the top without a per-node feature probe
+            "overload_state": (
+                ctl.state
+                if (ctl := get_overload_controller(self.node_name))
+                is not None
+                else "ok"
             ),
         }
 

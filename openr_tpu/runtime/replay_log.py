@@ -47,7 +47,9 @@ ANNEX_SCHEMA = "openr-tpu-replay/1"
 # via set_counter(f"replay.{field}", ...); tools/lint/metric_names.py
 # expands this list for collision checking (keep the two in sync by
 # importing, never copying)
-REPLAY_COUNTER_FIELDS = ("events", "snapshots", "ring_gaps", "epochs")
+REPLAY_COUNTER_FIELDS = (
+    "events", "snapshots", "ring_gaps", "epochs", "suppressed",
+)
 
 
 class ReplayRecorder:
@@ -67,8 +69,13 @@ class ReplayRecorder:
         # once by Decision at construction, exported with every annex
         self.meta = dict(meta or {})
         self._seq = 0  # cursor space: seq of the last recorded event
-        # (seq, t_mono, kind, area, key, version, originator, raw|None)
+        # (seq, t_mono, kind, area, key, version, originator, raw|None,
+        #  suppressed) — suppressed events (overload flap damping
+        # withheld them from the LSDB) are recorded for incident
+        # fidelity but NEVER applied on replay: they did not perturb
+        # the live RIB, so replaying them would break the digest ledger
         self._events: deque = deque(maxlen=self.ring)
+        self._suppressed = 0
         self._evicted_seq = 0  # newest seq the ring has dropped
         self._snapshot: Optional[dict] = None  # committed anchor
         self._snapshot_requested = True  # first solve anchors
@@ -95,22 +102,33 @@ class ReplayRecorder:
         originator: str,
         raw: bytes,
         recv_t: Optional[float] = None,
+        suppressed: bool = False,
     ) -> None:
         self._seq += 1
+        if suppressed:
+            self._suppressed += 1
         self._append((
             self._seq,
             recv_t if recv_t is not None else time.monotonic(),
             "kv", area, key, version, originator, raw,
+            bool(suppressed),
         ))
 
     def record_expired(
-        self, area: str, key: str, recv_t: Optional[float] = None
+        self,
+        area: str,
+        key: str,
+        recv_t: Optional[float] = None,
+        suppressed: bool = False,
     ) -> None:
         self._seq += 1
+        if suppressed:
+            self._suppressed += 1
         self._append((
             self._seq,
             recv_t if recv_t is not None else time.monotonic(),
             "expire", area, key, 0, "", None,
+            bool(suppressed),
         ))
 
     def cursor(self) -> int:
@@ -205,6 +223,7 @@ class ReplayRecorder:
             ("snapshots", self._snapshots),
             ("ring_gaps", self._gaps),
             ("epochs", self._epochs_recorded),
+            ("suppressed", self._suppressed),
         ):
             counters.set_counter(f"replay.{field}", value)
 
@@ -237,9 +256,10 @@ class ReplayRecorder:
                     None if raw is None
                     else base64.b64encode(raw).decode("ascii")
                 ),
+                "suppressed": suppressed,
             }
-            for seq, t, kind, area, key, version, originator, raw
-            in self._events
+            for seq, t, kind, area, key, version, originator, raw,
+            suppressed in self._events
             if seq > cursor
         ]
         return {
@@ -276,6 +296,7 @@ class ReplayRecorder:
             ),
             "epochs_recorded": self._epochs_recorded,
             "epochs_since_snapshot": self._epochs_since_snapshot,
+            "suppressed_events": self._suppressed,
             "ring_gaps": self._gaps,
             "gap": (
                 snap is not None

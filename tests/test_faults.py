@@ -143,6 +143,47 @@ class TestFaultRegistry:
             registry.arm("rpc.send", probability=1.5)
         with pytest.raises(ValueError):
             registry.arm("rpc.send", every_nth=-1)
+        with pytest.raises(ValueError):
+            registry.arm("rpc.send", rate=-1.0)
+        # rate is its own schedule — mixing with per-check schedules
+        # would make the storm's pacing ambiguous
+        with pytest.raises(ValueError):
+            registry.arm("rpc.send", rate=10.0, probability=0.5)
+        with pytest.raises(ValueError):
+            registry.arm("rpc.send", rate=10.0, every_nth=3)
+
+    def test_rate_schedule_paces_a_sustained_storm(self):
+        """ISSUE 19 satellite: `rate` fires at the target events/s no
+        matter how hot the check loop spins — a token bucket with
+        capacity one (no burst debt), not a per-call coin flip."""
+        registry.arm("decision.ingest", rate=200.0)
+        fired = 0
+        t0 = time.monotonic()
+        # spin far faster than 200 Hz for ~0.1 s
+        while time.monotonic() - t0 < 0.1:
+            try:
+                maybe_fail("decision.ingest")
+            except FaultInjected:
+                fired += 1
+        registry.clear("decision.ingest")
+        # 0.1 s at 200/s -> ~20 firings + the initial full token;
+        # generous bounds absorb scheduler jitter
+        assert 10 <= fired <= 35, fired
+
+    def test_rate_schedule_no_burst_debt_after_quiet_stretch(self):
+        registry.arm("fib.program", rate=1000.0)
+        with pytest.raises(FaultInjected):
+            maybe_fail("fib.program")  # initial token
+        time.sleep(0.05)  # 50 tokens' worth of quiet time...
+        fired = 0
+        for _ in range(10):
+            try:
+                maybe_fail("fib.program")
+            except FaultInjected:
+                fired += 1
+        registry.clear("fib.program")
+        # ...but the bucket caps at ONE token: no catch-up burst
+        assert fired <= 2, fired
 
     def test_configure_from_config(self):
         registry.configure(

@@ -34,6 +34,7 @@ sys.path.insert(0, str(REPO_ROOT))
 
 from openr_tpu.runtime.latency_budget import BUDGET_COMPONENTS  # noqa: E402
 from openr_tpu.runtime.lifecycle import BOOT_PHASES  # noqa: E402
+from openr_tpu.runtime.overload import OVERLOAD_COUNTER_FIELDS  # noqa: E402
 from openr_tpu.runtime.replay_log import REPLAY_COUNTER_FIELDS  # noqa: E402
 from openr_tpu.runtime.metrics_export import (  # noqa: E402
     is_valid_metric_name,
@@ -122,6 +123,17 @@ def run(project: Project) -> list[Finding]:
     if replay_site is not None:
         for field in REPLAY_COUNTER_FIELDS:
             counter_names.setdefault(f"replay.{field}", replay_site)
+    # And for the overload controller (runtime/overload.py): the
+    # `overload.<field>` gauge family is restamped on every ladder
+    # evaluation with a field drawn from the closed
+    # OVERLOAD_COUNTER_FIELDS vocabulary — expand it so overload.state,
+    # overload.brownout (the gauge_duration SLO source), and the rest
+    # participate in collision checking against the statically-named
+    # overload.damper.* / overload.transition_hook_errors counters.
+    overload_site = counter_names.pop(f"overload.{PLACEHOLDER}", None)
+    if overload_site is not None:
+        for field in OVERLOAD_COUNTER_FIELDS:
+            counter_names.setdefault(f"overload.{field}", overload_site)
     findings: list[Finding] = []
     # exposition family -> (raw name, site); stats expand to their
     # derived families so `a.b` (stat) vs `a.b_max` (counter) is caught

@@ -198,6 +198,12 @@ def replay_bundle(
             ei += 1
         prev_cursor = ep["cursor"]
         for ev in window:
+            # flap-damping withheld this event from the live LSDB
+            # (runtime/overload.py) — it is recorded for incident
+            # fidelity, but applying it here would perturb state the
+            # live solve never saw and break the digest bit-compare
+            if ev.get("suppressed"):
+                continue
             _apply_event(d, ev)
         replayed = _solve(d, full=ep.get("full", True))
         match = replayed == ep["digest"]
