@@ -790,6 +790,7 @@ def _ledger_record(name: str, res: dict) -> None:
         for k in ("compile_ms", "full_ms", "device_ms", "tpu_ms",
                   "exec_overhead_ms", "peak_hbm_mb", "cold_program_ms",
                   "incr_device_ms", "boot_first_rib_ms",
+                  "boot_first_rib_ms_warmcache", "aot_hit_rate",
                   "ack_p50_ms", "ack_p99_ms",
                   "bytes_downloaded_per_epoch")
         if isinstance(res.get(k), (int, float))
@@ -872,7 +873,110 @@ def bench_boot() -> dict:
     }
     log(f"[boot] first_rib {res['boot_first_rib_ms']} ms "
         f"phases {sorted(res['phases'])}")
+    res.update(bench_boot_aot())
     return res
+
+
+def bench_boot_aot() -> dict:
+    """Cold-vs-warm AOT-cache A/B on the boot lane (ISSUE 20): the same
+    two-node stack as bench_boot but with the device solver forced on,
+    run twice against one AOT cache directory. Run A compiles cold and
+    serializes every executable; a simulated restart then drops ALL
+    in-memory compiled state (bounded jit caches, jax's own caches, the
+    retrace sentinel's compile census) and run B boots against the
+    populated disk cache — its prewarm is deserialize-and-install, and
+    the retrace sentinel proves zero true compiles (any would page as
+    aot_warm_violation). Headlines: boot_first_rib_ms_warmcache +
+    aot_hit_rate (gated >= 0.9 by tools/perf_diff.py)."""
+    import asyncio
+    import os
+    import shutil
+    import tempfile
+
+    from openr_tpu.config import DecisionConfig
+    from openr_tpu.kvstore.wrapper import wait_until
+    from openr_tpu.ops.xla_cache import (
+        clear_all_jit_caches,
+        configure_aot,
+        retrace,
+    )
+    from openr_tpu.runtime.lifecycle import boot_tracer
+    from openr_tpu.runtime.openr_wrapper import OpenrWrapper
+    from openr_tpu.spark import MockIoMesh
+
+    cache_dir = os.environ.get("OPENR_TPU_AOT_BENCH_DIR") or tempfile.mkdtemp(
+        prefix="openr-aot-bench-"
+    )
+    cleanup = "OPENR_TPU_AOT_BENCH_DIR" not in os.environ
+    aot = configure_aot(cache_dir)
+
+    async def _one_boot() -> dict:
+        boot_tracer.reset()
+        boot_tracer.begin("boot-0")
+        mesh = MockIoMesh()
+        kv_ports: dict[str, int] = {}
+        dcfg = DecisionConfig(debounce_min_ms=5, debounce_max_ms=25)
+        nodes = {
+            n: OpenrWrapper(
+                n, mesh.provider(n), kv_ports,
+                decision_config=dcfg, solver_backend="tpu",
+            )
+            for n in ("boot-0", "boot-1")
+        }
+        mesh.connect("boot-0", "if-01", "boot-1", "if-10")
+        try:
+            await nodes["boot-0"].start("if-01")
+            await nodes["boot-1"].start("if-10")
+            nodes["boot-0"].advertise_prefix("10.99.0.1/32")
+            nodes["boot-1"].advertise_prefix("10.99.0.2/32")
+            await wait_until(
+                lambda: boot_tracer.report().get("complete"),
+                timeout_s=60.0,
+            )
+        finally:
+            for w in nodes.values():
+                await w.stop()
+        return boot_tracer.report()
+
+    try:
+        cold = asyncio.run(_one_boot())
+
+        # simulated daemon restart: the disk cache survives, nothing
+        # in-memory does — exactly what a real process restart drops
+        import jax
+
+        clear_all_jit_caches()
+        jax.clear_caches()
+        retrace.reset()
+        aot.reset_stats()
+        preload = aot.preload()
+
+        warm = asyncio.run(_one_boot())
+        summary = aot.summary()
+        scoped = retrace.snapshot()
+        res = {
+            "boot_first_rib_ms_coldcache": cold.get("first_rib_ms"),
+            "boot_first_rib_ms_warmcache": warm.get("first_rib_ms"),
+            "aot_hit_rate": summary.get("hit_rate"),
+            "aot_hits": summary.get("hits"),
+            "aot_misses": summary.get("misses"),
+            "aot_entries": summary.get("entries"),
+            "aot_preloaded": preload.get("loaded"),
+            "aot_warm_retraces": sum(
+                (scoped.get("retraces") or {}).values()
+            ),
+        }
+        log(
+            f"[boot-aot] cold {res['boot_first_rib_ms_coldcache']} ms -> "
+            f"warm {res['boot_first_rib_ms_warmcache']} ms "
+            f"(hit_rate {res['aot_hit_rate']}, "
+            f"{res['aot_entries']} entries)"
+        )
+        return res
+    finally:
+        configure_aot("off")
+        if cleanup:
+            shutil.rmtree(cache_dir, ignore_errors=True)
 
 
 def _write_budget_out(configs) -> None:
@@ -1012,6 +1116,10 @@ def main() -> None:
             "boot_first_rib_ms": configs.get("boot", {}).get(
                 "boot_first_rib_ms"
             ),
+            "boot_first_rib_ms_warmcache": configs.get("boot", {}).get(
+                "boot_first_rib_ms_warmcache"
+            ),
+            "aot_hit_rate": configs.get("boot", {}).get("aot_hit_rate"),
             "configs": configs,
         }))
         return
@@ -1197,6 +1305,14 @@ def main() -> None:
         "boot_first_rib_ms": configs.get("boot", {}).get(
             "boot_first_rib_ms"
         ),
+        # AOT executable cache A/B (ISSUE 20): the same boot with the
+        # device solver forced on, restarted against the populated
+        # serialized-executable cache — warm must sit materially below
+        # cold, with >= 0.9 of lookups served from disk
+        "boot_first_rib_ms_warmcache": configs.get("boot", {}).get(
+            "boot_first_rib_ms_warmcache"
+        ),
+        "aot_hit_rate": configs.get("boot", {}).get("aot_hit_rate"),
         # streaming-churn headline (ISSUE 16): flap-apply -> FIB ack
         # p99 under a sustained 100-events/s storm at 100k, plus the
         # changed-rows-proportional per-epoch download beside the full

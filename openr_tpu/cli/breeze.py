@@ -1313,6 +1313,57 @@ def tpu_kernels(ctx) -> None:
     _print(_call(ctx, "ctrl.tpu.kernels"))
 
 
+@tpu.command("aot")
+@click.option("--json", "as_json", is_flag=True,
+              help="raw JSON instead of the rendered table")
+@click.pass_context
+def tpu_aot(ctx, as_json) -> None:
+    """Persistent AOT executable cache: on-disk entries (kernel,
+    signature digest, size, fingerprint, age) and this process's
+    hit/miss summary. On a warm daemon `misses` should be 0 for every
+    baked shape class — a nonzero count means a boot compiled something
+    the cache was supposed to carry (docs/Operations.md runbook)."""
+    out = _call(ctx, "ctrl.tpu.aot")
+    if as_json:
+        _print(out)
+        return
+    s = out.get("summary", {})
+    if not s.get("enabled"):
+        click.echo("aot cache: DISABLED")
+        return
+    click.echo(f"aot cache: {s.get('dir')}  (keep={s.get('keep')}, "
+               f"fingerprint={s.get('fingerprint')})")
+    hr = s.get("hit_rate")
+    click.echo(
+        f"hits={s.get('hits', 0)} misses={s.get('misses', 0)} "
+        f"hit_rate={'-' if hr is None else f'{hr:.2f}'} "
+        f"load_errors={s.get('load_errors', 0)} "
+        f"stale={s.get('stale_fingerprint', 0)} "
+        f"writes={s.get('writes', 0)} "
+        f"speculative={s.get('speculative_bakes', 0)} "
+        f"installs={out.get('aot_installs', 0)}"
+    )
+    entries = out.get("entries", [])
+    if not entries:
+        click.echo("(no entries on disk)")
+        return
+    click.echo(f"{'kernel':<44} {'size':>9} {'age':>8} "
+               f"{'compile_ms':>10}  fingerprint")
+    for e in sorted(entries, key=lambda e: e.get("age_s") or 0):
+        if e.get("corrupt"):
+            click.echo(f"{e.get('file', '?'):<44} CORRUPT")
+            continue
+        size_kb = (e.get("size_bytes") or 0) / 1024
+        age = e.get("age_s") or 0
+        age_s = f"{age / 3600:.1f}h" if age >= 3600 else f"{age:.0f}s"
+        fp = e.get("fingerprint") or "?"
+        stale = " STALE" if e.get("stale") else ""
+        click.echo(
+            f"{(e.get('kernel') or '?')[:44]:<44} {size_kb:>8.1f}K "
+            f"{age_s:>8} {(e.get('compile_ms') or 0):>10.1f}  {fp}{stale}"
+        )
+
+
 @tpu.command("devices")
 @click.pass_context
 def tpu_devices(ctx) -> None:
@@ -1343,6 +1394,7 @@ def tech_support(ctx) -> None:
         ("DECISION VALIDATE", "ctrl.decision.validate", {}),
         ("FIB VALIDATE", "ctrl.fib.validate", {}),
         ("SUBSCRIBERS", "ctrl.subscriber_info", {}),
+        ("AOT CACHE", "ctrl.tpu.aot", {}),
         ("COUNTERS", "monitor.counters", {}),
     ]:
         click.echo(f"\n==== {title} ====")

@@ -135,6 +135,22 @@ class DecisionConfig:
     # recompilation (ops/xla_cache.py). "" = default resolution
     # ($OPENR_TPU_XLA_CACHE, then ~/.cache/openr_tpu/xla); "off" disables.
     xla_cache_dir: str = ""
+    # persistent AOT executable cache (ops/xla_cache.py, ISSUE 20):
+    # serialized compiled executables keyed by kernel + capacity
+    # signature + jax/backend fingerprint, preloaded during the
+    # `aot_load` boot phase so prewarm deserializes instead of
+    # compiling. "" = opt-in via $OPENR_TPU_AOT_CACHE (unset = off);
+    # "auto" = ~/.cache/openr_tpu/aot; "off" disables; anything else
+    # is the cache directory itself.
+    aot_cache_dir: str = ""
+    # newest-N on-disk retention for .aotx entries (flight-recorder
+    # pattern): oldest evicted past this count.
+    aot_cache_keep: int = 64
+    # speculative background bake (decision/tpu_solver.py): a daemon
+    # fiber compiles the NEXT capacity class up (and its mesh variant)
+    # whenever a vantage dispatches, so a churn-driven tier flip finds
+    # its executable already baked — on disk and in memory.
+    aot_speculate: bool = False
     # numerical-health sentinels (decision/tpu_solver.py): cheap
     # on-device reductions after each exec counting unreachable rows,
     # metric-overflow saturation, and bad UCMP weights; anomalies feed

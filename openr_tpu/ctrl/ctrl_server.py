@@ -103,6 +103,7 @@ class CtrlServer(Actor):
         s.register("ctrl.tpu.profiler.stop", self._tpu_profiler_stop)
         s.register("ctrl.tpu.profiler.status", self._tpu_profiler_status)
         s.register("ctrl.tpu.kernels", self._tpu_kernels)
+        s.register("ctrl.tpu.aot", self._tpu_aot)
         s.register("ctrl.tpu.devices", self._tpu_devices)
         s.register("ctrl.store.set", self._store_set)
         s.register("ctrl.store.get", self._store_get)
@@ -656,6 +657,20 @@ class CtrlServer(Actor):
         from openr_tpu.runtime import device_stats
 
         return device_stats.profiler_status()
+
+    async def _tpu_aot(self) -> dict:
+        """The persistent AOT executable cache: on-disk entries (kernel,
+        signature, size, fingerprint, age) + this process's hit/miss
+        ledger. `breeze tpu aot` renders it; a warm boot with misses > 0
+        is the first thing the cold-start runbook checks."""
+        from openr_tpu.ops.xla_cache import get_aot, retrace
+
+        cache = get_aot()
+        return {
+            "summary": cache.summary(),
+            "entries": cache.entries(),
+            "aot_installs": retrace.snapshot().get("aot_installs", 0),
+        }
 
     async def _tpu_devices(self) -> dict:
         """Per-device memory snapshot + live-array census (gauges'

@@ -152,6 +152,8 @@ def make_solver(
         kwargs.pop("spf_kernel", None)
         kwargs.pop("transfer_guard", None)
         kwargs.pop("streaming_pipeline", None)
+        kwargs.pop("aot_cache_dir", None)
+        kwargs.pop("aot_speculate", None)
         return SpfSolver(node_name, **kwargs)
     if backend in ("tpu", "auto"):
         try:
@@ -176,6 +178,8 @@ def make_solver(
             kwargs.pop("spf_kernel", None)
             kwargs.pop("transfer_guard", None)
             kwargs.pop("streaming_pipeline", None)
+            kwargs.pop("aot_cache_dir", None)
+            kwargs.pop("aot_speculate", None)
             return SpfSolver(node_name, **kwargs)
     raise ValueError(f"unknown solver backend {backend!r}")
 
@@ -241,6 +245,9 @@ class Decision(Actor):
             skw.setdefault(
                 "streaming_pipeline", config.streaming_pipeline
             )
+            # "" -> opt-in via $OPENR_TPU_AOT_CACHE (ops/xla_cache.py)
+            skw.setdefault("aot_cache_dir", config.aot_cache_dir or None)
+            skw.setdefault("aot_speculate", config.aot_speculate)
         self.solver = make_solver(
             node_name,
             backend,

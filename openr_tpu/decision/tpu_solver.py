@@ -743,7 +743,14 @@ def _instrumented_fused(
         g, n_cap, s_cap, r_cap, kr_cap, has_res, d_cap, p_cap, a_cap,
         budget, lfa, block_v4, sentinels, kernel, delta_exp,
     )
-    return name, instrument_jit(name, jitted)
+    # the AOT key carries EVERY factory arg: the display name above
+    # omits r_cap/kr_cap/budget and the block/sentinel flags, and two
+    # variants must never alias one serialized executable
+    aot_key = repr((
+        "fused", g, n_cap, s_cap, r_cap, kr_cap, has_res, d_cap, p_cap,
+        a_cap, budget, lfa, block_v4, sentinels, kernel, delta_exp,
+    ))
+    return name, instrument_jit(name, jitted, aot_key=aot_key)
 
 
 @bounded_jit_cache()
@@ -774,7 +781,12 @@ def _instrumented_pipeline(
         budget, lfa, block_v4, sentinels, emit_dist,
         kernel, delta_exp,
     )
-    return name, instrument_jit(name, jitted)
+    aot_key = repr((
+        "full", n_cap, s_cap, r_cap, kr_cap, has_res, d_cap, p_cap,
+        a_cap, budget, lfa, block_v4, sentinels, emit_dist, kernel,
+        delta_exp,
+    ))
+    return name, instrument_jit(name, jitted, aot_key=aot_key)
 
 
 @bounded_jit_cache(namespace="incr")
@@ -802,7 +814,12 @@ def _instrumented_incr(
         budget, dirty_cap, lfa, block_v4, sentinels,
         kernel, delta_exp,
     )
-    return name, instrument_jit(name, jitted)
+    aot_key = repr((
+        "incr", n_cap, s_cap, r_cap, kr_cap, has_res, d_cap, p_cap,
+        a_cap, budget, dirty_cap, lfa, block_v4, sentinels, kernel,
+        delta_exp,
+    ))
+    return name, instrument_jit(name, jitted, aot_key=aot_key)
 
 
 @bounded_jit_cache(namespace="stream")
@@ -863,7 +880,12 @@ def _instrumented_stream(
         budget, dirty_cap, sbudget, lfa, block_v4, sentinels,
         kernel, delta_exp, donate,
     )
-    return name, instrument_jit(name, jitted)
+    aot_key = repr((
+        "stream", n_cap, s_cap, r_cap, kr_cap, has_res, d_cap, p_cap,
+        a_cap, budget, dirty_cap, sbudget, lfa, block_v4, sentinels,
+        kernel, delta_exp, donate,
+    ))
+    return name, instrument_jit(name, jitted, aot_key=aot_key)
 
 
 def _mc_shardings(mesh, n_cap: int, r_cap: int, d_cap: int,
@@ -983,7 +1005,12 @@ def _instrumented_mc(
         a_cap, budget, lfa, block_v4, sentinels, emit_dist,
         kernel, delta_exp,
     )
-    return name, instrument_jit(name, jitted)
+    aot_key = repr((
+        "mc", _mesh_tag(mesh), n_cap, s_cap, r_cap, kr_cap, has_res,
+        d_cap, p_cap, a_cap, budget, lfa, block_v4, sentinels,
+        emit_dist, kernel, delta_exp,
+    ))
+    return name, instrument_jit(name, jitted, aot_key=aot_key)
 
 
 @bounded_jit_cache(namespace="multichip")
@@ -1010,7 +1037,60 @@ def _instrumented_mc_incr(
         a_cap, budget, dirty_cap, lfa, block_v4, sentinels,
         kernel, delta_exp,
     )
-    return name, instrument_jit(name, jitted)
+    aot_key = repr((
+        "mc_incr", _mesh_tag(mesh), n_cap, s_cap, r_cap, kr_cap,
+        has_res, d_cap, p_cap, a_cap, budget, dirty_cap, lfa, block_v4,
+        sentinels, kernel, delta_exp,
+    ))
+    return name, instrument_jit(name, jitted, aot_key=aot_key)
+
+
+# -- speculative next-class bake (ISSUE 20) ---------------------------------
+
+
+def _pipeline_avals(shape_key: tuple) -> tuple:
+    """Abstract avals for the 14-arg pipeline closure of a shape class —
+    exactly the shapes _lane_args uploads (deltas, shift plane,
+    residual tables, packed matrix buffer, root tables, prev outputs).
+    jitted.lower() accepts these in place of real arrays, so the
+    speculative baker compiles a class the fabric has not reached yet
+    without materializing a single array."""
+    import jax
+
+    n_cap, s_cap, r_cap, kr_cap, _has_res, d_cap, p_cap, a_cap = shape_key
+    i32 = np.int32
+    wa, wd = -(-a_cap // 16), -(-d_cap // 16)
+    S = jax.ShapeDtypeStruct
+    return (
+        S((s_cap,), i32),           # deltas
+        S((s_cap, n_cap), i32),     # shift_w
+        S((r_cap,), i32),           # res_rows
+        S((r_cap, kr_cap), i32),    # res_nbr
+        S((r_cap, kr_cap), i32),    # res_w
+        S((6 * p_cap * a_cap,), i32),  # packed matrix buffer
+        S((), i32),                 # root index
+        S((d_cap,), i32),           # root_nbr
+        S((d_cap,), i32),           # root_w
+        S((p_cap,), i32),           # prev metric
+        S((p_cap, wa), i32),        # prev s3 words
+        S((p_cap, wd), i32),        # prev nh words
+        S((p_cap,), i32),           # prev lfa slot
+        S((p_cap,), i32),           # prev lfa metric
+    )
+
+
+def _next_shape_key(shape_key: tuple) -> tuple:
+    """The capacity class one tier up from `shape_key`: n_cap doubles
+    and the node-proportional caps follow (residual rows when the class
+    has any, prefix rows), while the topology-local caps hold (shift
+    classes, per-row residual fanout, root degree, announcer width) —
+    capacities are pow2 (ops/edgeplan.py), so doubling lands exactly on
+    the next bucket a growing fabric pads into."""
+    n_cap, s_cap, r_cap, kr_cap, has_res, d_cap, p_cap, a_cap = shape_key
+    return (
+        n_cap * 2, s_cap, r_cap * 2 if has_res else r_cap, kr_cap,
+        has_res, d_cap, p_cap * 2, a_cap,
+    )
 
 
 @bounded_jit_cache()
@@ -1390,13 +1470,29 @@ class TpuSpfSolver:
         multichip_batch: int = 0,
         spf_kernel: str = "bucketed",
         transfer_guard: str = "off",
-        streaming_pipeline: bool = False, **solver_kwargs
+        streaming_pipeline: bool = False,
+        aot_cache_dir: str | None = None,
+        aot_speculate: bool = False, **solver_kwargs
     ):
         # a restarting daemon must not pay the ~80s 100k-node compile
         # again — load executables from the persistent cache
         from openr_tpu.ops.xla_cache import enable_compilation_cache
 
         enable_compilation_cache(xla_cache_dir)
+        # persistent AOT executable cache (ops/xla_cache.py): None
+        # leaves the process-global cache as configured (daemon boot /
+        # prewarm own it); a non-empty value points/enables it here —
+        # "auto" resolves the default directory, "off" disables.
+        if aot_cache_dir:
+            from openr_tpu.ops.xla_cache import configure_aot
+
+            configure_aot(aot_cache_dir)
+        # speculative next-class bake (ops/xla_cache.baker): after each
+        # dispatch, background-compile the capacity class one tier up
+        # (and its multichip variant past the threshold) so a tier flip
+        # finds its executable ready. Off by default — the bake burns a
+        # core per untaken tier; churny production fabrics opt in.
+        self.aot_speculate = bool(aot_speculate)
         self.my_node_name = my_node_name
         # numerical-health sentinels: on-device unreachable/saturation
         # reductions ride the pull buffers; UCMP weight checks run on
@@ -2694,6 +2790,56 @@ class TpuSpfSolver:
             with retrace.scope(namespace, kernel_name, signature):
                 return run(*args)
 
+    # backstop for the speculative doubler: never bake past this class
+    # (a misparsed cap would otherwise queue an absurd compile)
+    _SPECULATE_MAX_NCAP = 1 << 21
+
+    def _maybe_speculate(self, pv: dict) -> None:
+        """Hand the background-compile fiber (ops/xla_cache.baker) the
+        NEXT capacity class's full-solve executable (ISSUE 20): the
+        class one pow2 tier up per _next_shape_key, under this
+        dispatch's variant flags, compiled from abstract avals and
+        persisted to the AOT cache — so a fabric that grows through the
+        tier flip finds the executable installed instead of stalling
+        its first post-flip solve behind XLA. When the next class
+        crosses the multichip threshold the sharded variant is baked on
+        the tier mesh (with the root-degree axis padded to the batch
+        axis, mirroring _prep_vantage). The baker dedups by label, so
+        an oscillating fabric bakes each tier once; a wrong guess costs
+        one background compile and one retained cache file."""
+        if not self.aot_speculate:
+            return
+        from openr_tpu.ops.xla_cache import baker
+
+        nxt = _next_shape_key(pv["shape_key"])
+        if nxt[0] > self._SPECULATE_MAX_NCAP:
+            return
+        mesh = self._mc_mesh_for(nxt[0])
+        if mesh is not None:
+            b = mesh.shape["batch"]
+            d_pad = -(-nxt[5] // b) * b
+            nxt = nxt[:5] + (d_pad,) + nxt[6:]
+        lfa, block_v4 = pv["lfa"], pv["block_v4"]
+        sent, emit = self.enable_sentinels, self.incremental_spf
+        kern, dexp = pv["kernel"], pv["delta_exp"]
+        tier = _mesh_tag(mesh) if mesh is not None else "1chip"
+        label = f"next:{nxt}:{lfa}:{block_v4}:{kern}:{dexp}:{emit}:{tier}"
+
+        def bake():
+            if mesh is not None:
+                _, run = _instrumented_mc(
+                    mesh, *nxt, _DELTA_BUDGET, lfa, block_v4, sent,
+                    emit, kern, dexp,
+                )
+            else:
+                _, run = _instrumented_pipeline(
+                    *nxt, _DELTA_BUDGET, lfa, block_v4, sent, emit,
+                    kern, dexp,
+                )
+            run.prime(*_pipeline_avals(nxt))
+
+        baker.submit(label, bake)
+
     def _dispatch_one(self, pv: dict):
         """Dispatch one area's pipeline and start the async result copy;
         returns the prepare() closure for the materialization worker.
@@ -2704,6 +2850,7 @@ class TpuSpfSolver:
         emit = self.incremental_spf
         incr = pv.get("incr")
         mc = pv.get("mc")
+        self._maybe_speculate(pv)
         if mc is not None:
             counters.increment("decision.solver.multichip.dispatches")
         if incr is not None:

@@ -141,11 +141,27 @@ async def run_daemon(args) -> None:
             ph["cache_dir"] = enable_compilation_cache(
                 oc.decision_config.xla_cache_dir or None
             )
+        with boot_tracer.phase("aot_load", node=node_name) as ph:
+            from openr_tpu.ops.xla_cache import configure_aot
+
+            # deserialize previously compiled executables now, in this
+            # attributed phase, so prewarm/first-solve install instead
+            # of compiling (ISSUE 20)
+            _aot = configure_aot(
+                oc.decision_config.aot_cache_dir,
+                keep=oc.decision_config.aot_cache_keep,
+            )
+            ph["cache_dir"] = _aot.dir or None
+            if _aot.enabled:
+                ph.update(_aot.preload())
+            else:
+                ph["skipped"] = True
     else:
         boot_tracer.phase_mark(
             "device_init", node=node_name, backend=backend, skipped=True
         )
         boot_tracer.phase_mark("jit_cache_attach", node=node_name, skipped=True)
+        boot_tracer.phase_mark("aot_load", node=node_name, skipped=True)
 
     # prewarm happens offline (tools/prewarm.py); the phase attributes
     # what the bake paid per the perf ledger so the boot report shows

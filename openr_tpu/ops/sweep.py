@@ -152,7 +152,11 @@ def sweep_batch(b, r, es_cap, er_cap, n_cap, s_cap, r_cap, kr_cap,
         + (f",bk{delta_exp}" if kernel == "bucketed" else "")
         + "]"
     )
-    return name, instrument_jit(name, jax.jit(kern))
+    aot_key = repr((
+        "sweep", b, r, es_cap, er_cap, n_cap, s_cap, r_cap, kr_cap,
+        has_res, max_trips, return_dist, kernel, delta_exp,
+    ))
+    return name, instrument_jit(name, jax.jit(kern), aot_key=aot_key)
 
 
 # -- differentiable TE (softmin surrogate, arXiv:2209.10380) ---------------
@@ -251,4 +255,8 @@ def te_step(n_links, n_srcs, n_dem, es_cap, er_cap, n_cap, s_cap,
         + (",res" if has_res else "")
         + "]"
     )
-    return name, instrument_jit(name, jax.jit(fn))
+    aot_key = repr((
+        "te", n_links, n_srcs, n_dem, es_cap, er_cap, n_cap, s_cap,
+        r_cap, kr_cap, has_res, trips,
+    ))
+    return name, instrument_jit(name, jax.jit(fn), aot_key=aot_key)

@@ -14,6 +14,18 @@ Resolution order for the cache directory:
 
 Safe to call any number of times; only the first call wins (jax reads
 the setting at first compile).
+
+Two cache tiers live here (ISSUE 20). jax's persistent compilation
+cache above skips the XLA *backend* compile but still pays tracing,
+lowering and executable re-construction per kernel — tens of seconds
+across the solver's kernel set at the 100k class. The AOT executable
+cache below (`AotExecutableCache` / the `aot` singleton) removes the
+whole pass: `instrument_jit` serializes each freshly compiled
+executable (jax.experimental.serialize_executable) to its own
+fingerprinted file, and a warm restart deserializes-and-installs it —
+zero compiles, zero traces — during the `aot_load` boot phase. A
+`SpeculativeBaker` background fiber additionally compiles the NEXT
+capacity class before churn forces a tier flip.
 """
 
 from __future__ import annotations
@@ -22,6 +34,7 @@ import contextlib
 import functools
 import logging
 import os
+import sys
 import threading
 import time
 from collections import OrderedDict, deque
@@ -158,6 +171,10 @@ class RetraceSentinel:
         self._hooked: bool | None = None  # None = not yet attempted
         # (namespace, kernel name) -> capacity signature at last compile
         self._compiled: dict[tuple, tuple] = {}
+        # pairs installed warm from the AOT executable cache — no
+        # compile event ever fired for them, so a later compile is not
+        # a retrace but a WARM-CACHE VIOLATION (classified on the event)
+        self._aot_installed: set[tuple] = set()
         # namespace label -> retrace count (counter fabric mirror)
         self._retraces: dict[str, int] = {}
         # namespace label -> {capacity signatures} (factory-miss census)
@@ -195,17 +212,24 @@ class RetraceSentinel:
         stack = getattr(self._tls, "stack", None)
         if not stack:
             return
+        from openr_tpu.runtime.counters import counters
+
+        # every in-scope compile is counted: a warm-cache boot asserts
+        # this stays flat (zero true compiles for baked shape classes)
+        counters.increment("xla_cache.scoped_compiles")
         namespace, name, sig = stack[-1]
         key = (namespace, name)
         with self._lock:
             prev = self._compiled.get(key, _NEVER)
             self._compiled[key] = sig
+            aot_installed = key in self._aot_installed
         if prev is _NEVER:
             return  # warmup compile — expected
-        self._record_retrace(namespace, name, prev, sig)
+        self._record_retrace(namespace, name, prev, sig, aot_installed)
 
     def _record_retrace(
-        self, namespace: str, name: str, prev: tuple, sig: tuple
+        self, namespace: str, name: str, prev: tuple, sig: tuple,
+        aot_installed: bool = False,
     ) -> None:
         from openr_tpu.runtime.counters import counters
 
@@ -214,6 +238,11 @@ class RetraceSentinel:
         evt = {
             "namespace": label,
             "kernel": name,
+            # classification (ISSUE 20): "retrace" = trace-level churn
+            # after an in-process warmup compile; "aot_warm_violation"
+            # = the kernel was installed from the warm AOT cache and
+            # should NEVER compile again — the bug the sentinel guards
+            "class": "aot_warm_violation" if aot_installed else "retrace",
             "signature": repr(sig),
             "signature_delta": _sig_delta(prev, sig),
             "ts": time.time(),
@@ -223,8 +252,8 @@ class RetraceSentinel:
             self._events.append(evt)
             self._recent.append(dict(evt))
         log.warning(
-            "retrace after warmup: %s kernel %s (%s)",
-            label, name, evt["signature_delta"],
+            "%s after warmup: %s kernel %s (%s)",
+            evt["class"], label, name, evt["signature_delta"],
         )
 
     # -- solver-facing API -------------------------------------------------
@@ -245,6 +274,25 @@ class RetraceSentinel:
         finally:
             stack.pop()
 
+    def current_scope(self) -> tuple | None:
+        """(namespace, kernel, signature) of the innermost active scope
+        on this thread, or None — lets the AOT install path label
+        itself without replumbing every factory."""
+        stack = getattr(self._tls, "stack", None)
+        return stack[-1] if stack else None
+
+    def note_aot_install(
+        self, namespace: str, name: str, sig=()
+    ) -> None:
+        """An AOT-cache deserialize installed (namespace, kernel) warm
+        WITHOUT a compile event ever firing: mark the pair compiled so
+        any actual later compile classifies as a warm-cache violation
+        (a DEVICE_RETRACE page), never as warmup."""
+        key = (namespace, name)
+        with self._lock:
+            self._compiled.setdefault(key, tuple(sig))
+            self._aot_installed.add(key)
+
     def note_class(self, namespace: str, sig: tuple) -> None:
         """Factory-miss census: one distinct capacity signature seen in
         `namespace` (called by bounded_jit_cache)."""
@@ -263,6 +311,7 @@ class RetraceSentinel:
         with self._lock:
             for key in [k for k in self._compiled if k[0] == namespace]:
                 del self._compiled[key]
+                self._aot_installed.discard(key)
 
     def drain_events(self) -> list[dict]:
         """Pending retrace events, consumed (Decision -> LogSample)."""
@@ -278,6 +327,7 @@ class RetraceSentinel:
                 "classes": {
                     ns: len(sigs) for ns, sigs in self._classes.items()
                 },
+                "aot_installs": len(self._aot_installed),
                 "recent": [dict(e) for e in self._recent],
             }
 
@@ -286,6 +336,7 @@ class RetraceSentinel:
         be unregistered; an empty scope stack makes it a no-op)."""
         with self._lock:
             self._compiled.clear()
+            self._aot_installed.clear()
             self._retraces.clear()
             self._classes.clear()
             self._events.clear()
@@ -306,6 +357,21 @@ retrace = RetraceSentinel()
 # flag variants of the same shape class (lfa / block_v4 / sentinels)
 # live and die together, because a live bucket legitimately needs all
 # of its variants while a dead (outgrown) bucket needs none.
+
+
+# every bounded factory registers here so a simulated process restart
+# (bench boot A/B, the chaos warm-restart drill) can drop ALL in-memory
+# executables in one call and re-enter through the AOT load path
+_BOUNDED_CACHES: list = []
+
+
+def clear_all_jit_caches() -> int:
+    """Drop every bounded factory's cached (wrapper, executable) state —
+    the in-memory half of a process restart. On-disk AOT entries
+    survive; the next dispatch re-installs through aot.load()."""
+    for w in _BOUNDED_CACHES:
+        w.cache_clear()
+    return len(_BOUNDED_CACHES)
 
 
 def bounded_jit_cache(max_buckets: int = 8, namespace: str = ""):
@@ -388,6 +454,7 @@ def bounded_jit_cache(max_buckets: int = 8, namespace: str = ""):
                 buckets.clear()
 
         wrapper.cache_clear = cache_clear
+        _BOUNDED_CACHES.append(wrapper)
         return wrapper
 
     return decorate
@@ -436,8 +503,12 @@ class KernelLedger:
 
     def record(
         self, name: str, compile_ms: float | None, cost: dict,
-        aot: bool = True,
+        aot: bool = True, loaded: bool = False,
+        load_ms: float | None = None,
     ) -> None:
+        """`loaded` marks an executable installed from the persistent
+        AOT cache (deserialize, no compile): compile_ms stays None and
+        load_ms records what the install actually cost."""
         from openr_tpu.runtime.counters import counters
 
         with self._lock:
@@ -447,6 +518,10 @@ class KernelLedger:
                     round(compile_ms, 3) if compile_ms is not None else None
                 ),
                 "aot": aot,
+                "aot_loaded": loaded,
+                "load_ms": (
+                    round(load_ms, 3) if load_ms is not None else None
+                ),
                 "calls": 0,
                 **cost,
             }
@@ -479,7 +554,497 @@ class KernelLedger:
 ledger = KernelLedger()
 
 
-def instrument_jit(name: str, jitted):
+# -- persistent AOT executable cache (ISSUE 20) ------------------------------
+#
+# jax's persistent compilation cache (enable_compilation_cache above)
+# skips the XLA backend compile but still pays tracing + lowering +
+# executable construction per kernel on every restart. This tier
+# removes the whole pass: each freshly compiled executable is
+# serialized (jax.experimental.serialize_executable) to its own file,
+# keyed by (kernel name, full factory-arg signature) and stamped with
+# the jax+jaxlib+backend+device fingerprint; a warm restart
+# deserializes-and-installs it with ZERO compiles. Fallbacks are total:
+# a stale fingerprint or a torn/corrupt file silently degrades to the
+# compile path (counted, never raising into a solve), writes are
+# atomic (tmp + os.replace, the perf-ledger idiom), and on-disk
+# retention keeps the newest N entries (the flight-recorder idiom).
+
+ENV_AOT_DIR = "OPENR_TPU_AOT_CACHE"
+AOT_SUFFIX = ".aotx"
+# closed counter vocabulary for the xla_cache.aot.<field> family
+# (tools/lint/metric_names.py expands the placeholder over this)
+AOT_COUNTER_FIELDS = (
+    "hits", "misses", "load_errors", "stale_fingerprint", "writes",
+    "write_errors", "evictions", "preloaded", "speculative_bakes",
+    "speculative_errors",
+)
+
+
+def aot_fingerprint() -> str:
+    """Toolchain + device identity a serialized executable is valid
+    under. Deliberately eager on jax (unlike perf_ledger.fingerprint):
+    it is only evaluated once the AOT cache is enabled, which implies a
+    device-plane process. Device kind AND count are part of it — a
+    sharded executable deserialized onto a different mesh is garbage."""
+    try:
+        import jax
+
+        jaxlib = sys.modules.get("jaxlib")
+        devs = jax.devices()
+        kind = devs[0].device_kind.replace(" ", "_") if devs else "?"
+        return (
+            f"jax{getattr(jax, '__version__', '?')}"
+            f"+jaxlib{getattr(jaxlib, '__version__', '?')}"
+            f"+{jax.default_backend()}+{kind}x{len(devs)}"
+        )
+    # lint: allow(broad-except) identity probe is best-effort
+    except Exception:  # pragma: no cover - no usable jax
+        return "nojax"
+
+
+class AotExecutableCache:
+    """One directory of serialized compiled executables, one file per
+    (kernel name, factory-arg signature). Disabled ("" dir) it is a
+    total no-op — loads return None, stores return False — so tests
+    and control-plane processes never touch disk."""
+
+    SCHEMA = "openr-tpu-aot/1"
+
+    def __init__(self, dir_path: str = "", keep: int = 64):
+        self.dir = dir_path or ""
+        self.keep = int(keep)
+        self._lock = threading.Lock()
+        self._fp: str | None = None
+        # preload() parks deserialized executables here; load() claims
+        # them by digest so boot pays deserialization once, in its own
+        # attributed aot_load phase, not inside the first solve
+        self._preloaded: dict[str, object] = {}
+        self._stats = {f: 0 for f in AOT_COUNTER_FIELDS}
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.dir)
+
+    def fingerprint(self) -> str:
+        if self._fp is None:
+            self._fp = aot_fingerprint()
+        return self._fp
+
+    def _bump(self, field: str, n: int = 1) -> None:
+        from openr_tpu.runtime.counters import counters
+
+        with self._lock:
+            self._stats[field] = self._stats.get(field, 0) + n
+        counters.increment(f"xla_cache.aot.{field}", n)
+
+    # -- keying ------------------------------------------------------------
+
+    @staticmethod
+    def _digest(name: str, key: str) -> str:
+        import hashlib
+
+        return hashlib.sha256(f"{name}|{key}".encode()).hexdigest()[:20]
+
+    @staticmethod
+    def _slug(name: str) -> str:
+        safe = "".join(
+            c if (c.isalnum() or c in "._=-") else "_" for c in name
+        )
+        return safe[:80] or "kernel"
+
+    def _path(self, name: str, key: str) -> str:
+        return os.path.join(
+            self.dir, f"{self._slug(name)}-{self._digest(name, key)}{AOT_SUFFIX}"
+        )
+
+    # -- file format: one JSON header line + pickled serialize() triple ----
+
+    @staticmethod
+    def _read_file(path: str) -> tuple[dict, bytes]:
+        """-> (header, blob); raises on a torn/corrupt entry (the
+        caller counts + evicts). The header is newline-terminated JSON
+        (json.dumps emits no raw newlines), the rest is the pickled
+        (payload, in_tree, out_tree) triple."""
+        import json
+
+        with open(path, "rb") as f:
+            raw = f.read()
+        head, sep, blob = raw.partition(b"\n")
+        header = json.loads(head.decode())
+        if (
+            not sep
+            or not isinstance(header, dict)
+            or header.get("schema") != AotExecutableCache.SCHEMA
+            or not blob
+        ):
+            raise ValueError(f"malformed AOT cache entry {path}")
+        return header, blob
+
+    def _evict(self, path: str) -> None:
+        with contextlib.suppress(OSError):
+            os.remove(path)
+
+    # -- store / load ------------------------------------------------------
+
+    def store(
+        self, name: str, key: str, compiled, compile_ms: float | None = None,
+        source: str = "compile",
+    ) -> bool:
+        """Serialize one compiled executable to its keyed file. Atomic
+        (tmp + os.replace) and best-effort: any failure is counted and
+        swallowed — the in-memory executable keeps working."""
+        if not self.enabled:
+            return False
+        import json
+        import pickle
+
+        try:
+            from jax.experimental.serialize_executable import serialize
+
+            payload, in_tree, out_tree = serialize(compiled)
+            blob = pickle.dumps(
+                (payload, in_tree, out_tree),
+                protocol=pickle.HIGHEST_PROTOCOL,
+            )
+            header = json.dumps({
+                "schema": self.SCHEMA,
+                "kernel": name,
+                "aot_key": key,
+                "fingerprint": self.fingerprint(),
+                "created_ms": int(time.time() * 1000),
+                "compile_ms": (
+                    round(compile_ms, 3) if compile_ms is not None else None
+                ),
+                "source": source,
+            }).encode()
+            os.makedirs(self.dir, exist_ok=True)
+            path = self._path(name, key)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(header + b"\n" + blob)
+            os.replace(tmp, path)
+        # lint: allow(broad-except) cache writes never fail a solve
+        except Exception as e:
+            self._bump("write_errors")
+            log.warning("AOT cache write failed for %s (%s)", name, e)
+            return False
+        self._bump("writes")
+        self._prune()
+        return True
+
+    def _load_file(self, path: str):
+        """Deserialize one entry; returns the executable or None with
+        the failure counted and the bad file evicted (corrupt entries
+        must fall back to compile silently, never crash, and never be
+        retried forever)."""
+        import pickle
+
+        try:
+            header, blob = self._read_file(path)
+        # lint: allow(broad-except) torn/corrupt entry -> compile path
+        except Exception:
+            self._bump("load_errors")
+            log.warning(
+                "corrupt AOT cache entry %s — evicted, will recompile",
+                path,
+            )
+            self._evict(path)
+            return None
+        if header.get("fingerprint") != self.fingerprint():
+            # a toolchain/backend/device-topology bump invalidates the
+            # entry; evict so the next store rewrites it fresh
+            self._bump("stale_fingerprint")
+            self._evict(path)
+            return None
+        try:
+            from jax.experimental.serialize_executable import (
+                deserialize_and_load,
+            )
+
+            payload, in_tree, out_tree = pickle.loads(blob)
+            return deserialize_and_load(payload, in_tree, out_tree)
+        # lint: allow(broad-except) undeserializable entry -> compile
+        except Exception as e:
+            self._bump("load_errors")
+            log.warning(
+                "AOT deserialize failed for %s (%s) — evicted", path, e
+            )
+            self._evict(path)
+            return None
+
+    def load(self, name: str, key: str):
+        """The warm path: claim a preloaded executable or deserialize
+        the keyed file. Every call that cannot produce an executable —
+        absent, stale or corrupt — counts one miss (aot_hit_rate =
+        hits / (hits + misses))."""
+        if not self.enabled:
+            return None
+        digest = self._digest(name, key)
+        with self._lock:
+            fn = self._preloaded.pop(digest, None)
+        if fn is None:
+            path = self._path(name, key)
+            if os.path.exists(path):
+                t0 = time.perf_counter()
+                fn = self._load_file(path)
+                if fn is not None:
+                    from openr_tpu.runtime.counters import counters
+
+                    counters.add_stat_value(
+                        "xla_cache.aot.load_ms",
+                        (time.perf_counter() - t0) * 1e3,
+                    )
+        if fn is None:
+            self._bump("misses")
+            return None
+        self._bump("hits")
+        return fn
+
+    def preload(self) -> dict:
+        """Eagerly deserialize every fingerprint-matching entry into
+        memory — the `aot_load` boot phase (runtime/lifecycle.py).
+        Returns the phase attribution dict; stale/corrupt entries are
+        counted + evicted exactly as on the lazy path."""
+        if not self.enabled:
+            return {"enabled": False}
+        loaded = skipped = 0
+        nbytes = 0
+        before = dict(self._stats)
+        for path in sorted(self._entry_paths()):
+            try:
+                header, _ = self._read_file(path)
+            # lint: allow(broad-except) corrupt entry -> counted evict
+            except Exception:
+                self._bump("load_errors")
+                self._evict(path)
+                continue
+            digest = self._digest(
+                str(header.get("kernel")), str(header.get("aot_key"))
+            )
+            with self._lock:
+                have = digest in self._preloaded
+            if have:
+                skipped += 1
+                continue
+            fn = self._load_file(path)
+            if fn is None:
+                continue
+            with self._lock:
+                self._preloaded[digest] = fn
+            loaded += 1
+            nbytes += os.path.getsize(path) if os.path.exists(path) else 0
+        if loaded:
+            self._bump("preloaded", loaded)
+        return {
+            "enabled": True,
+            "loaded": loaded,
+            "skipped": skipped,
+            "stale": self._stats["stale_fingerprint"]
+            - before["stale_fingerprint"],
+            "errors": self._stats["load_errors"] - before["load_errors"],
+            "bytes": nbytes,
+        }
+
+    # -- retention / introspection -----------------------------------------
+
+    def _entry_paths(self) -> list[str]:
+        if not self.enabled or not os.path.isdir(self.dir):
+            return []
+        return [
+            os.path.join(self.dir, f)
+            for f in os.listdir(self.dir)
+            if f.endswith(AOT_SUFFIX)
+        ]
+
+    def _prune(self) -> None:
+        """Newest-N on-disk retention (the flight-recorder idiom): keep
+        the `keep` most recently written entries, evict the rest."""
+        paths = self._entry_paths()
+        if len(paths) <= self.keep:
+            return
+        try:
+            paths.sort(key=lambda p: os.path.getmtime(p), reverse=True)
+        except OSError:
+            return
+        dropped = 0
+        for path in paths[self.keep:]:
+            self._evict(path)
+            dropped += 1
+        if dropped:
+            self._bump("evictions", dropped)
+
+    def entries(self) -> list[dict]:
+        """On-disk listing for ctrl.tpu.aot / breeze tpu aot: kernel,
+        signature, size, fingerprint (+staleness), age."""
+        now = time.time()
+        fp = self.fingerprint() if self.enabled else ""
+        out = []
+        for path in self._entry_paths():
+            try:
+                header, _ = self._read_file(path)
+                size = os.path.getsize(path)
+            # lint: allow(broad-except) listing skips torn entries
+            except Exception:
+                out.append({"file": os.path.basename(path), "corrupt": True})
+                continue
+            created = header.get("created_ms") or 0
+            out.append({
+                "file": os.path.basename(path),
+                "kernel": header.get("kernel"),
+                "signature": header.get("aot_key"),
+                "size_bytes": size,
+                "fingerprint": header.get("fingerprint"),
+                "stale": header.get("fingerprint") != fp,
+                "age_s": round(max(0.0, now - created / 1e3), 1),
+                "compile_ms": header.get("compile_ms"),
+                "source": header.get("source"),
+            })
+        out.sort(key=lambda e: e.get("age_s") or 0.0)
+        return out
+
+    def summary(self) -> dict:
+        with self._lock:
+            stats = dict(self._stats)
+            pending = len(self._preloaded)
+        lookups = stats["hits"] + stats["misses"]
+        return {
+            "enabled": self.enabled,
+            "dir": self.dir,
+            "keep": self.keep,
+            "fingerprint": self.fingerprint() if self.enabled else None,
+            "entries": len(self._entry_paths()),
+            "preloaded_pending": pending,
+            "hit_rate": (
+                round(stats["hits"] / lookups, 4) if lookups else None
+            ),
+            **stats,
+        }
+
+    def reset_stats(self) -> None:
+        """Test/bench hook: zero the in-memory stat mirror (the counter
+        fabric keeps its own totals) and drop unclaimed preloads."""
+        with self._lock:
+            self._stats = {f: 0 for f in AOT_COUNTER_FIELDS}
+            self._preloaded.clear()
+
+
+# process singleton (the tracer/counters pattern); disabled by default
+aot = AotExecutableCache("")
+
+_AOT_DISABLE = _DISABLE
+_AOT_AUTO = ("auto", "default")
+
+
+def configure_aot(
+    spec: str | None, keep: int | None = None
+) -> AotExecutableCache:
+    """Point the process AOT cache at a directory.
+
+    `spec` resolution: None/"" consults $OPENR_TPU_AOT_CACHE (empty =
+    stays disabled — the cache is opt-in, unlike the jax compilation
+    cache); "auto" resolves ~/.cache/openr_tpu/aot; "off"/"0" disables;
+    anything else is the directory. Repointing drops unclaimed
+    preloads; an identical repoint is a cheap no-op."""
+    global aot
+    raw = spec if spec else os.environ.get(ENV_AOT_DIR, "")
+    d = raw.strip()
+    if d.lower() in _AOT_DISABLE or not d:
+        d = ""
+    elif d.lower() in _AOT_AUTO:
+        d = os.path.join(
+            os.path.expanduser("~"), ".cache", "openr_tpu", "aot"
+        )
+    if d != aot.dir or (keep is not None and keep != aot.keep):
+        aot = AotExecutableCache(d, keep if keep is not None else aot.keep)
+    return aot
+
+
+def get_aot() -> AotExecutableCache:
+    """Current process AOT cache (configure_aot may have swapped the
+    module global; call sites that cache the object would miss it)."""
+    return aot
+
+
+# -- speculative background-compile fiber ------------------------------------
+
+
+class SpeculativeBaker:
+    """Single background thread that compiles executables BEFORE churn
+    needs them (the next capacity class up, the multichip mesh shapes).
+    Work items are deduplicated by label for the process lifetime — a
+    tier the fabric oscillates around is baked once, not per solve.
+    Failures are counted and logged at debug: a speculative miss costs
+    nothing but the wasted compile."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._queue: deque = deque()
+        self._seen: set[str] = set()
+        self._pending = 0
+        self._thread: threading.Thread | None = None
+
+    def submit(self, label: str, thunk) -> bool:
+        """Enqueue one bake; returns False when the label already ran
+        (or is queued). The worker thread starts lazily on first use."""
+        with self._cv:
+            if label in self._seen:
+                return False
+            self._seen.add(label)
+            self._queue.append((label, thunk))
+            self._pending += 1
+            if self._thread is None:
+                # lint: allow(executor-escape) baker owns only its queue + the process AOT cache, both lock-guarded
+                self._thread = threading.Thread(
+                    target=self._run, name="aot-baker", daemon=True
+                )
+                self._thread.start()
+            self._cv.notify_all()
+        return True
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue:
+                    self._cv.wait()
+                label, thunk = self._queue.popleft()
+            try:
+                thunk()
+                aot._bump("speculative_bakes")
+                log.debug("speculative bake done: %s", label)
+            # lint: allow(broad-except) a failed bake is a counted no-op
+            except Exception:
+                aot._bump("speculative_errors")
+                log.debug("speculative bake failed: %s", label,
+                          exc_info=True)
+            with self._cv:
+                self._pending -= 1
+                self._cv.notify_all()
+
+    def drain(self, timeout: float = 60.0) -> bool:
+        """Block until every queued bake finished (tests/bench); False
+        on timeout."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._pending:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cv.wait(left)
+        return True
+
+    def reset(self) -> None:
+        """Test hook: drop queued (not in-flight) work + the dedup set."""
+        with self._cv:
+            self._pending -= len(self._queue)
+            self._queue.clear()
+            self._seen.clear()
+            self._cv.notify_all()
+
+
+baker = SpeculativeBaker()
+
+
+def instrument_jit(name: str, jitted, aot_key: str | None = None):
     """Wrap a jitted callable so its first invocation AOT-compiles
     (lower().compile()), recording compile time + cost_analysis into
     the ledger, and every later invocation hits the compiled executable
@@ -487,25 +1052,114 @@ def instrument_jit(name: str, jitted):
     instrumented instance — true for the solver's shape-keyed pipeline
     factories, whose lru key IS the shape class. Where AOT fails (e.g.
     a backend quirk) the wrapper degrades to the plain jitted fn and
-    the ledger says so."""
+    the ledger says so.
 
-    state: dict = {"fn": None}
+    With `aot_key` (the canonical repr of EVERY factory argument — the
+    kernel name alone under-keys: it omits r_cap/kr_cap/budget and the
+    sentinel/block flags) the persistent executable cache engages:
+    install first consults aot.load(name, aot_key) — a hit deserializes
+    in milliseconds with no compile event, and the retrace sentinel is
+    told so a later compile for the pair pages as a warm-cache
+    violation — and a fresh compile is serialized back via aot.store.
+    A loaded executable whose avals reject the first real call (an
+    under-keyed or foreign entry) falls back to compiling, counted as
+    a load error. `wrapper.prime(*avals)` installs without executing —
+    jax.ShapeDtypeStruct args suffice — which is how the speculative
+    baker bakes the next capacity class from abstract shapes."""
 
-    def wrapper(*args, **kwargs):
-        fn = state["fn"]
-        if fn is None:
+    state: dict = {"fn": None, "verify_loaded": False}
+    lock = threading.Lock()
+
+    def _mark_installed() -> None:
+        scope = retrace.current_scope()
+        if scope is not None:
+            retrace.note_aot_install(scope[0], name, scope[2])
+        else:
+            retrace.note_aot_install("", name)
+
+    def _compile(args, kwargs):
+        t0 = time.perf_counter()
+        fn = jitted.lower(*args, **kwargs).compile()
+        compile_ms = (time.perf_counter() - t0) * 1e3
+        ledger.record(name, compile_ms, _extract_cost(fn))
+        if aot_key is not None:
+            aot.store(name, aot_key, fn, compile_ms)
+        return fn
+
+    def _install(args, kwargs):
+        """-> (fn, loaded_from_cache). Caller holds `lock`."""
+        if aot_key is not None and aot.enabled:
+            t0 = time.perf_counter()
+            fn = aot.load(name, aot_key)
+            if fn is not None:
+                ledger.record(
+                    name, None, _extract_cost(fn), loaded=True,
+                    load_ms=(time.perf_counter() - t0) * 1e3,
+                )
+                _mark_installed()
+                return fn, True
+        return _compile(args, kwargs), False
+
+    def _ensure(args, kwargs):
+        with lock:
+            fn = state["fn"]
+            if fn is not None:
+                return fn
             try:
-                t0 = time.perf_counter()
-                fn = jitted.lower(*args, **kwargs).compile()
-                compile_ms = (time.perf_counter() - t0) * 1e3
-                ledger.record(name, compile_ms, _extract_cost(fn))
+                fn, loaded = _install(args, kwargs)
+                state["verify_loaded"] = loaded
             # lint: allow(broad-except) degrades to plain jit, ledgered
             except Exception as e:
                 log.debug("AOT compile failed for %s (%s)", name, e)
                 fn = jitted
                 ledger.record(name, None, {}, aot=False)
             state["fn"] = fn
+            return fn
+
+    def wrapper(*args, **kwargs):
+        fn = state["fn"]
+        if fn is None:
+            fn = _ensure(args, kwargs)
         ledger.bump_calls(name)
+        if state["verify_loaded"]:
+            # first call on a cache-loaded executable: a TypeError here
+            # is the aval-mismatch rejection (raised before execution)
+            # — fall back to a fresh compile, counted, never crashing
+            state["verify_loaded"] = False
+            try:
+                return fn(*args, **kwargs)
+            except TypeError as e:
+                aot._bump("load_errors")
+                log.warning(
+                    "AOT-loaded executable %s rejected its first call "
+                    "(%s); recompiling", name, e,
+                )
+                with lock:
+                    try:
+                        fn = _compile(args, kwargs)
+                    # lint: allow(broad-except) degrade to plain jit
+                    except Exception:
+                        fn = jitted
+                        ledger.record(name, None, {}, aot=False)
+                    state["fn"] = fn
+                return fn(*args, **kwargs)
         return fn(*args, **kwargs)
 
+    def prime(*args, **kwargs) -> bool:
+        """Install (AOT-load or compile + persist) WITHOUT executing;
+        `args` may be jax.ShapeDtypeStructs. Returns True when this
+        call did the install. The speculative baker's entry point."""
+        if state["fn"] is not None:
+            return False
+        with lock:
+            if state["fn"] is not None:
+                return False
+            fn, loaded = _install(args, kwargs)
+            state["verify_loaded"] = loaded
+            state["fn"] = fn
+        return True
+
+    wrapper.prime = prime
+    wrapper.kernel_name = name
+    wrapper.is_installed = lambda: state["fn"] is not None
     return wrapper

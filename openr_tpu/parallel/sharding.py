@@ -33,7 +33,7 @@ import numpy as np
 
 from openr_tpu.ops import relax as relax_ops
 from openr_tpu.ops.edgeplan import INF32E
-from openr_tpu.ops.xla_cache import bounded_jit_cache
+from openr_tpu.ops.xla_cache import bounded_jit_cache, instrument_jit, retrace
 
 INF_E = int(INF32E)
 _UNROLL = relax_ops.UNROLL
@@ -70,11 +70,11 @@ def make_mesh(n_devices: Optional[int] = None, batch: Optional[int] = None):
 def _sharded_fabric_fn(mesh, n_cap: int, s_cap: int, r_cap: int,
                        kr_cap: int, has_res: bool, d_cap: int,
                        p_cap: int, a_cap: int, n_trips: int,
-                       lfa: bool = False):
-    """shard_mapped whole-fabric pipeline: for each root (sharded over
-    'batch'), batched-seed SSSP with graph-axis-sharded weights, then
-    best-route selection. Returns (dist[R, N], metric[R, P],
-    nh_mask[R, P, D])."""
+                       lfa: bool = False, rt_cap: int = 0):
+    """(kernel name, instrumented executable) for the shard_mapped
+    whole-fabric pipeline: for each root (sharded over 'batch'),
+    batched-seed SSSP with graph-axis-sharded weights, then best-route
+    selection. Returns (dist[R, N], metric[R, P], nh_mask[R, P, D])."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -219,7 +219,7 @@ def _sharded_fabric_fn(mesh, n_cap: int, s_cap: int, r_cap: int,
         from jax.experimental.shard_map import shard_map
         _check_kw = {"check_rep": False}
 
-    return jax.jit(
+    jitted = jax.jit(
         shard_map(
             local_fn,
             mesh=mesh,
@@ -249,6 +249,20 @@ def _sharded_fabric_fn(mesh, n_cap: int, s_cap: int, r_cap: int,
             **_check_kw,
         )
     )
+    mesh_tag = f"{mesh.shape['batch']}x{mesh.shape['graph']}"
+    # rt_cap (the padded root-batch extent) is part of the executable's
+    # identity: instrument_jit pins ONE compiled aval set per instance,
+    # so the factory key must carry every dispatched-shape degree of
+    # freedom (a plain jax.jit would have silently retraced instead)
+    name = (
+        f"fabric[mesh={mesh_tag},n={n_cap},rt={rt_cap},p={p_cap}"
+        f",t={n_trips}" + (",lfa" if lfa else "") + "]"
+    )
+    aot_key = repr((
+        "fabric", mesh_tag, n_cap, s_cap, r_cap, kr_cap, has_res,
+        d_cap, p_cap, a_cap, n_trips, lfa, rt_cap,
+    ))
+    return name, instrument_jit(name, jitted, aot_key=aot_key)
 
 
 class Unconverged(AssertionError):
@@ -759,18 +773,20 @@ def sharded_fabric_step(mesh, plan, matrix, roots, out_nbr, out_w,
         matrix.is_v4 if block_v4 else np.zeros(p_cap, bool)
     )
 
-    fn = _sharded_fabric_fn(
+    name, fn = _sharded_fabric_fn(
         mesh, n_cap, plan.s_cap, r_cap, kr_cap, has_res, d_cap,
-        p_cap, a_cap, n_trips, lfa,
+        p_cap, a_cap, n_trips, lfa, int(roots.shape[0]),
     )
-    dist, metric, s3, nh_mask, lfa_slot, lfa_metric, ok, converged = fn(
-        plan.deltas, shift_w, res_rows, res_nbr, res_w,
-        roots.astype(np.int32), out_nbr.astype(np.int32),
-        out_w.astype(np.int32),
-        matrix.ann_node, flags, matrix.path_pref, matrix.source_pref,
-        matrix.dist_adv,
-        matrix.min_nexthop.astype(np.int32), v4_blocked,
-    )
+    sig = (n_cap, r_cap, d_cap, p_cap, a_cap, n_trips, int(roots.shape[0]))
+    with retrace.scope("fabric", name, sig):
+        dist, metric, s3, nh_mask, lfa_slot, lfa_metric, ok, converged = fn(
+            plan.deltas, shift_w, res_rows, res_nbr, res_w,
+            roots.astype(np.int32), out_nbr.astype(np.int32),
+            out_w.astype(np.int32),
+            matrix.ann_node, flags, matrix.path_pref, matrix.source_pref,
+            matrix.dist_adv,
+            matrix.min_nexthop.astype(np.int32), v4_blocked,
+        )
     if check_convergence:
         conv = np.asarray(converged)
         if not conv.all():

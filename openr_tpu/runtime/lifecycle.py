@@ -8,9 +8,13 @@ attach, prewarm attribution, the initial full sync, the first
 compile-heavy solve) was invisible. This module records that one-shot
 timeline:
 
-  config_load -> device_init -> jit_cache_attach -> prewarm
-    -> kvstore_initial_sync -> first_solve -> first_rib_delta
-    -> first_fib_program
+  config_load -> device_init -> jit_cache_attach -> aot_load
+    -> prewarm -> kvstore_initial_sync -> first_solve
+    -> first_rib_delta -> first_fib_program
+
+``aot_load`` (ISSUE 20) is the persistent executable-cache preload:
+deserializing previously compiled kernels from disk so the prewarm
+phase that follows installs them instead of invoking XLA.
 
 ``main.run_daemon`` calls ``boot_tracer.begin(node)`` before any actor
 spins up; phases are stamped from wherever they actually complete
@@ -53,6 +57,7 @@ BOOT_PHASES = (
     "config_load",
     "device_init",
     "jit_cache_attach",
+    "aot_load",
     "prewarm",
     "kvstore_initial_sync",
     "first_solve",

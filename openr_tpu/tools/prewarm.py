@@ -27,6 +27,13 @@ records a `prewarm[<namespace>:<nodes>]` entry (compile_ms) in the
 kernel ledger so `breeze tpu kernels` shows what the bake paid per
 workload class.
 
+With --aot-cache-dir (or $OPENR_TPU_AOT_CACHE) every executable the
+bake compiles is ALSO serialized into the persistent AOT cache
+(ops/xla_cache.py, ISSUE 20): a restarting daemon's `aot_load` boot
+phase then deserializes the finished executables instead of replaying
+the XLA compile against the source cache — prewarm becomes an
+install pass, not a compile pass.
+
 Every bake compiles BOTH round-loop kernels (ops/relax.py): the
 default bucketed Δ-stepping executables (the synthetic grid derives
 the same pow2-quantized delta_exp capacity signature a production
@@ -310,6 +317,13 @@ def main(argv=None) -> int:
         help="also bake the what-if sweep (whatif) namespace",
     )
     p.add_argument(
+        "--aot-cache-dir", default="auto",
+        help="persistent AOT executable-cache directory to bake "
+        "serialized executables into (default 'auto' = "
+        "~/.cache/openr_tpu/aot; 'off' disables; empty consults "
+        "$OPENR_TPU_AOT_CACHE)",
+    )
+    p.add_argument(
         "--perf-ledger-dir", default=None,
         help="perf-ledger directory for bake-time records (default: "
         "$OPENR_TPU_PERF_LEDGER / ~/.cache/openr_tpu/perf)",
@@ -336,7 +350,7 @@ def main(argv=None) -> int:
                 + f" --xla_force_host_platform_device_count={args.devices}"
             ).strip()
 
-    from openr_tpu.ops.xla_cache import enable_compilation_cache
+    from openr_tpu.ops.xla_cache import configure_aot, enable_compilation_cache
     from openr_tpu.runtime import perf_ledger
 
     perf_ledger.configure(
@@ -350,6 +364,11 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 1
     print(f"[prewarm] cache: {cache}")
+    aot = configure_aot(args.aot_cache_dir)
+    if aot.enabled:
+        print(f"[prewarm] aot cache: {aot.dir}")
+    else:
+        print("[prewarm] aot cache disabled — executables not serialized")
     total = 0.0
     for n in args.nodes:
         total += prewarm_class(n, enable_lfa=False, enable_ksp2=False)
@@ -365,6 +384,12 @@ def main(argv=None) -> int:
             total += prewarm_multichip(n)
         if args.whatif:
             total += prewarm_whatif(n)
+    if aot.enabled:
+        s = aot.summary()
+        print(
+            f"[prewarm] aot: {s['entries']} serialized entries on disk "
+            f"({s['writes']} written this run, fp {s['fingerprint']})"
+        )
     print(f"[prewarm] done in {total:.1f}s — restarts now load from cache")
     return 0
 

@@ -18,6 +18,10 @@ os.environ["XLA_FLAGS"] = (
 # hermetic tests: never load persistent-cache AOT artifacts compiled for
 # a different backend/machine-feature set (ops/xla_cache.py)
 os.environ["OPENR_TPU_XLA_CACHE"] = "off"
+# same for the serialized-executable cache: a developer's fleet-wide
+# $OPENR_TPU_AOT_CACHE opt-in must not leak entries into (or out of)
+# the suite; tests that exercise it configure a tmp dir explicitly
+os.environ["OPENR_TPU_AOT_CACHE"] = "off"
 try:
     import jax
 

@@ -1137,3 +1137,120 @@ class TestDeviceRetraceFlightRecorderDrill:
                 with contextlib.suppress(Exception):
                     await mon.stop()
             await stop_all(nodes)
+
+
+class TestWarmCacheRestartDrill:
+    @run_async
+    async def test_decision_restart_mid_churn_recovers_without_compile(self):
+        """ISSUE 20 drill: a Decision restart mid-churn with a warm AOT
+        cache must recover WITHOUT recompiling — every executable the
+        reconvergence dispatches is deserialized from disk. The cold
+        generation converges and absorbs a link flap (populating the
+        cache), then the whole stack is stopped mid-churn and the
+        in-memory half of a process restart is simulated
+        (clear_all_jit_caches + jax.clear_caches); a fresh generation
+        on the same disk cache must reconverge with zero in-scope XLA
+        compiles, zero cache misses, and no sentinel events."""
+        import shutil
+        import tempfile
+
+        import jax
+
+        from openr_tpu.ops.xla_cache import (
+            baker,
+            clear_all_jit_caches,
+            configure_aot,
+            retrace,
+        )
+
+        registry.clear()
+        names = ["node-0", "node-1", "node-2"]
+        links = [
+            ("node-0", "if-01", "node-1", "if-10"),
+            ("node-1", "if-12", "node-2", "if-21"),
+            ("node-2", "if-20", "node-0", "if-02"),
+        ]
+        dcfg = DecisionConfig(debounce_min_ms=5, debounce_max_ms=25)
+        cache_dir = tempfile.mkdtemp(prefix="openr-tpu-aot-drill-")
+        aot = configure_aot(cache_dir)
+        aot.reset_stats()
+        baker.reset()
+        # the cold generation's compiles are warmup, not retraces
+        clear_all_jit_caches()
+        retrace.reset()
+
+        def converged(nodes):
+            def check():
+                for i, n in enumerate(names):
+                    expect = {loopback(j) for j in range(3) if j != i}
+                    if set(nodes[n].fib_routes) != expect:
+                        return False
+                return True
+
+            return check
+
+        nodes = {}
+        try:
+            # -- cold generation: converge + flap = cache population
+            mesh, nodes = await start_mesh(
+                names, links, solver_backend="tpu", decision_config=dcfg
+            )
+            for i, n in enumerate(names):
+                nodes[n].advertise_prefix(loopback(i))
+            await wait_until(converged(nodes), timeout_s=CONVERGENCE_S)
+            # churn: cut a link and reconverge through the long way
+            mesh.disconnect("node-0", "if-02", "node-2", "if-20")
+
+            def rerouted_via_b():
+                entry = nodes["node-0"].fib_routes.get(loopback(2))
+                return entry is not None and {
+                    nh.neighbor_node_name for nh in entry.nexthops
+                } == {"node-1"}
+
+            await wait_until(rerouted_via_b, timeout_s=CONVERGENCE_S)
+            assert aot.summary()["writes"] >= 1, aot.summary()
+            # mid-churn: fresh state is in flight when the stack dies
+            nodes["node-2"].advertise_prefix("10.99.0.0/24")
+            await stop_all(nodes)
+
+            # -- the restart: drop every piece of in-memory compiled
+            # state a process exit would drop; the disk cache survives
+            clear_all_jit_caches()
+            jax.clear_caches()
+            retrace.reset()
+            aot.reset_stats()
+            pre = aot.preload()  # the aot_load boot phase
+            assert pre["loaded"] >= 1, pre
+            scoped0 = _counter("xla_cache.scoped_compiles")
+
+            # -- warm generation: same fabric, same churn shape
+            mesh, nodes = await start_mesh(
+                names, links, solver_backend="tpu", decision_config=dcfg
+            )
+            for i, n in enumerate(names):
+                nodes[n].advertise_prefix(loopback(i))
+            await wait_until(converged(nodes), timeout_s=CONVERGENCE_S)
+            # supervised recovery keeps absorbing churn, still warm
+            nodes["node-2"].advertise_prefix("10.99.0.0/24")
+            await wait_until(
+                lambda: "10.99.0.0/24" in nodes["node-0"].fib_routes,
+                timeout_s=CONVERGENCE_S,
+            )
+            await asyncio.sleep(0.3)  # let trailing rebuilds settle
+
+            s = aot.summary()
+            assert s["hits"] >= 1, s
+            assert s["misses"] == 0, s  # every install came from disk
+            assert s["hit_rate"] == 1.0, s
+            # the sentinel's census proves no XLA compile fired inside
+            # any solver scope, and nothing paged
+            assert _counter("xla_cache.scoped_compiles") == scoped0
+            snap = retrace.snapshot()
+            assert sum(snap["retraces"].values()) == 0, snap
+            assert snap["aot_installs"] >= 1, snap
+        finally:
+            registry.clear()
+            await stop_all(nodes)
+            configure_aot("off")
+            retrace.reset()
+            shutil.rmtree(cache_dir, ignore_errors=True)

@@ -1129,6 +1129,14 @@ class TestDevicePlaneRpc:
             assert kernels["backend"] == "cpu"
             assert isinstance(kernels["kernels"], dict)
             assert isinstance(kernels["achieved"], list)
+
+            # ctrl.tpu.aot (ISSUE 20): always answers; with the cache
+            # unconfigured (the test default) it reports disabled with
+            # an empty listing rather than erroring
+            aotd = await client.request("ctrl.tpu.aot")
+            assert aotd["summary"]["enabled"] is False
+            assert aotd["entries"] == []
+            assert isinstance(aotd["aot_installs"], int)
         finally:
             await client.close()
             await a.stop()
@@ -1285,3 +1293,65 @@ def test_kv_compare_detects_value_and_ttl_divergence(monkeypatch):
     delta = json.loads(res.output)["127.0.0.1:2222"]
     assert delta["diverged"] == ["k-ttl", "k-val"]
     assert not delta["missing_here"] and not delta["missing_there"]
+
+
+def test_breeze_tpu_aot_renders_summary_and_entries(monkeypatch):
+    """`breeze tpu aot` renders the ctrl.tpu.aot payload: header line
+    with the cache dir + hit/miss roll-up, one row per on-disk entry
+    (staleness flagged), corrupt entries visibly marked."""
+    from openr_tpu.cli import breeze as bz
+
+    doc = {
+        "summary": {
+            "enabled": True, "dir": "/var/cache/openr/aot", "keep": 64,
+            "fingerprint": "jax0.4.37+jaxlib0.4.36+cpu+cpux8",
+            "entries": 2, "preloaded_pending": 0, "hit_rate": 0.9375,
+            "hits": 15, "misses": 1, "load_errors": 0,
+            "stale_fingerprint": 1, "writes": 1, "write_errors": 0,
+            "evictions": 0, "preloaded": 15, "speculative_bakes": 2,
+            "speculative_errors": 0,
+        },
+        "entries": [
+            {"file": "pipeline-abc.aotx", "kernel": "pipeline[n=128]",
+             "signature": "('pipeline', ...)", "size_bytes": 204800,
+             "fingerprint": "jax0.4.37+jaxlib0.4.36+cpu+cpux8",
+             "stale": False, "age_s": 120.0, "compile_ms": 812.5,
+             "source": "compile"},
+            {"file": "fabric-old.aotx", "kernel": "fabric[mesh=4x2]",
+             "signature": "('fabric', ...)", "size_bytes": 1024,
+             "fingerprint": "jax0.0.1+jaxlib0.0.1+cpu+cpux8",
+             "stale": True, "age_s": 7200.0, "compile_ms": 99.0,
+             "source": "speculative"},
+            {"file": "torn.aotx", "corrupt": True},
+        ],
+        "aot_installs": 15,
+    }
+
+    class StubClient:
+        def __init__(self, host, port, **kw):
+            pass
+
+        async def request(self, method, params=None, *a, **kw):
+            assert method == "ctrl.tpu.aot"
+            return doc
+
+        async def close(self):
+            pass
+
+    monkeypatch.setattr(bz, "RpcClient", StubClient)
+    runner = CliRunner()
+    res = runner.invoke(bz.cli, ["tpu", "aot"], obj={})
+    assert res.exit_code == 0, res.output
+    assert "/var/cache/openr/aot" in res.output
+    assert "hits=15 misses=1 hit_rate=0.94" in res.output
+    assert "speculative=2 installs=15" in res.output
+    assert "pipeline[n=128]" in res.output
+    assert "2.0h" in res.output  # old entry ages render in hours
+    assert "STALE" in res.output
+    assert "CORRUPT" in res.output
+
+    # disabled cache renders a single clear line, exit 0
+    doc = {"summary": {"enabled": False}, "entries": [], "aot_installs": 0}
+    res = runner.invoke(bz.cli, ["tpu", "aot"], obj={})
+    assert res.exit_code == 0, res.output
+    assert "DISABLED" in res.output

@@ -137,6 +137,14 @@ class TestBootTracerUnit:
         assert BOOT_PHASES[0] == "config_load"
         assert BOOT_PHASES[-1] == "first_fib_program"
         assert len(BOOT_PHASES) == len(set(BOOT_PHASES))
+        # the AOT executable preload (ISSUE 20) is its own attributed
+        # phase, right after the jax compilation cache attaches and
+        # before prewarm (which it turns into deserialize-and-install)
+        assert (
+            BOOT_PHASES.index("aot_load")
+            == BOOT_PHASES.index("jit_cache_attach") + 1
+        )
+        assert BOOT_PHASES.index("aot_load") < BOOT_PHASES.index("prewarm")
 
 
 class TestBootSystem:

@@ -106,6 +106,42 @@ class TestRetraceSentinel:
         assert [e["kernel"] for e in recent] == ["kern"]
         assert "signature_delta" in recent[0]
 
+    def test_plain_retrace_classifies_as_retrace(self):
+        # ISSUE 20: every sentinel event carries a classification so
+        # triage can tell trace churn from warm-cache violations
+        retrace.reset()
+        f = jax.jit(lambda x: x * 9)
+        a = jnp.arange(8)
+        b = jnp.arange(16)
+        with retrace.scope("cls", "kern", (8,)):
+            f(a).block_until_ready()
+        with retrace.scope("cls", "kern", (8,)):
+            f(b).block_until_ready()
+        [evt] = retrace.drain_events()
+        assert evt["class"] == "retrace"
+
+    def test_compile_after_aot_install_is_warm_violation(self):
+        # an AOT deserialize installed the pair warm — with no compile
+        # event ever firing, the FIRST real compile is not warmup: it
+        # is the bug the warm-cache sentinel exists to page on
+        retrace.reset()
+        before = _counter("xla_cache.retraces.aotns")
+        retrace.note_aot_install("aotns", "kern", (8,))
+        assert retrace.snapshot()["aot_installs"] == 1
+
+        f = jax.jit(lambda x: x * 11)
+        a = jnp.arange(8)
+        with retrace.scope("aotns", "kern", (8,)):
+            f(a).block_until_ready()
+        [evt] = retrace.drain_events()
+        assert evt["class"] == "aot_warm_violation"
+        assert evt["namespace"] == "aotns"
+        assert _counter("xla_cache.retraces.aotns") == before + 1
+        # forget() (bucket eviction) clears the install mark too: the
+        # regrowth compile is warmup again, not a violation
+        retrace.forget("aotns")
+        assert retrace.snapshot()["aot_installs"] == 0
+
 
 # -- Decision surfaces retraces as DEVICE_RETRACE LogSamples ---------------
 

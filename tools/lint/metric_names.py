@@ -36,6 +36,7 @@ from openr_tpu.runtime.latency_budget import BUDGET_COMPONENTS  # noqa: E402
 from openr_tpu.runtime.lifecycle import BOOT_PHASES  # noqa: E402
 from openr_tpu.runtime.overload import OVERLOAD_COUNTER_FIELDS  # noqa: E402
 from openr_tpu.runtime.replay_log import REPLAY_COUNTER_FIELDS  # noqa: E402
+from openr_tpu.ops.xla_cache import AOT_COUNTER_FIELDS  # noqa: E402
 from openr_tpu.runtime.metrics_export import (  # noqa: E402
     is_valid_metric_name,
     normalize_metric_name,
@@ -134,6 +135,15 @@ def run(project: Project) -> list[Finding]:
     if overload_site is not None:
         for field in OVERLOAD_COUNTER_FIELDS:
             counter_names.setdefault(f"overload.{field}", overload_site)
+    # And for the persistent AOT executable cache (ops/xla_cache.py):
+    # `xla_cache.aot.<field>` counters are bumped with a field drawn
+    # from the closed AOT_COUNTER_FIELDS vocabulary — expand it so
+    # hits/misses/load_errors/... participate in collision checking
+    # against the statically-named xla_cache.aot.load_ms stat.
+    aot_site = counter_names.pop(f"xla_cache.aot.{PLACEHOLDER}", None)
+    if aot_site is not None:
+        for field in AOT_COUNTER_FIELDS:
+            counter_names.setdefault(f"xla_cache.aot.{field}", aot_site)
     findings: list[Finding] = []
     # exposition family -> (raw name, site); stats expand to their
     # derived families so `a.b` (stat) vs `a.b_max` (counter) is caught

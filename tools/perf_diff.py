@@ -39,6 +39,9 @@ HIGHER_BETTER = {
     "scenarios_per_s",
     "overlap_efficiency",
     "solves",
+    # AOT executable cache (ISSUE 20): fraction of warm-boot lookups
+    # served from the serialized-executable disk cache
+    "aot_hit_rate",
 }
 LOWER_BETTER_SUFFIXES = ("_ms", "_mb", "_s", "_bytes")
 
